@@ -1,0 +1,52 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capabilities of
+PaddlePaddle Fluid 1.2 (reference at /root/reference; blueprint in SURVEY.md).
+
+Import surface mirrors `paddle.fluid`:
+
+    import paddle_tpu as fluid
+    x = fluid.layers.data('x', shape=[13])
+    y = fluid.layers.fc(x, size=1)
+    ...
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(fluid.default_startup_program())
+    loss_val, = exe.run(feed={...}, fetch_list=[loss])
+"""
+from . import ops as _ops  # registers all op lowerings
+
+from .framework import (Program, Block, Operator, Variable, Parameter,
+                        default_main_program, default_startup_program,
+                        program_guard, switch_main_program,
+                        switch_startup_program, convert_dtype,
+                        CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace)
+from .executor import Executor, global_scope, scope_guard, Scope
+from .backward import append_backward, calc_gradient
+from . import layers
+from . import initializer
+from . import regularizer
+from . import clip
+from . import optimizer
+from . import unique_name
+from . import nets
+from . import metrics
+from . import profiler
+from .param_attr import ParamAttr, WeightNormParamAttr
+from .data_feeder import DataFeeder
+from .initializer import Constant, Uniform, Normal, Xavier, MSRA, Bilinear
+from .clip import (ErrorClipByValue, GradientClipByValue, GradientClipByNorm,
+                   GradientClipByGlobalNorm, set_gradient_clip)
+from .regularizer import L1Decay, L2Decay
+from .lod_tensor import (LoDTensor, create_lod_tensor,
+                         create_random_int_lodtensor)
+from . import io
+from .io import (save_vars, save_params, save_persistables, load_vars,
+                 load_params, load_persistables, save_inference_model,
+                 load_inference_model)
+from . import core
+from .parallel.parallel_executor import ParallelExecutor
+from .parallel.compiler import CompiledProgram, BuildStrategy, ExecutionStrategy
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig, \
+    memory_optimize, release_memory, InferenceTranspiler
+
+CUDAException = RuntimeError
+
+__version__ = '0.1.0'

@@ -1,0 +1,8 @@
+from . import registry  # noqa: F401
+from .scope import Scope, global_scope, scope_guard  # noqa: F401
+from .lod import LoDArray, create_lod_array  # noqa: F401
+
+
+class EOFException(Exception):
+    """Raised by pipeline readers at end of epoch (ref: fluid.core.EOFException)."""
+    pass

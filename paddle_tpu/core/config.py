@@ -1,0 +1,59 @@
+"""Backend/platform selection + FLAGS-style config registry
+(ref: the reference's gflags system, platform/init.cc:81 and python
+__bootstrap__ in fluid/__init__.py:97-170).
+
+PTPU_PLATFORM env (or set_backend()) pins the jax backend for all executors
+and meshes — needed because the TPU plugin registers itself as default even
+when tests want the 8-device virtual CPU platform.
+"""
+from __future__ import annotations
+
+import os
+
+_backend_override = None
+
+
+def set_backend(name):
+    """Pin the jax backend ('cpu' | 'tpu' | None to auto)."""
+    global _backend_override
+    _backend_override = name
+
+
+def get_backend():
+    """Resolve the accelerator backend: override > PTPU_PLATFORM env >
+    tpu-if-present > default."""
+    if _backend_override is not None:
+        return _backend_override
+    env = os.environ.get('PTPU_PLATFORM')
+    if env:
+        return env
+    import jax
+    kinds = {d.platform for d in jax.devices()}
+    for k in ('tpu', 'axon'):
+        if k in kinds:
+            return k
+    return None  # jax default
+
+
+def accel_devices():
+    import jax
+    b = get_backend()
+    return jax.devices(b) if b else jax.devices()
+
+
+# -- FLAGS registry (reference gflags equivalents) ---------------------------
+FLAGS = {
+    'check_nan_inf': os.environ.get('FLAGS_check_nan_inf', '0') == '1',
+    'benchmark': os.environ.get('FLAGS_benchmark', '0') == '1',
+    'eager_delete_tensor_gb': float(
+        os.environ.get('FLAGS_eager_delete_tensor_gb', '-1')),
+    'deterministic': os.environ.get('FLAGS_cudnn_deterministic', '0') == '1',
+}
+
+
+def get_flag(name, default=None):
+    return FLAGS.get(name, default)
+
+
+def set_flags(d):
+    FLAGS.update(d)

@@ -1,0 +1,88 @@
+"""LoD (level-of-detail) runtime representation.
+
+The reference's LoDTensor (paddle/fluid/framework/lod_tensor.h:58,110) packs
+variable-length sequences into one dense tensor plus offset tables. TPU-native
+re-design: the dense data is a jax.Array; the offsets ride along as device
+int32 arrays inside a registered pytree (`LoDArray`) so they can flow through
+jit/pjit. Shapes stay static per (batch-size, total-token) signature; callers
+that need shape stability should bucket/pad on the host (see
+layers/io + lod_tensor helpers).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class LoDArray(object):
+    """Dense data + per-level row-split offsets (device arrays)."""
+
+    __slots__ = ('data', 'lod')
+
+    def __init__(self, data, lod=()):
+        self.data = data
+        self.lod = tuple(jnp.asarray(l, dtype=jnp.int32) for l in lod)
+
+    # -- pytree protocol ---------------------------------------------------
+    def tree_flatten(self):
+        return (self.data,) + self.lod, len(self.lod)
+
+    @classmethod
+    def tree_unflatten(cls, nlod, children):
+        obj = cls.__new__(cls)
+        obj.data = children[0]
+        obj.lod = tuple(children[1:1 + nlod])
+        return obj
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def recursive_sequence_lengths(self):
+        out = []
+        for level in self.lod:
+            l = np.asarray(level)
+            out.append((l[1:] - l[:-1]).tolist())
+        return out
+
+    def __repr__(self):
+        return "LoDArray(shape=%s, lod_levels=%d)" % (
+            tuple(self.data.shape), len(self.lod))
+
+
+def unwrap(x):
+    return x.data if isinstance(x, LoDArray) else x
+
+
+def lod_of(x):
+    return x.lod if isinstance(x, LoDArray) else ()
+
+
+def lengths_to_offsets(lengths):
+    lengths = np.asarray(lengths, dtype=np.int64)
+    return np.concatenate([[0], np.cumsum(lengths)]).astype(np.int32)
+
+
+def create_lod_array(data, recursive_seq_lens=None, lod=None):
+    """Build a LoDArray from dense data + python nested lengths or offsets."""
+    if lod is None:
+        lod = []
+        if recursive_seq_lens:
+            for lens in recursive_seq_lens:
+                lod.append(lengths_to_offsets(lens))
+    return LoDArray(jnp.asarray(data), lod)
+
+
+def segment_ids_from_offsets(offsets, total):
+    """offsets: i32[nseq+1] device array; total: static int row count.
+    Returns i32[total] mapping row -> sequence index. The workhorse for
+    lowering sequence_* ops onto XLA segment primitives."""
+    rows = jnp.arange(total, dtype=jnp.int32)
+    # searchsorted(side='right') - 1 gives the segment of each row
+    return jnp.searchsorted(offsets, rows, side='right').astype(jnp.int32) - 1

@@ -1,0 +1,135 @@
+"""Host-side Scope: name -> device array map.
+
+The reference Scope (framework/scope.h:48) is a hierarchical C++ map of
+type-erased Variables mutated in place by every op. TPU-native re-design:
+ops never mutate — the Executor traces a pure step function whose carry is
+the persistable subset of this dict, and commits the returned new state back
+here. The Scope is thus just the host-side home of parameters/optimizer
+state between runs (and the save/load surface).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+
+class Scope(object):
+    def __init__(self, parent=None):
+        self._vars = {}
+        self.parent = parent
+        self._kids = []
+
+    def var(self, name):
+        """Create-or-get (reference Scope::Var)."""
+        if name not in self._vars:
+            self._vars[name] = None
+        return _VarHandle(self, name)
+
+    def find_var(self, name):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return _VarHandle(s, name)
+            s = s.parent
+        return None
+
+    def new_scope(self):
+        kid = Scope(self)
+        self._kids.append(kid)
+        return kid
+
+    def drop_kids(self):
+        self._kids = []
+
+    # -- direct value access (the common path) -----------------------------
+    def get(self, name, default=None):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return default
+
+    def has(self, name):
+        return self.find_var(name) is not None
+
+    def set(self, name, value):
+        self._vars[name] = value
+
+    def delete(self, name):
+        self._vars.pop(name, None)
+
+    def local_var_names(self):
+        return list(self._vars)
+
+    def __contains__(self, name):
+        return self.has(name)
+
+
+class _VarHandle(object):
+    """Mimics the reference Variable handle enough for user code:
+    var.get_tensor().set(np_array, place) / np.array(tensor)."""
+
+    __slots__ = ('scope', 'name')
+
+    def __init__(self, scope, name):
+        self.scope = scope
+        self.name = name
+
+    def get_tensor(self):
+        return _TensorHandle(self.scope, self.name)
+
+    def get_value(self):
+        return self.scope.get(self.name)
+
+    def set_value(self, v):
+        self.scope.set(self.name, v)
+
+
+class _TensorHandle(object):
+    __slots__ = ('scope', 'name')
+
+    def __init__(self, scope, name):
+        self.scope = scope
+        self.name = name
+
+    def set(self, array, place=None):
+        import jax.numpy as jnp
+        self.scope.set(self.name, jnp.asarray(array))
+
+    def shape(self):
+        v = self.scope.get(self.name)
+        return list(v.shape) if v is not None else []
+
+    def __array__(self, dtype=None):
+        v = self.scope.get(self.name)
+        from .lod import unwrap
+        arr = np.asarray(unwrap(v))
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def set_lod(self, lod):
+        from .lod import LoDArray, unwrap
+        v = self.scope.get(self.name)
+        self.scope.set(self.name, LoDArray(unwrap(v), lod))
+
+    def lod(self):
+        from .lod import lod_of
+        return [np.asarray(l).tolist() for l in lod_of(self.scope.get(self.name))]
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _global_scope
+    prev, _global_scope = _global_scope, scope
+    try:
+        yield
+    finally:
+        _global_scope = prev

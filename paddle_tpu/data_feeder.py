@@ -1,0 +1,103 @@
+"""DataFeeder: python rows -> feed dict (ref: fluid/data_feeder.py:100)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .framework import Variable, default_main_program
+from .lod_tensor import create_lod_tensor
+
+
+class DataToLoDTensorConverter(object):
+    def __init__(self, place, lod_level, shape, dtype):
+        self.place = place
+        self.lod_level = lod_level
+        self.shape = [int(s) for s in shape if s is not None and s > 0]
+        self.dtype = dtype
+        self._reset()
+
+    def _reset(self):
+        self.data = []
+        self.lod = [[] for _ in range(self.lod_level)]
+
+    def feed(self, data):
+        self._feed_impl_(data, self.lod, self.lod_level)
+
+    def _feed_impl_(self, data, lod, lod_level):
+        if lod_level == 0:
+            self.data.append(data)
+        else:
+            lod[0].append(len(data))
+            for each_data in data:
+                self._feed_impl_(each_data, lod[1:], lod_level - 1)
+
+    def done(self):
+        if self.lod_level == 0:
+            arr = np.asarray(self.data, dtype=self.dtype)
+            per_sample = int(np.prod(arr.shape[1:])) if arr.ndim > 1 else 1
+            declared = int(np.prod(self.shape)) if self.shape else per_sample
+            if self.shape and per_sample == declared and \
+                    list(arr.shape[1:]) != self.shape:
+                arr = arr.reshape([arr.shape[0]] + self.shape)
+            out = arr
+        else:
+            rows = [np.asarray(r) for r in self.data]
+            flat = (np.stack(rows).astype(self.dtype) if rows
+                    else np.zeros([0] + self.shape, dtype=self.dtype))
+            if self.shape and list(flat.shape[1:]) != self.shape and \
+                    int(np.prod(flat.shape[1:])) == int(np.prod(self.shape)):
+                flat = flat.reshape([flat.shape[0]] + self.shape)
+            out = create_lod_tensor(flat, self.lod)
+        self._reset()
+        return out
+
+
+class DataFeeder(object):
+    def __init__(self, feed_list, place=None, program=None):
+        self.feed_dtypes = []
+        self.feed_names = []
+        self.feed_shapes = []
+        self.feed_lod_level = []
+        if program is None:
+            program = default_main_program()
+        for each_var in feed_list:
+            if isinstance(each_var, str):
+                each_var = program.global_block().var(each_var)
+            if not isinstance(each_var, Variable):
+                raise TypeError("Feed list should contain Variables or names")
+            self.feed_dtypes.append(each_var.dtype)
+            self.feed_names.append(each_var.name)
+            self.feed_lod_level.append(each_var.lod_level)
+            self.feed_shapes.append(list(each_var.shape or ()))
+        self.place = place
+
+    def feed(self, iterable):
+        converters = []
+        for lod_level, shape, dtype in zip(self.feed_lod_level,
+                                           self.feed_shapes, self.feed_dtypes):
+            converters.append(DataToLoDTensorConverter(
+                place=self.place, lod_level=lod_level,
+                shape=[s for s in shape if s != -1], dtype=dtype))
+        for each_sample in iterable:
+            assert len(each_sample) == len(converters), (
+                "The number of fields in data (%d) does not match the number "
+                "of feed variables (%d)" % (len(each_sample), len(converters)))
+            for each_converter, each_slot in zip(converters, each_sample):
+                each_converter.feed(each_slot)
+        ret_dict = {}
+        for each_name, each_converter in zip(self.feed_names, converters):
+            ret_dict[each_name] = each_converter.done()
+        return ret_dict
+
+    def feed_parallel(self, iterable, num_places=None):
+        """Split samples round-robin per place (ref data_feeder.py
+        feed_parallel); with SPMD we instead return one batch dict — the
+        mesh shards it — so this simply concatenates."""
+        for item in iterable:
+            yield self.feed(item)
+
+    def decorate_reader(self, reader, multi_devices=False, num_places=None,
+                        drop_last=True):
+        def _reader():
+            for item in reader():
+                yield self.feed(item)
+        return _reader

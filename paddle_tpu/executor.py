@@ -1,0 +1,211 @@
+"""Executor: trace-once/compile-once/run-many program execution.
+
+Replaces the reference's interpret-per-step C++ Executor
+(framework/executor.cc:203, python/paddle/fluid/executor.py:260). `run`
+keeps the reference's feed/fetch contract, but under the hood the program
+block is traced into a pure step function
+    (state, feed, rng) -> (fetches, new_state)
+jit-compiled by XLA, and cached keyed on (program, feed signature, fetch
+names, state signature) — the moral equivalent of executor.py:222's program
+cache, except a cache hit here skips ALL per-op work, not just op creation.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import framework
+from .framework import Program, Variable, default_main_program, _place_backend
+from .core.scope import Scope, global_scope, scope_guard  # re-export
+from .core.lowering import Tracer
+from .core.lod import LoDArray, unwrap
+
+
+def _fetch_name(f):
+    if isinstance(f, Variable):
+        return f.name
+    if isinstance(f, str):
+        return f
+    raise TypeError("fetch_list entries must be Variable or str, got %r" % (f,))
+
+
+def _collect_written(program):
+    names = set()
+    for b in program.blocks:
+        for op in b.ops:
+            names.update(op.output_arg_names())
+    return names
+
+
+class Executor(object):
+    def __init__(self, place=None):
+        self.place = place
+        backend = _place_backend(place)
+        self._device = None
+        if backend is not None:
+            try:
+                self._device = jax.devices(backend)[0]
+            except RuntimeError:
+                self._device = None
+        self._cache = {}
+        self._step_counters = {}
+
+    # ------------------------------------------------------------------
+    def run(self, program=None, feed=None, fetch_list=None, feed_var_name='feed',
+            fetch_var_name='fetch', scope=None, return_numpy=True,
+            use_program_cache=True):
+        program = program if program is not None else default_main_program()
+        mesh = None
+        if hasattr(program, '_ptpu_compiled_program'):
+            compiled = program
+            mesh = compiled._get_mesh(self)
+            program = compiled._program
+        scope = scope if scope is not None else global_scope()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        if isinstance(fetch_list, (Variable, str)):
+            fetch_list = [fetch_list]
+        fetch_names = [_fetch_name(f) for f in fetch_list]
+
+        feed_vals = {}
+        for name, value in feed.items():
+            feed_vals[name] = self._to_device_value(value,
+                                                    self._feed_var(program, name))
+
+        # persistable state present in scope
+        persist = {v.name for v in program.list_vars() if v.persistable}
+        state = {}
+        for name in sorted(persist):
+            val = scope.get(name)
+            if val is not None:
+                state[name] = val
+
+        written = _collect_written(program)
+        out_state_names = tuple(sorted(set(state) | (persist & written)))
+
+        mesh_key = (tuple(mesh.shape.items()) if mesh is not None else None)
+        key = self._cache_key(program, feed_vals, fetch_names, state,
+                              out_state_names) + (mesh_key,)
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = self._build(program, tuple(sorted(feed_vals)), tuple(fetch_names),
+                             tuple(sorted(state)), out_state_names, mesh,
+                             feed_vals)
+            self._cache[key] = fn
+
+        step = self._step_counters.get(id(program), 0)
+        self._step_counters[id(program)] = step + 1
+        seed = program.random_seed or 1234567
+        rng = jax.random.fold_in(jax.random.key(seed), step)
+
+        fetches, new_state = fn(state, feed_vals, rng)
+        for name, val in new_state.items():
+            scope.set(name, val)
+
+        if return_numpy:
+            return [np.asarray(unwrap(v)) for v in fetches]
+        return list(fetches)
+
+    def close(self):
+        self._cache.clear()
+
+    # ------------------------------------------------------------------
+    def _feed_var(self, program, name):
+        for b in program.blocks:
+            if name in b.vars:
+                return b.vars[name]
+        return None
+
+    def _to_device_value(self, value, var=None):
+        if isinstance(value, LoDArray):
+            return value
+        # host-side LoDTensor from lod_tensor.py
+        lod = getattr(value, 'lod', None)
+        data = getattr(value, 'data', value)
+        if callable(lod):  # reference-style LoDTensor API
+            lod, data = value.lod(), np.asarray(value)
+        dtype = var.dtype if var is not None and var.dtype else None
+        arr = jnp.asarray(np.asarray(data), dtype=jnp.dtype(dtype) if dtype else None)
+        if self._device is not None:
+            arr = jax.device_put(arr, self._device)
+        if lod:
+            return LoDArray(arr, [np.asarray(l, np.int32) for l in lod])
+        return arr
+
+    def _sig(self, v):
+        if isinstance(v, LoDArray):
+            return ('lod', v.data.shape, str(v.data.dtype),
+                    tuple(l.shape for l in v.lod))
+        return (tuple(np.shape(v)), str(getattr(v, 'dtype', type(v).__name__)))
+
+    def _cache_key(self, program, feed_vals, fetch_names, state, out_names):
+        return (id(program), program._build_epoch,
+                tuple((n, self._sig(v)) for n, v in sorted(feed_vals.items())),
+                tuple(fetch_names),
+                tuple((n, self._sig(v)) for n, v in sorted(state.items())),
+                out_names)
+
+    def _build(self, program, feed_names, fetch_names, state_names,
+               out_state_names, mesh=None, feed_vals=None):
+        def step(state, feed, rng):
+            tracer = Tracer(program, rng)
+            tracer.env.update(state)
+            tracer.env.update(feed)
+            tracer.run_block(program.global_block())
+            fetches = [tracer.env[n] for n in fetch_names]
+            new_state = {n: tracer.env[n] for n in out_state_names
+                         if n in tracer.env}
+            return fetches, new_state
+
+        if mesh is None:
+            jitted = jax.jit(step, donate_argnums=(0,))
+            dev = self._device
+
+            def run_single(state, feed, rng):
+                # scope state may live sharded across a mesh from an earlier
+                # ParallelExecutor run (shared-scope interop, ref
+                # parallel_executor.py/executor.py share global scope):
+                # gather anything multi-device back to this executor's device
+                def _home(v):
+                    arrs = v.data if hasattr(v, 'data') and hasattr(v, 'lod') \
+                        else v
+                    if hasattr(arrs, 'sharding') and \
+                            len(arrs.sharding.device_set) > 1:
+                        return jax.device_put(v, dev or
+                                              list(arrs.sharding.device_set)[0])
+                    return v
+                state = {n: _home(v) for n, v in state.items()}
+                return jitted(state, feed, rng)
+            return run_single
+
+        # SPMD: batch-shard the feeds over the data axis, replicate state;
+        # GSPMD partitions the program and inserts gradient all-reduces
+        # (subsumes ParallelExecutor + nccl2 + pserver-dense, SURVEY §2.4).
+        from .parallel.mesh import replicated, batch_sharded, DATA_AXIS
+        rep = replicated(mesh)
+        ndp = mesh.shape.get(DATA_AXIS, 1)
+
+        def feed_spec(name):
+            v = feed_vals.get(name)
+            arr = unwrap(v) if v is not None else None
+            if (arr is not None and getattr(arr, 'ndim', 0) >= 1
+                    and arr.shape[0] % ndp == 0 and arr.shape[0] > 0):
+                if isinstance(v, LoDArray):
+                    return None  # lod arrays: replicate (offsets are global)
+                return batch_sharded(mesh, arr.ndim)
+            return rep
+
+        feed_specs = {n: feed_spec(n) or rep for n in feed_names}
+        jitted = jax.jit(step, donate_argnums=(0,))
+
+        def run_with_mesh(state, feed, rng):
+            # place inputs on the mesh (resharding no-op when already there);
+            # jit compiles to the arg shardings, GSPMD does the rest
+            state = {n: jax.device_put(v, rep) for n, v in state.items()}
+            feed = {n: jax.device_put(v, feed_specs[n])
+                    for n, v in feed.items()}
+            rng = jax.device_put(rng, rep)
+            with mesh:
+                return jitted(state, feed, rng)
+        return run_with_mesh

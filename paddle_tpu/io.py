@@ -1,0 +1,280 @@
+"""Checkpoint / inference-model save & load
+(ref: python/paddle/fluid/io.py — save_persistables:270, load_persistables:490,
+save_inference_model:570, load_inference_model:704).
+
+The reference routes I/O through save/load OPS executed by the C++ executor,
+with tensors serialized per framework/lod_tensor.cc (u32 version, proto
+header, raw bytes). Here I/O is host-side (params already live in the host
+Scope as jax Arrays): each var is written in the same spirit — a small JSON
+header + raw little-endian bytes — and `__model__` is the Program serialized
+to JSON (programs are plain-python IR; see framework.py).
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+
+import numpy as np
+
+from .framework import (Program, Parameter, Variable, default_main_program,
+                        convert_dtype)
+from .core.scope import global_scope
+from .core.lod import LoDArray, unwrap, lod_of
+
+_MAGIC = b'PTPU'
+_VERSION = 1
+
+
+# ---------------------------------------------------------------------------
+# single-tensor serialization
+# ---------------------------------------------------------------------------
+def _serialize_tensor(f, value):
+    data = np.asarray(unwrap(value))
+    lod = [np.asarray(l).tolist() for l in lod_of(value)]
+    header = json.dumps({'dtype': data.dtype.name,
+                         'shape': list(data.shape), 'lod': lod}).encode()
+    f.write(_MAGIC)
+    f.write(struct.pack('<I', _VERSION))
+    f.write(struct.pack('<I', len(header)))
+    f.write(header)
+    f.write(np.ascontiguousarray(data).tobytes())
+
+
+def _deserialize_tensor(f):
+    import jax.numpy as jnp
+    magic = f.read(4)
+    if magic != _MAGIC:
+        raise ValueError("not a paddle_tpu tensor file (bad magic %r)" % magic)
+    (_version,) = struct.unpack('<I', f.read(4))
+    (hlen,) = struct.unpack('<I', f.read(4))
+    header = json.loads(f.read(hlen).decode())
+    n = int(np.prod(header['shape'])) if header['shape'] else 1
+    dt = np.dtype(header['dtype'])
+    data = np.frombuffer(f.read(n * dt.itemsize), dtype=dt).reshape(
+        header['shape'])
+    arr = jnp.asarray(data)
+    if header['lod']:
+        return LoDArray(arr, [np.asarray(l, np.int32) for l in header['lod']])
+    return arr
+
+
+# ---------------------------------------------------------------------------
+# program (de)serialization — the __model__ format
+# ---------------------------------------------------------------------------
+def _var_to_dict(v):
+    return {'name': v.name, 'shape': list(v.shape) if v.shape is not None else None,
+            'dtype': v.dtype, 'lod_level': v.lod_level,
+            'persistable': v.persistable, 'stop_gradient': v.stop_gradient,
+            'is_parameter': isinstance(v, Parameter),
+            'trainable': getattr(v, 'trainable', True),
+            'type': v.type}
+
+
+def _attr_jsonable(a):
+    if isinstance(a, (np.integer,)):
+        return int(a)
+    if isinstance(a, (np.floating,)):
+        return float(a)
+    if isinstance(a, dict):
+        return {k: _attr_jsonable(v) for k, v in a.items()}
+    if isinstance(a, (list, tuple)):
+        return [_attr_jsonable(v) for v in a]
+    return a
+
+
+def program_to_dict(program):
+    blocks = []
+    for b in program.blocks:
+        blocks.append({
+            'idx': b.idx, 'parent_idx': b.parent_idx,
+            'vars': [_var_to_dict(v) for v in b.vars.values()],
+            'ops': [{'type': op.type, 'inputs': op.inputs,
+                     'outputs': op.outputs,
+                     'attrs': _attr_jsonable(op.attrs)} for op in b.ops],
+        })
+    return {'version': _VERSION, 'blocks': blocks,
+            'random_seed': program.random_seed}
+
+
+def program_from_dict(d):
+    from .framework import Block, Operator
+    p = Program()
+    p.random_seed = d.get('random_seed', 0)
+    p.blocks = []
+    for bd in d['blocks']:
+        b = Block(p, bd['idx'], bd['parent_idx'])
+        p.blocks.append(b)
+    for bd, b in zip(d['blocks'], p.blocks):
+        for vd in bd['vars']:
+            cls = Parameter if vd.get('is_parameter') else Variable
+            if cls is Parameter:
+                v = Parameter(b, vd['name'], vd['shape'], vd['dtype'],
+                              trainable=vd.get('trainable', True))
+            else:
+                v = Variable(b, vd['name'], vd['shape'], vd['dtype'],
+                             lod_level=vd.get('lod_level', 0),
+                             persistable=vd.get('persistable', False),
+                             stop_gradient=vd.get('stop_gradient', False),
+                             type=vd.get('type', 'lod_tensor'))
+            b.vars[vd['name']] = v
+        for od in bd['ops']:
+            b.ops.append(Operator(b, od['type'], od['inputs'], od['outputs'],
+                                  od['attrs']))
+    return p
+
+
+def serialize_program(program):
+    return json.dumps(program_to_dict(program)).encode()
+
+
+def deserialize_program(data):
+    return program_from_dict(json.loads(data.decode()))
+
+
+# ---------------------------------------------------------------------------
+# save/load vars (ref io.py:89-704)
+# ---------------------------------------------------------------------------
+def is_persistable(var):
+    return var.persistable
+
+
+def is_parameter(var):
+    return isinstance(var, Parameter)
+
+
+def _resolve_vars(main_program, vars, predicate):
+    main_program = main_program or default_main_program()
+    if vars is None:
+        return [v for v in main_program.list_vars() if predicate(v)]
+    out = []
+    for v in vars:
+        if isinstance(v, str):
+            v = main_program.global_block().var(v)
+        out.append(v)
+    return out
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    vars = _resolve_vars(main_program, vars, predicate or (lambda v: True))
+    scope = global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    if filename is None:
+        for v in vars:
+            val = scope.get(v.name)
+            if val is None:
+                continue
+            with open(os.path.join(dirname, v.name), 'wb') as f:
+                _serialize_tensor(f, val)
+    else:
+        with open(os.path.join(dirname, filename), 'wb') as f:
+            present = [v for v in vars if scope.get(v.name) is not None]
+            f.write(struct.pack('<I', len(present)))
+            for v in present:
+                name = v.name.encode()
+                f.write(struct.pack('<I', len(name)))
+                f.write(name)
+                _serialize_tensor(f, scope.get(v.name))
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    vars = _resolve_vars(main_program, vars, predicate or (lambda v: True))
+    scope = global_scope()
+    if filename is None:
+        for v in vars:
+            path = os.path.join(dirname, v.name)
+            if not os.path.exists(path):
+                raise RuntimeError("missing checkpoint file for var %r at %s"
+                                   % (v.name, path))
+            with open(path, 'rb') as f:
+                scope.set(v.name, _deserialize_tensor(f))
+    else:
+        with open(os.path.join(dirname, filename), 'rb') as f:
+            (n,) = struct.unpack('<I', f.read(4))
+            loaded = {}
+            for _ in range(n):
+                (ln,) = struct.unpack('<I', f.read(4))
+                name = f.read(ln).decode()
+                loaded[name] = _deserialize_tensor(f)
+        for v in vars:
+            if v.name in loaded:
+                scope.set(v.name, loaded[v.name])
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, None, is_parameter, filename)
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, None, is_parameter, filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, None, is_persistable, filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, None, is_persistable, filename)
+
+
+# ---------------------------------------------------------------------------
+# inference model (ref io.py:570,704): prune to feed->fetch subgraph,
+# write __model__ + params
+# ---------------------------------------------------------------------------
+def prune_program(program, feed_names, fetch_names):
+    """Keep only ops needed to compute fetch from feed (ref framework/prune.cc)."""
+    pruned = program.clone(for_test=True)
+    block = pruned.global_block()
+    needed = set(fetch_names)
+    keep = []
+    for op in reversed(block.ops):
+        if op.type in ('feed', 'fetch'):
+            continue
+        if any(o in needed for o in op.output_arg_names()):
+            keep.append(op)
+            needed.update(n for n in op.input_arg_names() if n)
+    keep.reverse()
+    block.ops = keep
+    return pruned
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True):
+    main_program = main_program or default_main_program()
+    fetch_names = [v.name if isinstance(v, Variable) else v
+                   for v in target_vars]
+    pruned = prune_program(main_program, feeded_var_names, fetch_names)
+    pruned._feed_names = list(feeded_var_names)
+    pruned._fetch_names = fetch_names
+    os.makedirs(dirname, exist_ok=True)
+    model_path = os.path.join(dirname, model_filename or '__model__')
+    d = program_to_dict(pruned)
+    d['feed_names'] = list(feeded_var_names)
+    d['fetch_names'] = fetch_names
+    with open(model_path, 'wb') as f:
+        f.write(json.dumps(d).encode())
+    save_persistables(executor, dirname, pruned, params_filename)
+    return fetch_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    model_path = os.path.join(dirname, model_filename or '__model__')
+    with open(model_path, 'rb') as f:
+        d = json.loads(f.read().decode())
+    program = program_from_dict(d)
+    load_persistables(executor, dirname, program, params_filename)
+    feed_names = d.get('feed_names', [])
+    fetch_vars = [program.global_block().var(n)
+                  for n in d.get('fetch_names', [])]
+    return program, feed_names, fetch_vars
+
+
+def get_inference_program(target_vars, main_program=None):
+    main_program = main_program or default_main_program()
+    fetch_names = [v.name if isinstance(v, Variable) else v
+                   for v in target_vars]
+    return prune_program(main_program, [], fetch_names)

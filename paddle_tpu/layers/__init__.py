@@ -1,0 +1,31 @@
+"""fluid.layers flat namespace (ref: python/paddle/fluid/layers/__init__.py).
+
+All layer modules are merged into this namespace, matching the reference's
+`from .nn import *` pattern, so `layers.fc`, `layers.data`,
+`layers.cross_entropy`, `layers.exponential_decay` etc. all resolve here.
+"""
+from . import math_op_patch
+from .nn import *            # noqa: F401,F403
+from .ops import *           # noqa: F401,F403
+from . import ops as _ops_mod
+from .tensor import (create_tensor, create_parameter, create_global_var,  # noqa
+                     sums, assign, fill_constant, fill_constant_batch_size_like,
+                     ones, zeros, zeros_like, reverse, has_inf, has_nan,
+                     isfinite, tensor_array_to_tensor)
+from .io import data, read_file, load  # noqa: F401
+from .control_flow import (increment, less_than, less_equal, greater_than,  # noqa
+                           greater_equal, equal, not_equal, is_empty, Print)
+from .metric_op import accuracy, auc  # noqa: F401
+from .learning_rate_scheduler import (noam_decay, exponential_decay,  # noqa
+                                      natural_exp_decay, inverse_time_decay,
+                                      polynomial_decay, piecewise_decay,
+                                      cosine_decay, append_LARS,
+                                      autoincreased_step_counter)
+
+# re-export the unary wrappers generated in ops.py (they're created with
+# globals() assignment so `from .ops import *` misses them without __all__)
+for _name in _ops_mod.__all__:
+    globals()[_name] = getattr(_ops_mod, _name)
+del _name, _ops_mod
+
+math_op_patch.monkey_patch_variable()

@@ -1,0 +1,66 @@
+"""Control-flow layers (ref: fluid/layers/control_flow.py —
+While:504, StaticRNN:278, DynamicRNN:1395, Switch:1139).
+
+Round-1 surface: comparison helpers + increment + Print; the block-based
+While/StaticRNN/DynamicRNN lower onto lax.while_loop/scan in the sequence
+phase (they create sub-blocks that core/lowering executes with explicit
+carries).
+"""
+from __future__ import annotations
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment")
+    if in_place:
+        out = x
+    else:
+        out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type='increment', inputs={'X': [x]},
+                     outputs={'Out': [out]}, attrs={'step': float(value)})
+    return out
+
+
+def _cmp(op_type):
+    def layer(x, y, cond=None):
+        helper = LayerHelper(op_type)
+        if cond is None:
+            cond = helper.create_variable_for_type_inference('bool')
+        cond.stop_gradient = True
+        helper.append_op(type=op_type, inputs={'X': [x], 'Y': [y]},
+                         outputs={'Out': [cond]}, attrs={})
+        return cond
+    layer.__name__ = op_type
+    return layer
+
+
+less_than = _cmp('less_than')
+less_equal = _cmp('less_equal')
+greater_than = _cmp('greater_than')
+greater_equal = _cmp('greater_equal')
+equal = _cmp('equal')
+not_equal = _cmp('not_equal')
+
+
+def is_empty(x, cond=None):
+    helper = LayerHelper("is_empty")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference('bool')
+    cond.stop_gradient = True
+    helper.append_op(type='is_empty', inputs={'X': [x]},
+                     outputs={'Out': [cond]}, attrs={})
+    return cond
+
+
+def Print(input, first_n=-1, message=None, summarize=-1, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_lod=True, print_phase='both'):
+    helper = LayerHelper('print')
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='print', inputs={'In': [input]},
+                     outputs={'Out': [out]},
+                     attrs={'first_n': first_n, 'message': message or '',
+                            'summarize': summarize})
+    return out

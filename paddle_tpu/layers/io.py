@@ -1,0 +1,40 @@
+"""Data input layers (ref: python/paddle/fluid/layers/io.py).
+
+`data` declares a feed slot. py_reader/double_buffer are provided by the
+host-side pipeline (paddle_tpu/reader/pipeline.py): the feeding thread +
+device prefetch replace the reference's C++ reader-op chain
+(operators/reader/) — see that module for the queue/EOF semantics.
+"""
+from __future__ import annotations
+
+from ..framework import default_main_program, default_startup_program
+from ..layer_helper import LayerHelper
+
+
+def data(name, shape, append_batch_size=True, dtype='float32', lod_level=0,
+         type=None, stop_gradient=True):
+    helper = LayerHelper('data')
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    var = helper.block.create_var(
+        name=name, shape=shape, dtype=dtype, lod_level=lod_level,
+        stop_gradient=stop_gradient, is_data=True)
+    # mirror the reference: a feed op records the feed order
+    block = default_main_program().global_block()
+    if not any(op.type == 'feed' and op.output('Out') == [name]
+               for op in block.ops):
+        block.prepend_op(type='feed', inputs={}, outputs={'Out': [name]},
+                         attrs={'col': 0}, infer_shape=False)
+    return var
+
+
+def read_file(reader):
+    """Pops one batch worth of variables from a pipeline reader."""
+    return reader.read()
+
+
+def load(out, file_path, load_as_fp16=None):
+    helper = LayerHelper('load')
+    helper.append_op(type='load', inputs={}, outputs={'Out': [out]},
+                     attrs={'file_path': file_path})

@@ -1,0 +1,48 @@
+"""Metric layers (ref: python/paddle/fluid/layers/metric_op.py)."""
+from __future__ import annotations
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+from ..initializer import ConstantInitializer
+from .nn import topk
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    helper = LayerHelper("accuracy")
+    values, indices = topk(input, k=k)
+    acc_out = helper.create_variable_for_type_inference(dtype="float32")
+    if correct is None:
+        correct = helper.create_variable_for_type_inference(dtype="int32")
+    if total is None:
+        total = helper.create_variable_for_type_inference(dtype="int32")
+    helper.append_op(
+        type="accuracy",
+        inputs={"Out": values, "Indices": indices, "Label": label},
+        outputs={"Accuracy": acc_out, "Correct": correct, "Total": total},
+        attrs={})
+    acc_out.stop_gradient = True
+    return acc_out
+
+
+def auc(input, label, curve='ROC', num_thresholds=2 ** 12 - 1, topk=1,
+        slide_steps=1):
+    helper = LayerHelper("auc")
+    auc_out = helper.create_variable_for_type_inference(dtype="float64")
+    # streaming stat state lives in persistable vars threaded through the step
+    stat_pos = helper.create_or_get_global_variable(
+        name=helper.name + "_stat_pos", persistable=True,
+        dtype='int64', shape=[num_thresholds + 1])
+    helper.set_variable_initializer(stat_pos, ConstantInitializer(0.0))
+    stat_neg = helper.create_or_get_global_variable(
+        name=helper.name + "_stat_neg", persistable=True,
+        dtype='int64', shape=[num_thresholds + 1])
+    helper.set_variable_initializer(stat_neg, ConstantInitializer(0.0))
+    helper.append_op(
+        type="auc",
+        inputs={"Predict": input, "Label": label,
+                "StatPos": stat_pos, "StatNeg": stat_neg},
+        outputs={"AUC": auc_out, "StatPosOut": stat_pos,
+                 "StatNegOut": stat_neg},
+        attrs={"curve": curve, "num_thresholds": num_thresholds})
+    auc_out.stop_gradient = True
+    return auc_out, [stat_pos, stat_neg]

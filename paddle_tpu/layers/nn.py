@@ -1,0 +1,1188 @@
+"""Neural-network layer functions (ref: python/paddle/fluid/layers/nn.py —
+~190 functions, the model-building vocabulary).
+
+Layers append ops to the default main program; parameters are created via
+LayerHelper with init ops in the startup program. Signatures follow the
+reference so user model code ports unchanged; `use_cudnn`-style knobs are
+accepted and ignored (XLA owns kernels).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+from ..initializer import NormalInitializer, ConstantInitializer
+from ..param_attr import ParamAttr
+
+
+def _single(v, n):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v] * n
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, is_test=False, name=None):
+    """Fully-connected layer (ref nn.py fc): mul per input + sum + bias + act."""
+    helper = LayerHelper("fc", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = helper.input_dtype()
+    mul_results = []
+    for input_var, pattr in helper.iter_inputs_and_params():
+        input_shape = input_var.shape
+        param_shape = [int(np.prod(input_shape[num_flatten_dims:])), size]
+        w = helper.create_parameter(attr=pattr, shape=param_shape, dtype=dtype)
+        tmp = helper.create_variable_for_type_inference(dtype)
+        helper.append_op(
+            type="mul", inputs={"X": input_var, "Y": w},
+            outputs={"Out": tmp},
+            attrs={"x_num_col_dims": num_flatten_dims, "y_num_col_dims": 1})
+        mul_results.append(tmp)
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = helper.create_variable_for_type_inference(dtype)
+        helper.append_op(type="sum", inputs={"X": mul_results},
+                         outputs={"Out": pre_bias}, attrs={})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=num_flatten_dims)
+    return helper.append_activation(pre_act)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype='float32'):
+    """Embedding lookup (ref nn.py embedding / lookup_table_op.cc).
+    is_sparse/is_distributed are accepted; sharding over a mesh axis is
+    configured via paddle_tpu.parallel (the dist-lookup-table equivalent)."""
+    helper = LayerHelper('embedding', param_attr=param_attr)
+    w = helper.create_parameter(attr=helper.param_attr, shape=size,
+                                dtype=dtype, is_bias=False)
+    tmp = helper.create_variable_for_type_inference(dtype)
+    padding_idx = (-1 if padding_idx is None else
+                   padding_idx if padding_idx >= 0 else size[0] + padding_idx)
+    helper.append_op(
+        type='lookup_table', inputs={'Ids': input, 'W': w},
+        outputs={'Out': tmp},
+        attrs={'is_sparse': is_sparse, 'is_distributed': is_distributed,
+               'padding_idx': padding_idx})
+    return tmp
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None):
+    helper = LayerHelper('conv2d', param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    dtype = input.dtype
+    num_channels = input.shape[1]
+    groups = groups or 1
+    filter_size = _single(filter_size, 2)
+    stride = _single(stride, 2)
+    padding = _single(padding, 2)
+    dilation = _single(dilation, 2)
+    filter_shape = [num_filters, num_channels // groups] + filter_size
+    filter_elem_num = int(np.prod(filter_shape[1:]))
+    std = (2.0 / filter_elem_num) ** 0.5
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=filter_shape, dtype=dtype,
+        default_initializer=NormalInitializer(0.0, std))
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type='conv2d',
+        inputs={'Input': input, 'Filter': w},
+        outputs={'Output': pre_bias},
+        attrs={'strides': stride, 'paddings': padding, 'dilations': dilation,
+               'groups': groups, 'use_cudnn': use_cudnn})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None):
+    helper = LayerHelper('conv3d', param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    dtype = input.dtype
+    num_channels = input.shape[1]
+    groups = groups or 1
+    filter_size = _single(filter_size, 3)
+    filter_shape = [num_filters, num_channels // groups] + filter_size
+    std = (2.0 / int(np.prod(filter_shape[1:]))) ** 0.5
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=filter_shape, dtype=dtype,
+        default_initializer=NormalInitializer(0.0, std))
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type='conv3d', inputs={'Input': input, 'Filter': w},
+        outputs={'Output': pre_bias},
+        attrs={'strides': _single(stride, 3), 'paddings': _single(padding, 3),
+               'dilations': _single(dilation, 3), 'groups': groups})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    helper = LayerHelper('conv2d_transpose', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    groups = groups or 1
+    stride = _single(stride, 2)
+    padding = _single(padding, 2)
+    dilation = _single(dilation, 2)
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError("filter_size or output_size must be set")
+        output_size = _single(output_size, 2)
+        h_in, w_in = input.shape[2], input.shape[3]
+        filter_size = [
+            (output_size[0] - (h_in - 1) * stride[0] + 2 * padding[0] - 1)
+            // dilation[0] + 1,
+            (output_size[1] - (w_in - 1) * stride[1] + 2 * padding[1] - 1)
+            // dilation[1] + 1]
+    else:
+        filter_size = _single(filter_size, 2)
+    filter_shape = [input.shape[1], num_filters // groups] + filter_size
+    w = helper.create_parameter(attr=helper.param_attr, shape=filter_shape,
+                                dtype=dtype)
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type='conv2d_transpose', inputs={'Input': input, 'Filter': w},
+        outputs={'Output': pre_bias},
+        attrs={'strides': stride, 'paddings': padding, 'dilations': dilation,
+               'groups': groups})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    helper = LayerHelper('conv3d_transpose', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    groups = groups or 1
+    filter_size = _single(filter_size, 3)
+    filter_shape = [input.shape[1], num_filters // groups] + filter_size
+    w = helper.create_parameter(attr=helper.param_attr, shape=filter_shape,
+                                dtype=dtype)
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type='conv3d_transpose', inputs={'Input': input, 'Filter': w},
+        outputs={'Output': pre_bias},
+        attrs={'strides': _single(stride, 3), 'paddings': _single(padding, 3),
+               'dilations': _single(dilation, 3), 'groups': groups})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True):
+    helper = LayerHelper('pool2d', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type='pool2d', inputs={'X': input}, outputs={'Out': out},
+        attrs={'pooling_type': pool_type, 'ksize': _single(pool_size, 2),
+               'global_pooling': global_pooling,
+               'strides': _single(pool_stride, 2),
+               'paddings': _single(pool_padding, 2),
+               'ceil_mode': ceil_mode, 'exclusive': exclusive})
+    return out
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True):
+    helper = LayerHelper('pool3d', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type='pool3d', inputs={'X': input}, outputs={'Out': out},
+        attrs={'pooling_type': pool_type, 'ksize': _single(pool_size, 3),
+               'global_pooling': global_pooling,
+               'strides': _single(pool_stride, 3),
+               'paddings': _single(pool_padding, 3),
+               'ceil_mode': ceil_mode, 'exclusive': exclusive})
+    return out
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    helper = LayerHelper('adaptive_pool2d', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type='pool2d', inputs={'X': input}, outputs={'Out': out},
+        attrs={'pooling_type': pool_type, 'ksize': _single(pool_size, 2),
+               'adaptive': True})
+    return out
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    helper = LayerHelper('adaptive_pool3d', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type='pool3d', inputs={'X': input}, outputs={'Out': out},
+        attrs={'pooling_type': pool_type, 'ksize': _single(pool_size, 3),
+               'adaptive': True})
+    return out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-05,
+               param_attr=None, bias_attr=None, data_layout='NCHW',
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None,
+               do_model_average_for_mean_and_var=False,
+               fuse_with_relu=False, use_global_stats=False):
+    helper = LayerHelper('batch_norm', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    c = input.shape[1] if data_layout == 'NCHW' else input.shape[-1]
+    scale = helper.create_parameter(
+        attr=helper.param_attr or ParamAttr(), shape=[c], dtype=dtype,
+        default_initializer=ConstantInitializer(1.0))
+    bias = helper.create_parameter(attr=helper.bias_attr or ParamAttr(),
+                                   shape=[c], dtype=dtype, is_bias=True)
+    mean = helper.create_or_get_global_variable(
+        name=moving_mean_name or (helper.name + '.mean'),
+        shape=[c], dtype=dtype, persistable=True)
+    helper.set_variable_initializer(mean, ConstantInitializer(0.0))
+    variance = helper.create_or_get_global_variable(
+        name=moving_variance_name or (helper.name + '.variance'),
+        shape=[c], dtype=dtype, persistable=True)
+    helper.set_variable_initializer(variance, ConstantInitializer(1.0))
+    mean.stop_gradient = True
+    variance.stop_gradient = True
+
+    saved_mean = helper.create_variable_for_type_inference(dtype, True)
+    saved_var = helper.create_variable_for_type_inference(dtype, True)
+    out = input if in_place else helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type='batch_norm',
+        inputs={'X': input, 'Scale': scale, 'Bias': bias,
+                'Mean': mean, 'Variance': variance},
+        outputs={'Y': out, 'MeanOut': mean, 'VarianceOut': variance,
+                 'SavedMean': saved_mean, 'SavedVariance': saved_var},
+        attrs={'momentum': momentum, 'epsilon': epsilon, 'is_test': is_test,
+               'data_layout': data_layout,
+               'use_global_stats': use_global_stats})
+    return helper.append_activation(out)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-05, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    helper = LayerHelper('layer_norm', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    param_shape = [int(np.prod(input.shape[begin_norm_axis:]))]
+    inputs = {'X': input}
+    if scale:
+        s = helper.create_parameter(
+            attr=helper.param_attr, shape=param_shape, dtype=dtype,
+            default_initializer=ConstantInitializer(1.0))
+        inputs['Scale'] = s
+    if shift:
+        b = helper.create_parameter(attr=helper.bias_attr, shape=param_shape,
+                                    dtype=dtype, is_bias=True)
+        inputs['Bias'] = b
+    mean_out = helper.create_variable_for_type_inference(dtype, True)
+    var_out = helper.create_variable_for_type_inference(dtype, True)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type='layer_norm', inputs=inputs,
+        outputs={'Y': out, 'Mean': mean_out, 'Variance': var_out},
+        attrs={'epsilon': epsilon, 'begin_norm_axis': begin_norm_axis})
+    return helper.append_activation(out)
+
+
+def group_norm(input, groups, epsilon=1e-05, param_attr=None, bias_attr=None,
+               act=None, data_layout='NCHW', name=None):
+    helper = LayerHelper('group_norm', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    c = input.shape[1]
+    inputs = {'X': input}
+    if param_attr is not False:
+        s = helper.create_parameter(
+            attr=helper.param_attr, shape=[c], dtype=dtype,
+            default_initializer=ConstantInitializer(1.0))
+        inputs['Scale'] = s
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=helper.bias_attr, shape=[c],
+                                    dtype=dtype, is_bias=True)
+        inputs['Bias'] = b
+    mean_out = helper.create_variable_for_type_inference(dtype, True)
+    var_out = helper.create_variable_for_type_inference(dtype, True)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='group_norm', inputs=inputs,
+                     outputs={'Y': out, 'Mean': mean_out, 'Variance': var_out},
+                     attrs={'epsilon': epsilon, 'groups': groups})
+    return helper.append_activation(out)
+
+
+def data_norm(input, act=None, epsilon=1e-05, param_attr=None,
+              data_layout='NCHW', in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=False):
+    helper = LayerHelper('data_norm', act=act, name=name)
+    dtype = input.dtype
+    c = input.shape[1]
+    batch_size = helper.create_parameter(
+        attr=ParamAttr(name=helper.name + '.batch_size', trainable=True),
+        shape=[c], dtype=dtype,
+        default_initializer=ConstantInitializer(1e4))
+    batch_sum = helper.create_parameter(
+        attr=ParamAttr(name=helper.name + '.batch_sum', trainable=True),
+        shape=[c], dtype=dtype, default_initializer=ConstantInitializer(0.0))
+    batch_square = helper.create_parameter(
+        attr=ParamAttr(name=helper.name + '.batch_square_sum', trainable=True),
+        shape=[c], dtype=dtype, default_initializer=ConstantInitializer(1e4))
+    means = helper.create_variable_for_type_inference(dtype, True)
+    scales = helper.create_variable_for_type_inference(dtype, True)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type='data_norm',
+        inputs={'X': input, 'BatchSize': batch_size, 'BatchSum': batch_sum,
+                'BatchSquareSum': batch_square},
+        outputs={'Y': out, 'Means': means, 'Scales': scales},
+        attrs={'epsilon': epsilon})
+    return helper.append_activation(out)
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    helper = LayerHelper('dropout', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    mask = helper.create_variable_for_type_inference(x.dtype, True)
+    helper.append_op(
+        type='dropout', inputs={'X': x},
+        outputs={'Out': out, 'Mask': mask},
+        attrs={'dropout_prob': dropout_prob, 'is_test': is_test,
+               'seed': seed if seed is not None else 0,
+               'dropout_implementation': dropout_implementation})
+    return out
+
+
+def softmax(input, use_cudnn=True, name=None, axis=-1):
+    helper = LayerHelper('softmax', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='softmax', inputs={'X': input},
+                     outputs={'Out': out}, attrs={'axis': axis})
+    return out
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    helper = LayerHelper('cross_entropy')
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type='cross_entropy', inputs={'X': input, 'Label': label},
+        outputs={'Y': out},
+        attrs={'soft_label': soft_label, 'ignore_index': ignore_index})
+    return out
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False):
+    helper = LayerHelper('softmax_with_cross_entropy')
+    softmax_out = helper.create_variable_for_type_inference(logits.dtype)
+    loss = helper.create_variable_for_type_inference(logits.dtype)
+    helper.append_op(
+        type='softmax_with_cross_entropy',
+        inputs={'Logits': logits, 'Label': label},
+        outputs={'Softmax': softmax_out, 'Loss': loss},
+        attrs={'soft_label': soft_label, 'ignore_index': ignore_index})
+    if return_softmax:
+        return loss, softmax_out
+    return loss
+
+
+def square_error_cost(input, label):
+    helper = LayerHelper('square_error_cost')
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='square_error_cost',
+                     inputs={'X': input, 'Y': label}, outputs={'Out': out},
+                     attrs={})
+    return out
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100, name=None,
+                                      normalize=False):
+    helper = LayerHelper('sigmoid_cross_entropy_with_logits', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type='sigmoid_cross_entropy_with_logits',
+        inputs={'X': x, 'Label': label}, outputs={'Out': out},
+        attrs={'ignore_index': ignore_index, 'normalize': normalize})
+    return out
+
+
+def huber_loss(input, label, delta):
+    helper = LayerHelper('huber_loss')
+    out = helper.create_variable_for_type_inference(input.dtype)
+    residual = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op(type='huber_loss', inputs={'X': input, 'Y': label},
+                     outputs={'Out': out, 'Residual': residual},
+                     attrs={'delta': delta})
+    return out
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    helper = LayerHelper('smooth_l1_loss')
+    out = helper.create_variable_for_type_inference(x.dtype)
+    diff = helper.create_variable_for_type_inference(x.dtype, True)
+    inputs = {'X': x, 'Y': y}
+    if inside_weight is not None:
+        inputs['InsideWeight'] = inside_weight
+    if outside_weight is not None:
+        inputs['OutsideWeight'] = outside_weight
+    helper.append_op(type='smooth_l1_loss', inputs=inputs,
+                     outputs={'Out': out, 'Diff': diff},
+                     attrs={'sigma': sigma if sigma is not None else 1.0})
+    return out
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    helper = LayerHelper('log_loss', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='log_loss',
+                     inputs={'Predicted': input, 'Labels': label},
+                     outputs={'Loss': out}, attrs={'epsilon': epsilon})
+    return out
+
+
+def bpr_loss(input, label, name=None):
+    helper = LayerHelper('bpr_loss', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='bpr_loss', inputs={'X': input, 'Label': label},
+                     outputs={'Y': out}, attrs={})
+    return out
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    helper = LayerHelper('margin_rank_loss', name=name)
+    out = helper.create_variable_for_type_inference(left.dtype)
+    act = helper.create_variable_for_type_inference(left.dtype, True)
+    helper.append_op(type='margin_rank_loss',
+                     inputs={'Label': label, 'X1': left, 'X2': right},
+                     outputs={'Out': out, 'Activated': act},
+                     attrs={'margin': margin})
+    return out
+
+
+def rank_loss(label, left, right, name=None):
+    helper = LayerHelper('rank_loss', name=name)
+    out = helper.create_variable_for_type_inference(left.dtype)
+    helper.append_op(type='rank_loss',
+                     inputs={'Label': label, 'Left': left, 'Right': right},
+                     outputs={'Out': out}, attrs={})
+    return out
+
+
+def dice_loss(input, label, epsilon=0.00001):
+    label = one_hot(label, depth=input.shape[-1])
+    reduce_dim = list(range(1, len(input.shape)))
+    inse = reduce_sum(input * label, dim=reduce_dim)
+    dice_denominator = reduce_sum(input, dim=reduce_dim) + reduce_sum(
+        label, dim=reduce_dim)
+    dice_score = 1 - inse * 2 / (dice_denominator + epsilon)
+    return reduce_mean(dice_score)
+
+
+def mean_iou(input, label, num_classes):
+    helper = LayerHelper('mean_iou')
+    out_mean_iou = helper.create_variable_for_type_inference('float32')
+    out_wrong = helper.create_variable_for_type_inference('float32')
+    out_correct = helper.create_variable_for_type_inference('float32')
+    helper.append_op(type='mean_iou',
+                     inputs={'Predictions': input, 'Labels': label},
+                     outputs={'OutMeanIou': out_mean_iou,
+                              'OutWrong': out_wrong,
+                              'OutCorrect': out_correct},
+                     attrs={'num_classes': num_classes})
+    return out_mean_iou, out_wrong, out_correct
+
+
+def relu(x, name=None):
+    helper = LayerHelper('relu', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='relu', inputs={'X': x}, outputs={'Out': out},
+                     attrs={})
+    return out
+
+
+def log(x, name=None):
+    helper = LayerHelper('log', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='log', inputs={'X': x}, outputs={'Out': out},
+                     attrs={})
+    return out
+
+
+def _simple_unary(op_type):
+    def layer(x, name=None, **attrs):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op(type=op_type, inputs={'X': x}, outputs={'Out': out},
+                         attrs=attrs)
+        return out
+    layer.__name__ = op_type
+    return layer
+
+
+leaky_relu = _simple_unary('leaky_relu')
+elu = _simple_unary('elu')
+relu6 = _simple_unary('relu6')
+brelu = _simple_unary('brelu')
+soft_relu = _simple_unary('soft_relu')
+stanh = _simple_unary('stanh')
+hard_sigmoid = _simple_unary('hard_sigmoid')
+swish = _simple_unary('swish')
+selu = _simple_unary('selu')
+maxout = _simple_unary('maxout')
+space_to_depth = _simple_unary('space_to_depth')
+shuffle_channel = _simple_unary('shuffle_channel')
+
+
+def pow(x, factor=1.0, name=None):
+    helper = LayerHelper('pow', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='pow', inputs={'X': x}, outputs={'Out': out},
+                     attrs={'factor': factor})
+    return out
+
+
+def prelu(x, mode, param_attr=None, name=None):
+    helper = LayerHelper('prelu', param_attr=param_attr, name=name)
+    if mode not in ['all', 'channel', 'element']:
+        raise ValueError('mode should be one of all, channel, element.')
+    alpha_shape = [1]
+    if mode == 'channel':
+        alpha_shape = [1, x.shape[1], 1, 1]
+    elif mode == 'element':
+        alpha_shape = list(x.shape)
+        alpha_shape[0] = 1
+    alpha = helper.create_parameter(
+        attr=helper.param_attr, shape=alpha_shape, dtype='float32',
+        is_bias=False, default_initializer=ConstantInitializer(0.25))
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='prelu', inputs={'X': x, 'Alpha': alpha},
+                     outputs={'Out': out}, attrs={'mode': mode})
+    return out
+
+
+def clip(x, min, max, name=None):
+    helper = LayerHelper('clip', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='clip', inputs={'X': x}, outputs={'Out': out},
+                     attrs={'min': min, 'max': max})
+    return out
+
+
+def clip_by_norm(x, max_norm, name=None):
+    helper = LayerHelper('clip_by_norm', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='clip_by_norm', inputs={'X': x},
+                     outputs={'Out': out}, attrs={'max_norm': max_norm})
+    return out
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    helper = LayerHelper('l2_normalize', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    norm = helper.create_variable_for_type_inference(x.dtype, True)
+    helper.append_op(type='l2_normalize', inputs={'X': x},
+                     outputs={'Out': out, 'Norm': norm},
+                     attrs={'axis': 1 if axis is None else axis,
+                            'epsilon': epsilon})
+    return out
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    helper = LayerHelper('lrn', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mid = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op(type='lrn', inputs={'X': input},
+                     outputs={'Out': out, 'MidOut': mid},
+                     attrs={'n': n, 'k': k, 'alpha': alpha, 'beta': beta})
+    return out
+
+
+def affine_channel(x, scale=None, bias=None, data_layout='NCHW', name=None):
+    helper = LayerHelper('affine_channel', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='affine_channel',
+                     inputs={'X': x, 'Scale': scale, 'Bias': bias},
+                     outputs={'Out': out}, attrs={'data_layout': data_layout})
+    return out
+
+
+def affine_grid(theta, out_shape, name=None):
+    helper = LayerHelper('affine_grid', name=name)
+    out = helper.create_variable_for_type_inference(theta.dtype)
+    inputs = {'Theta': theta}
+    attrs = {}
+    if isinstance(out_shape, Variable):
+        inputs['OutputShape'] = out_shape
+    else:
+        attrs['output_shape'] = list(out_shape)
+    helper.append_op(type='affine_grid', inputs=inputs,
+                     outputs={'Output': out}, attrs=attrs)
+    return out
+
+
+def grid_sampler(x, grid, name=None):
+    helper = LayerHelper('grid_sampler', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='grid_sampler', inputs={'X': x, 'Grid': grid},
+                     outputs={'Output': out}, attrs={})
+    return out
+
+
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample='BILINEAR', actual_shape=None, align_corners=True,
+                 align_mode=1):
+    helper = LayerHelper('interpolate', name=name)
+    op_type = {'BILINEAR': 'bilinear_interp',
+               'NEAREST': 'nearest_interp'}[resample]
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {'X': input}
+    attrs = {'align_corners': align_corners, 'align_mode': align_mode,
+             'out_h': -1, 'out_w': -1, 'scale': 0.0}
+    if out_shape is not None:
+        if isinstance(out_shape, Variable):
+            inputs['OutSize'] = out_shape
+        else:
+            attrs['out_h'], attrs['out_w'] = int(out_shape[0]), int(out_shape[1])
+    elif scale is not None:
+        attrs['scale'] = float(scale)
+    helper.append_op(type=op_type, inputs=inputs, outputs={'Out': out},
+                     attrs=attrs)
+    return out
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    actual_shape=None, align_corners=True, align_mode=1):
+    return image_resize(input, out_shape, scale, name, 'BILINEAR',
+                        actual_shape, align_corners, align_mode)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   actual_shape=None, align_corners=True):
+    return image_resize(input, out_shape, scale, name, 'NEAREST',
+                        actual_shape, align_corners)
+
+
+def image_resize_short(input, out_short_len, resample='BILINEAR'):
+    in_shape = input.shape
+    hw = in_shape[2:4]
+    short_idx = hw.index(min(hw))
+    out_shape = list(hw)
+    out_shape[short_idx] = out_short_len
+    out_shape[1 - short_idx] = int(float(out_shape[1 - short_idx]) *
+                                   (float(out_short_len) / float(hw[short_idx])) + 0.5)
+    return image_resize(input=input, out_shape=out_shape, resample=resample)
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    helper = LayerHelper('pad', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='pad', inputs={'X': x}, outputs={'Out': out},
+                     attrs={'paddings': paddings, 'pad_value': pad_value})
+    return out
+
+
+def pad2d(input, paddings=[0, 0, 0, 0], mode='constant', pad_value=0.0,
+          data_format="NCHW", name=None):
+    helper = LayerHelper('pad2d', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='pad2d', inputs={'X': input}, outputs={'Out': out},
+                     attrs={'paddings': paddings, 'mode': mode,
+                            'pad_value': pad_value, 'data_format': data_format})
+    return out
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    helper = LayerHelper('pad_constant_like', name=name)
+    out = helper.create_variable_for_type_inference(y.dtype)
+    helper.append_op(type='pad_constant_like', inputs={'X': x, 'Y': y},
+                     outputs={'Out': out}, attrs={'pad_value': pad_value})
+    return out
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    helper = LayerHelper('crop', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {'X': x}
+    attrs = {}
+    if isinstance(shape, Variable):
+        inputs['Y'] = shape
+        attrs['shape'] = list(shape.shape)
+    else:
+        attrs['shape'] = list(shape)
+    if isinstance(offsets, Variable):
+        inputs['Offsets'] = offsets
+    else:
+        attrs['offsets'] = list(offsets) if offsets else [0] * len(x.shape)
+    helper.append_op(type='crop', inputs=inputs, outputs={'Out': out},
+                     attrs=attrs)
+    return out
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    helper = LayerHelper('matmul', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type='matmul', inputs={'X': x, 'Y': y}, outputs={'Out': out},
+        attrs={'transpose_X': transpose_x, 'transpose_Y': transpose_y,
+               'alpha': float(alpha)})
+    return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    helper = LayerHelper('mul', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type='mul', inputs={'X': x, 'Y': y}, outputs={'Out': out},
+        attrs={'x_num_col_dims': x_num_col_dims,
+               'y_num_col_dims': y_num_col_dims})
+    return out
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None, param_attr=None,
+                            bias_attr=None):
+    helper = LayerHelper('bilinear_tensor_product', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = helper.input_dtype('x')
+    param_shape = [size, x.shape[1], y.shape[1]]
+    w = helper.create_parameter(attr=helper.param_attr, shape=param_shape,
+                                dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    inputs = {'X': x, 'Y': y, 'Weight': w}
+    if helper.bias_attr:
+        bias_size = [1, size]
+        bias = helper.create_parameter(attr=helper.bias_attr, shape=bias_size,
+                                       dtype=dtype, is_bias=True)
+        inputs['Bias'] = bias
+    helper.append_op(type='bilinear_tensor_product', inputs=inputs,
+                     outputs={'Out': out}, attrs={})
+    return helper.append_activation(out)
+
+
+def _elementwise_layer(op_type):
+    def layer(x, y, axis=-1, act=None, name=None):
+        helper = LayerHelper(op_type, act=act, name=name)
+        out = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op(type=op_type, inputs={'X': x, 'Y': y},
+                         outputs={'Out': out}, attrs={'axis': axis})
+        return helper.append_activation(out)
+    layer.__name__ = op_type
+    return layer
+
+
+elementwise_add = _elementwise_layer('elementwise_add')
+elementwise_sub = _elementwise_layer('elementwise_sub')
+elementwise_mul = _elementwise_layer('elementwise_mul')
+elementwise_div = _elementwise_layer('elementwise_div')
+elementwise_max = _elementwise_layer('elementwise_max')
+elementwise_min = _elementwise_layer('elementwise_min')
+elementwise_pow = _elementwise_layer('elementwise_pow')
+elementwise_mod = _elementwise_layer('elementwise_mod')
+elementwise_floordiv = _elementwise_layer('elementwise_floordiv')
+
+
+def _logical_layer(op_type, binary=True):
+    def layer(x, y=None, out=None, name=None):
+        helper = LayerHelper(op_type, name=name)
+        if out is None:
+            out = helper.create_variable_for_type_inference('bool')
+        inputs = {'X': x}
+        if binary:
+            inputs['Y'] = y
+        helper.append_op(type=op_type, inputs=inputs, outputs={'Out': out},
+                         attrs={})
+        return out
+    layer.__name__ = op_type
+    return layer
+
+
+logical_and = _logical_layer('logical_and')
+logical_or = _logical_layer('logical_or')
+logical_xor = _logical_layer('logical_xor')
+
+
+def logical_not(x, out=None, name=None):
+    helper = LayerHelper('logical_not', name=name)
+    if out is None:
+        out = helper.create_variable_for_type_inference('bool')
+    helper.append_op(type='logical_not', inputs={'X': x},
+                     outputs={'Out': out}, attrs={})
+    return out
+
+
+def _reduce_layer(op_type):
+    def layer(input, dim=None, keep_dim=False, name=None):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(input.dtype)
+        if dim is not None and not isinstance(dim, (list, tuple)):
+            dim = [dim]
+        helper.append_op(
+            type=op_type, inputs={'X': input}, outputs={'Out': out},
+            attrs={'dim': dim if dim is not None else [0],
+                   'keep_dim': keep_dim, 'reduce_all': dim is None})
+        return out
+    layer.__name__ = op_type
+    return layer
+
+
+reduce_sum = _reduce_layer('reduce_sum')
+reduce_mean = _reduce_layer('reduce_mean')
+reduce_max = _reduce_layer('reduce_max')
+reduce_min = _reduce_layer('reduce_min')
+reduce_prod = _reduce_layer('reduce_prod')
+
+
+def mean(x, name=None):
+    helper = LayerHelper('mean', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='mean', inputs={'X': x}, outputs={'Out': out},
+                     attrs={})
+    return out
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    helper = LayerHelper('scale', act=act, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type='scale', inputs={'X': x}, outputs={'Out': out},
+        attrs={'scale': float(scale), 'bias': float(bias),
+               'bias_after_scale': bias_after_scale})
+    return helper.append_activation(out)
+
+
+def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):
+    helper = LayerHelper('reshape2', act=act, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    x_shape = helper.create_variable_for_type_inference(x.dtype, True)
+    inputs = {'X': x}
+    if actual_shape is not None:
+        inputs['Shape'] = actual_shape
+    helper.append_op(type='reshape2', inputs=inputs,
+                     outputs={'Out': out, 'XShape': x_shape},
+                     attrs={'shape': list(shape)})
+    return helper.append_activation(out)
+
+
+def squeeze(input, axes, name=None):
+    helper = LayerHelper('squeeze2', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    x_shape = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op(type='squeeze2', inputs={'X': input},
+                     outputs={'Out': out, 'XShape': x_shape},
+                     attrs={'axes': axes})
+    return out
+
+
+def unsqueeze(input, axes, name=None):
+    helper = LayerHelper('unsqueeze2', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    x_shape = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op(type='unsqueeze2', inputs={'X': input},
+                     outputs={'Out': out, 'XShape': x_shape},
+                     attrs={'axes': axes})
+    return out
+
+
+def transpose(x, perm, name=None):
+    helper = LayerHelper('transpose2', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    x_shape = helper.create_variable_for_type_inference(x.dtype, True)
+    helper.append_op(type='transpose2', inputs={'X': x},
+                     outputs={'Out': out, 'XShape': x_shape},
+                     attrs={'axis': list(perm)})
+    return out
+
+
+def flatten(x, axis=1, name=None):
+    helper = LayerHelper('flatten2', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    x_shape = helper.create_variable_for_type_inference(x.dtype, True)
+    helper.append_op(type='flatten2', inputs={'X': x},
+                     outputs={'Out': out, 'XShape': x_shape},
+                     attrs={'axis': axis})
+    return out
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    helper = LayerHelper('split', name=name)
+    input_shape = input.shape
+    dim = dim if dim >= 0 else dim + len(input_shape)
+    if isinstance(num_or_sections, int):
+        num = num_or_sections
+        sections = []
+    else:
+        num = 0
+        sections = list(num_or_sections)
+    outs = [helper.create_variable_for_type_inference(input.dtype)
+            for _ in range(num or len(sections))]
+    helper.append_op(type='split', inputs={'X': input}, outputs={'Out': outs},
+                     attrs={'num': num, 'sections': sections, 'axis': dim})
+    return outs
+
+
+def slice(input, axes, starts, ends):
+    helper = LayerHelper('slice')
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='slice', inputs={'Input': input},
+                     outputs={'Out': out},
+                     attrs={'axes': axes, 'starts': starts, 'ends': ends})
+    return out
+
+
+def shape(input):
+    helper = LayerHelper('shape')
+    out = helper.create_variable_for_type_inference('int32')
+    helper.append_op(type='shape', inputs={'Input': input},
+                     outputs={'Out': out}, attrs={})
+    return out
+
+
+def stack(x, axis=0):
+    helper = LayerHelper('stack')
+    if isinstance(x, Variable):
+        x = [x]
+    out = helper.create_variable_for_type_inference(x[0].dtype)
+    helper.append_op(type='stack', inputs={'X': x}, outputs={'Y': out},
+                     attrs={'axis': axis})
+    return out
+
+
+def unstack(x, axis=0, num=None):
+    helper = LayerHelper('unstack')
+    if num is None:
+        num = x.shape[axis]
+    outs = [helper.create_variable_for_type_inference(x.dtype)
+            for _ in range(num)]
+    helper.append_op(type='unstack', inputs={'X': x}, outputs={'Y': outs},
+                     attrs={'axis': axis, 'num': num})
+    return outs
+
+
+def expand(x, expand_times, name=None):
+    helper = LayerHelper('expand', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='expand', inputs={'X': x}, outputs={'Out': out},
+                     attrs={'expand_times': list(expand_times)})
+    return out
+
+
+def gather(input, index):
+    helper = LayerHelper('gather')
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='gather', inputs={'X': input, 'Index': index},
+                     outputs={'Out': out}, attrs={})
+    return out
+
+
+def scatter(input, index, updates, name=None, overwrite=True):
+    helper = LayerHelper('scatter', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='scatter',
+                     inputs={'X': input, 'Ids': index, 'Updates': updates},
+                     outputs={'Out': out}, attrs={'overwrite': overwrite})
+    return out
+
+
+def one_hot(input, depth):
+    helper = LayerHelper('one_hot')
+    out = helper.create_variable_for_type_inference('float32')
+    helper.append_op(type='one_hot', inputs={'X': input},
+                     outputs={'Out': out}, attrs={'depth': depth})
+    return out
+
+
+def topk(input, k, name=None):
+    helper = LayerHelper('top_k', name=name)
+    values = helper.create_variable_for_type_inference(input.dtype)
+    indices = helper.create_variable_for_type_inference('int64')
+    helper.append_op(type='top_k', inputs={'X': input},
+                     outputs={'Out': values, 'Indices': indices},
+                     attrs={'k': k})
+    values.stop_gradient = True
+    indices.stop_gradient = True
+    return values, indices
+
+
+def argmax(x, axis=0):
+    helper = LayerHelper('arg_max')
+    out = helper.create_variable_for_type_inference('int64')
+    helper.append_op(type='arg_max', inputs={'X': x}, outputs={'Out': out},
+                     attrs={'axis': axis})
+    out.stop_gradient = True
+    return out
+
+
+def argmin(x, axis=0):
+    helper = LayerHelper('arg_min')
+    out = helper.create_variable_for_type_inference('int64')
+    helper.append_op(type='arg_min', inputs={'X': x}, outputs={'Out': out},
+                     attrs={'axis': axis})
+    out.stop_gradient = True
+    return out
+
+
+def argsort(input, axis=-1, name=None):
+    helper = LayerHelper('argsort', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ids = helper.create_variable_for_type_inference('int64')
+    helper.append_op(type='argsort', inputs={'X': input},
+                     outputs={'Out': out, 'Indices': ids},
+                     attrs={'axis': axis})
+    ids.stop_gradient = True
+    return out, ids
+
+
+def concat(input, axis=0, name=None):
+    helper = LayerHelper('concat', name=name)
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op(type='concat', inputs={'X': input},
+                     outputs={'Out': out}, attrs={'axis': axis})
+    return out
+
+
+def cast(x, dtype):
+    from ..framework import convert_dtype
+    helper = LayerHelper('cast')
+    out = helper.create_variable_for_type_inference(convert_dtype(dtype))
+    helper.append_op(type='cast', inputs={'X': x}, outputs={'Out': out},
+                     attrs={'in_dtype': x.dtype,
+                            'out_dtype': convert_dtype(dtype)})
+    return out
+
+
+def multiplex(inputs, index):
+    helper = LayerHelper('multiplex')
+    out = helper.create_variable_for_type_inference(inputs[0].dtype)
+    helper.append_op(type='multiplex',
+                     inputs={'X': inputs, 'Ids': index},
+                     outputs={'Out': out}, attrs={})
+    return out
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
+                 name=None):
+    helper = LayerHelper('label_smooth', name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    inputs = {'X': label}
+    if prior_dist is not None:
+        inputs['PriorDist'] = prior_dist
+    helper.append_op(type='label_smooth', inputs=inputs,
+                     outputs={'Out': out}, attrs={'epsilon': float(epsilon)})
+    return out
+
+
+def cos_sim(X, Y):
+    helper = LayerHelper('cos_sim')
+    out = helper.create_variable_for_type_inference(X.dtype)
+    xnorm = helper.create_variable_for_type_inference(X.dtype, True)
+    ynorm = helper.create_variable_for_type_inference(X.dtype, True)
+    helper.append_op(type='cos_sim', inputs={'X': X, 'Y': Y},
+                     outputs={'Out': out, 'XNorm': xnorm, 'YNorm': ynorm},
+                     attrs={})
+    return out
+
+
+def uniform_random_batch_size_like(input, shape, dtype='float32',
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    helper = LayerHelper('uniform_random_batch_size_like')
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='uniform_random_batch_size_like',
+                     inputs={'Input': input}, outputs={'Out': out},
+                     attrs={'shape': list(shape), 'input_dim_idx': input_dim_idx,
+                            'output_dim_idx': output_dim_idx, 'min': min,
+                            'max': max, 'seed': seed, 'dtype': dtype})
+    out.stop_gradient = True
+    return out
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype='float32'):
+    helper = LayerHelper('gaussian_random')
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='gaussian_random', outputs={'Out': out},
+                     attrs={'shape': list(shape), 'mean': mean, 'std': std,
+                            'seed': seed, 'dtype': dtype})
+    out.stop_gradient = True
+    return out
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype='float32'):
+    helper = LayerHelper('gaussian_random_batch_size_like')
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='gaussian_random_batch_size_like',
+                     inputs={'Input': input}, outputs={'Out': out},
+                     attrs={'shape': list(shape), 'input_dim_idx': input_dim_idx,
+                            'output_dim_idx': output_dim_idx, 'mean': mean,
+                            'std': std, 'seed': seed, 'dtype': dtype})
+    out.stop_gradient = True
+    return out
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype='float32'):
+    helper = LayerHelper('sampling_id')
+    out = helper.create_variable_for_type_inference('int64')
+    helper.append_op(type='sampling_id', inputs={'X': x},
+                     outputs={'Out': out},
+                     attrs={'min': min, 'max': max, 'seed': seed})
+    out.stop_gradient = True
+    return out
+
+
+def random_crop(x, shape, seed=None):
+    helper = LayerHelper('random_crop')
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='random_crop', inputs={'X': x},
+                     outputs={'Out': out},
+                     attrs={'shape': list(shape),
+                            'seed': seed if seed is not None else 0})
+    return out
+
+
+def relu_(x):
+    return relu(x)
+
+
+def add_position_encoding(input, alpha, beta, name=None):
+    helper = LayerHelper('add_position_encoding', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='add_position_encoding', inputs={'X': input},
+                     outputs={'Out': out},
+                     attrs={'alpha': alpha, 'beta': beta})
+    return out
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    helper = LayerHelper('similarity_focus', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='similarity_focus', inputs={'X': input},
+                     outputs={'Out': out},
+                     attrs={'axis': axis, 'indexes': indexes})
+    return out
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    helper = LayerHelper('hash', name=name)
+    out = helper.create_variable_for_type_inference('int64')
+    helper.append_op(type='hash', inputs={'X': input}, outputs={'Out': out},
+                     attrs={'num_hash': num_hash, 'mod_by': hash_size})
+    return out
+
+
+def grid_sample(*a, **k):
+    return grid_sampler(*a, **k)

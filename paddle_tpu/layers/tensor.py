@@ -1,0 +1,147 @@
+"""Tensor creation layers (ref: python/paddle/fluid/layers/tensor.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import Variable, convert_dtype
+from ..layer_helper import LayerHelper
+from ..initializer import ConstantInitializer, Initializer
+from .nn import cast, concat, argmax, argmin, argsort  # re-exported
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    helper = LayerHelper('create_tensor', name=name)
+    return helper.create_variable(name=helper.name, dtype=dtype,
+                                  persistable=persistable)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..param_attr import ParamAttr
+    helper = LayerHelper("create_parameter", name=name)
+    attr = attr or ParamAttr(name=name)
+    return helper.create_parameter(attr, shape, dtype, is_bias,
+                                   default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    helper = LayerHelper("global_var", name=name)
+    var = helper.create_global_variable(shape=shape, dtype=dtype,
+                                        persistable=persistable,
+                                        name=name)
+    helper.set_variable_initializer(var, ConstantInitializer(value))
+    return var
+
+
+def sums(input, out=None):
+    helper = LayerHelper('sum')
+    if out is None:
+        out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op(type='sum', inputs={'X': input}, outputs={'Out': out},
+                     attrs={})
+    return out
+
+
+def assign(input, output=None):
+    helper = LayerHelper('assign')
+    if isinstance(input, Variable):
+        if output is None:
+            output = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op(type='assign', inputs={'X': [input]},
+                         outputs={'Out': [output]}, attrs={})
+    elif isinstance(input, np.ndarray):
+        dtype = convert_dtype(input.dtype)
+        if output is None:
+            output = helper.create_variable_for_type_inference(dtype)
+        if dtype in ('float32', 'float64'):
+            values = {'fp32_values': [float(v) for v in input.flat]}
+        else:
+            values = {'int32_values': [int(v) for v in input.flat]}
+        helper.append_op(type='assign_value', outputs={'Out': [output]},
+                         attrs={'shape': list(input.shape), 'dtype': dtype,
+                                **values})
+    else:
+        raise TypeError("assign expects Variable or numpy.ndarray")
+    return output
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None):
+    helper = LayerHelper("fill_constant")
+    if out is None:
+        out = helper.create_variable_for_type_inference(convert_dtype(dtype))
+    helper.append_op(type='fill_constant', outputs={'Out': [out]},
+                     attrs={'shape': list(shape), 'dtype': convert_dtype(dtype),
+                            'value': float(value)})
+    out.stop_gradient = True
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0):
+    helper = LayerHelper("fill_constant_batch_size_like")
+    out = helper.create_variable_for_type_inference(convert_dtype(dtype))
+    helper.append_op(type='fill_constant_batch_size_like',
+                     inputs={'Input': input}, outputs={'Out': [out]},
+                     attrs={'shape': list(shape), 'dtype': convert_dtype(dtype),
+                            'value': float(value),
+                            'input_dim_idx': input_dim_idx,
+                            'output_dim_idx': output_dim_idx})
+    out.stop_gradient = True
+    return out
+
+
+def ones(shape, dtype, force_cpu=False):
+    return fill_constant(shape=shape, dtype=dtype, value=1.0)
+
+
+def zeros(shape, dtype, force_cpu=False):
+    return fill_constant(shape=shape, dtype=dtype, value=0.0)
+
+
+def zeros_like(x, out=None):
+    helper = LayerHelper('zeros_like')
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='fill_zeros_like', inputs={'X': [x]},
+                     outputs={'Out': [out]}, attrs={})
+    return out
+
+
+def reverse(x, axis):
+    helper = LayerHelper('reverse')
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='reverse', inputs={'X': x}, outputs={'Out': out},
+                     attrs={'axis': axis})
+    return out
+
+
+def has_inf(x):
+    helper = LayerHelper('isinf')
+    out = helper.create_variable_for_type_inference('bool')
+    helper.append_op(type='logical_not', inputs={'X': isfinite(x)},
+                     outputs={'Out': out}, attrs={})
+    return out
+
+
+def has_nan(x):
+    return has_inf(x)
+
+
+def isfinite(x):
+    helper = LayerHelper('isfinite')
+    out = helper.create_variable_for_type_inference('bool')
+    helper.append_op(type='isfinite', inputs={'X': x}, outputs={'Out': out},
+                     attrs={})
+    return out
+
+
+def tensor_array_to_tensor(input, axis=1, name=None):
+    from .control_flow import array_length  # noqa — tensor arrays
+    helper = LayerHelper('tensor_array_to_tensor', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_index = helper.create_variable_for_type_inference('int32')
+    helper.append_op(type='tensor_array_to_tensor', inputs={'X': input},
+                     outputs={'Out': [out], 'OutIndex': [out_index]},
+                     attrs={'axis': axis})
+    return out, out_index

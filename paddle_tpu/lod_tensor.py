@@ -1,0 +1,39 @@
+"""Host-side LoDTensor construction helpers
+(ref: python/paddle/fluid/lod_tensor.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .core.lod import LoDArray, lengths_to_offsets
+
+# host-visible alias: a fed/fetched LoD tensor IS a LoDArray
+LoDTensor = LoDArray
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """Build a LoDTensor from numpy data + nested sequence lengths
+    (ref lod_tensor.py create_lod_tensor)."""
+    if isinstance(data, LoDArray):
+        return create_lod_tensor(np.asarray(data.data), recursive_seq_lens,
+                                 place)
+    if isinstance(data, list):
+        # list of sequences: flatten, derive lengths
+        flat = np.concatenate([np.asarray(s).reshape(len(s), -1) for s in data])
+        seq_lens = [len(s) for s in data]
+        assert [seq_lens] == recursive_seq_lens or recursive_seq_lens is None
+        return create_lod_tensor(flat, [seq_lens], place)
+    data = np.asarray(data)
+    lod = [lengths_to_offsets(l) for l in (recursive_seq_lens or [])]
+    import jax.numpy as jnp
+    return LoDArray(jnp.asarray(data), lod)
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place, low,
+                                high):
+    assert isinstance(base_shape, list), "base_shape should be a list"
+    converted_recursive_seq_lens = [np.cumsum([0] + l).tolist()
+                                    for l in recursive_seq_lens]
+    total = converted_recursive_seq_lens[-1][-1]
+    data = np.random.randint(low, high + 1, size=[total] + base_shape,
+                             dtype='int64')
+    return create_lod_tensor(data, recursive_seq_lens, place)

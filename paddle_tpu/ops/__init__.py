@@ -1,0 +1,12 @@
+"""Op library: importing this package registers every op lowering.
+
+Organization mirrors the reference's operator groups (SURVEY.md §2.2):
+math/elementwise/activations, tensor manipulation, NN (conv/pool/norm/
+embedding), optimizers, metrics, sequence (LoD), control flow, detection.
+"""
+from . import math_ops        # noqa: F401
+from . import tensor_ops      # noqa: F401
+from . import nn_ops          # noqa: F401
+from . import optimizer_ops   # noqa: F401
+from . import metric_ops      # noqa: F401
+from . import control_ops     # noqa: F401

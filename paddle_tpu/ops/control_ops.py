@@ -1,0 +1,38 @@
+"""Small control/scalar ops (ref: operators/controlflow/, increment_op.cc).
+
+The heavyweight control flow (while / conditional_block) lowers to
+lax.while_loop / lax.cond in sequence_ops/control_flow lowering."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register
+from .math_ops import X
+
+
+@register('increment', no_grad=True, lod='none')
+def _increment(ctx, ins):
+    x = X(ins)
+    return {'Out': [x + jnp.asarray(ctx.attr('step', 1.0), dtype=x.dtype)]}
+
+
+@register('select', lod='none')
+def _select(ctx, ins):
+    cond = ins['Cond'][0]
+    x, y = ins['X'][0], ins['Y'][0]
+    return {'Out': [jnp.where(cond.reshape([1] * x.ndim) if cond.ndim < x.ndim
+                              else cond, x, y)]}
+
+
+@register('is_empty', no_grad=True, lod='none')
+def _is_empty(ctx, ins):
+    x = X(ins)
+    return {'Out': [jnp.asarray(x.size == 0).reshape(1)]}
+
+
+@register('print', no_grad=True)
+def _print(ctx, ins):
+    # jax.debug.print would force host sync; keep as identity (debug hook
+    # available via FLAGS in utils/flags.py)
+    x = ins['In'][0] if 'In' in ins else X(ins)
+    return {'Out': [x]}

@@ -1,0 +1,98 @@
+"""Metric op lowerings (ref: operators/metrics/ — accuracy_op.cc, auc_op.cc)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+@register('accuracy', no_grad=True, lod='none')
+def _accuracy(ctx, ins):
+    pred = ins['Out'][0]          # [N, k] top-k values (unused)
+    indices = ins['Indices'][0]   # [N, k]
+    label = ins['Label'][0]       # [N, 1]
+    lab = label.reshape(-1, 1).astype(indices.dtype)
+    correct = jnp.any(indices == lab, axis=1)
+    num_correct = jnp.sum(correct.astype(jnp.int32))
+    total = jnp.asarray(indices.shape[0], jnp.int32)
+    acc = num_correct.astype(jnp.float32) / indices.shape[0]
+    return {'Accuracy': [acc.reshape(1)], 'Correct': [num_correct.reshape(1)],
+            'Total': [total.reshape(1)]}
+
+
+@register('auc', no_grad=True, lod='none')
+def _auc(ctx, ins):
+    """Streaming AUC: stat buffers are persistable state threaded through the
+    step function (the reference mutates them in place)."""
+    predict = ins['Predict'][0]   # [N, 2]
+    label = ins['Label'][0]       # [N, 1]
+    stat_pos = ins['StatPos'][0]  # [num_thresholds + 1]
+    stat_neg = ins['StatNeg'][0]
+    num_t = ctx.attr('num_thresholds', 4095)
+    pos_prob = predict[:, 1]
+    bucket = jnp.floor(pos_prob * num_t).astype(jnp.int32)
+    bucket = jnp.clip(bucket, 0, num_t)
+    lab = label.reshape(-1).astype(jnp.int32)
+    pos_new = stat_pos.at[bucket].add((lab == 1).astype(stat_pos.dtype))
+    neg_new = stat_neg.at[bucket].add((lab == 0).astype(stat_neg.dtype))
+    # compute AUC by trapezoid over thresholds (descending)
+    pos_rev = jnp.cumsum(pos_new[::-1])
+    neg_rev = jnp.cumsum(neg_new[::-1])
+    tot_pos = pos_rev[-1]
+    tot_neg = neg_rev[-1]
+    tp = jnp.concatenate([jnp.zeros(1, pos_rev.dtype), pos_rev])
+    fp = jnp.concatenate([jnp.zeros(1, neg_rev.dtype), neg_rev])
+    area = jnp.sum((fp[1:] - fp[:-1]) * (tp[1:] + tp[:-1]) / 2.0)
+    auc = jnp.where(tot_pos * tot_neg > 0,
+                    area / jnp.maximum(tot_pos * tot_neg, 1.0), 0.0)
+    return {'AUC': [auc.astype(jnp.float64).reshape(1)],
+            'StatPosOut': [pos_new], 'StatNegOut': [neg_new]}
+
+
+@register('precision_recall', no_grad=True, lod='none')
+def _precision_recall(ctx, ins):
+    max_probs = ins['MaxProbs'][0]
+    indices = ins['Indices'][0]
+    labels = ins['Labels'][0]
+    states = ins['StatesInfo'][0]  # [C, 4] TP/FP/TN/FN
+    cls = ctx.attr('class_number')
+    idx = indices.reshape(-1).astype(jnp.int32)
+    lab = labels.reshape(-1).astype(jnp.int32)
+    onehot_pred = jnp.zeros((idx.shape[0], cls)).at[jnp.arange(idx.shape[0]), idx].set(1.0)
+    onehot_lab = jnp.zeros((lab.shape[0], cls)).at[jnp.arange(lab.shape[0]), lab].set(1.0)
+    tp = jnp.sum(onehot_pred * onehot_lab, axis=0)
+    fp = jnp.sum(onehot_pred * (1 - onehot_lab), axis=0)
+    fn = jnp.sum((1 - onehot_pred) * onehot_lab, axis=0)
+    tn = idx.shape[0] - tp - fp - fn
+    batch = jnp.stack([tp, fp, tn, fn], axis=1)
+    acc = states + batch
+
+    def prf(mat):
+        tp_, fp_, _tn, fn_ = mat[:, 0], mat[:, 1], mat[:, 2], mat[:, 3]
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / jnp.maximum(tp_ + fp_, 1.0), 0.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / jnp.maximum(tp_ + fn_, 1.0), 0.0)
+        f1 = jnp.where(prec + rec > 0, 2 * prec * rec / jnp.maximum(prec + rec, 1e-12), 0.0)
+        return jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1)])
+
+    bm = prf(batch)
+    am = prf(acc)
+    return {'BatchMetrics': [jnp.concatenate([bm, bm])],
+            'AccumMetrics': [jnp.concatenate([am, am])],
+            'AccumStatesInfo': [acc]}
+
+
+@register('mean_iou', no_grad=True, lod='none')
+def _mean_iou(ctx, ins):
+    pred = ins['Predictions'][0].reshape(-1).astype(jnp.int32)
+    lab = ins['Labels'][0].reshape(-1).astype(jnp.int32)
+    c = ctx.attr('num_classes')
+    inter = jnp.zeros((c,), jnp.float32).at[pred].add(
+        (pred == lab).astype(jnp.float32))
+    pred_cnt = jnp.zeros((c,), jnp.float32).at[pred].add(1.0)
+    lab_cnt = jnp.zeros((c,), jnp.float32).at[lab].add(1.0)
+    union = pred_cnt + lab_cnt - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1.0), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+    return {'OutMeanIou': [miou.reshape(1)], 'OutWrong': [(union - inter)],
+            'OutCorrect': [inter]}

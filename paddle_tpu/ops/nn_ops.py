@@ -1,0 +1,423 @@
+"""NN op lowerings: conv / pool / normalization / embedding / resize.
+
+Replaces the reference's cuDNN-backed kernels (operators/conv_op.cc,
+conv_cudnn_op.cu.cc, pool_op.cc, batch_norm_op.cc/cu, layer_norm_op.cc,
+lookup_table_op.cc, interpolate_op.cc ...). Convs lower to
+lax.conv_general_dilated in NCHW — XLA picks MXU-friendly internal layouts;
+grads come from the generic vjp path (no conv_grad kernels needed).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+from .math_ops import X
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v] * n
+
+
+# ---------------------------------------------------------------------------
+# convolutions
+# ---------------------------------------------------------------------------
+@register('conv2d')
+def _conv2d(ctx, ins):
+    x, w = ins['Input'][0], ins['Filter'][0]
+    strides = _pair(ctx.attr('strides', [1, 1]))
+    pads = _pair(ctx.attr('paddings', [0, 0]))
+    dils = _pair(ctx.attr('dilations', [1, 1]))
+    groups = ctx.attr('groups', 1) or 1
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dils, feature_group_count=groups,
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+    return {'Output': [out]}
+
+
+@register('depthwise_conv2d')
+def _depthwise_conv2d(ctx, ins):
+    return _conv2d(ctx, ins)
+
+
+@register('conv3d')
+def _conv3d(ctx, ins):
+    x, w = ins['Input'][0], ins['Filter'][0]
+    strides = _pair(ctx.attr('strides', [1, 1, 1]), 3)
+    pads = _pair(ctx.attr('paddings', [0, 0, 0]), 3)
+    dils = _pair(ctx.attr('dilations', [1, 1, 1]), 3)
+    groups = ctx.attr('groups', 1) or 1
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(p, p) for p in pads], rhs_dilation=dils,
+        feature_group_count=groups,
+        dimension_numbers=('NCDHW', 'OIDHW', 'NCDHW'))
+    return {'Output': [out]}
+
+
+def _conv_transpose(x, w, strides, pads, dils, groups, nd):
+    # w: [C_in, C_out/groups, *k]; emulate grad-of-conv via lhs dilation
+    k = w.shape[2:]
+    if groups > 1:
+        xs = jnp.split(x, groups, axis=1)
+        ws = jnp.split(w, groups, axis=0)
+        outs = [_conv_transpose(xi, wi, strides, pads, dils, 1, nd)
+                for xi, wi in zip(xs, ws)]
+        return jnp.concatenate(outs, axis=1)
+    wt = jnp.swapaxes(w, 0, 1)  # [C_out, C_in, *k]
+    wt = jnp.flip(wt, axis=tuple(range(2, 2 + nd)))
+    dk = [(ki - 1) * di + 1 for ki, di in zip(k, dils)]  # dilated kernel size
+    padding = [(dki - 1 - p, dki - 1 - p) for dki, p in zip(dk, pads)]
+    dims = (('NCHW', 'OIHW', 'NCHW') if nd == 2
+            else ('NCDHW', 'OIDHW', 'NCDHW'))
+    return jax.lax.conv_general_dilated(
+        x, wt, window_strides=[1] * nd, padding=padding,
+        lhs_dilation=strides, rhs_dilation=dils, dimension_numbers=dims)
+
+
+@register('conv2d_transpose')
+def _conv2d_transpose(ctx, ins):
+    x, w = ins['Input'][0], ins['Filter'][0]
+    out = _conv_transpose(x, w, _pair(ctx.attr('strides', [1, 1])),
+                          _pair(ctx.attr('paddings', [0, 0])),
+                          _pair(ctx.attr('dilations', [1, 1])),
+                          ctx.attr('groups', 1) or 1, 2)
+    return {'Output': [out]}
+
+
+@register('conv3d_transpose')
+def _conv3d_transpose(ctx, ins):
+    x, w = ins['Input'][0], ins['Filter'][0]
+    out = _conv_transpose(x, w, _pair(ctx.attr('strides', [1, 1, 1]), 3),
+                          _pair(ctx.attr('paddings', [0, 0, 0]), 3),
+                          _pair(ctx.attr('dilations', [1, 1, 1]), 3),
+                          ctx.attr('groups', 1) or 1, 3)
+    return {'Output': [out]}
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+def _pool(ctx, ins, nd):
+    x = X(ins)
+    ptype = ctx.attr('pooling_type', 'max')
+    ksize = _pair(ctx.attr('ksize'), nd)
+    strides = _pair(ctx.attr('strides', [1] * nd), nd)
+    pads = _pair(ctx.attr('paddings', [0] * nd), nd)
+    if ctx.attr('global_pooling', False):
+        ksize = list(x.shape[2:])
+        pads = [0] * nd
+    if ctx.attr('adaptive', False):
+        return {'Out': [_adaptive_pool(x, ksize, ptype, nd)]}
+    window = (1, 1) + tuple(ksize)
+    strides_full = (1, 1) + tuple(strides)
+    pad_full = [(0, 0), (0, 0)] + [(p, p) for p in pads]
+    if ctx.attr('ceil_mode', False):
+        for i in range(nd):
+            in_sz = x.shape[2 + i] + 2 * pads[i]
+            rem = (in_sz - ksize[i]) % strides[i]
+            if rem:
+                pad_full[2 + i] = (pads[i], pads[i] + strides[i] - rem)
+    if ptype == 'max':
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window,
+                                    strides_full, pad_full)
+    else:
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides_full,
+                                  pad_full)
+        if ctx.attr('exclusive', True) and any(pads):
+            ones = jnp.ones(x.shape, x.dtype)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                        strides_full, pad_full)
+            out = s / cnt
+        else:
+            out = s / float(np.prod(ksize))
+    return {'Out': [out]}
+
+
+def _adaptive_pool(x, out_size, ptype, nd):
+    # general adaptive pooling: per-dim bucket boundaries (static)
+    spatial = x.shape[2:]
+    red = jnp.max if ptype == 'max' else jnp.mean
+    # reshape trick when evenly divisible, else explicit window slices
+    if all(s % o == 0 for s, o in zip(spatial, out_size)):
+        shape = [x.shape[0], x.shape[1]]
+        axes = []
+        for i, (s, o) in enumerate(zip(spatial, out_size)):
+            shape += [o, s // o]
+            axes.append(2 + 2 * i + 1)
+        return red(x.reshape(shape), axis=tuple(axes))
+    slices = []
+    import itertools
+    for idx in itertools.product(*[range(o) for o in out_size]):
+        window = [slice(None), slice(None)]
+        for i, o in enumerate(idx):
+            s = spatial[i]
+            start = (o * s) // out_size[i]
+            end = -(-((o + 1) * s) // out_size[i])
+            window.append(slice(start, end))
+        slices.append(red(x[tuple(window)], axis=tuple(range(2, 2 + nd))))
+    out = jnp.stack(slices, axis=-1)
+    return out.reshape(x.shape[:2] + tuple(out_size))
+
+
+@register('pool2d')
+def _pool2d(ctx, ins):
+    return _pool(ctx, ins, 2)
+
+
+@register('pool3d')
+def _pool3d(ctx, ins):
+    return _pool(ctx, ins, 3)
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+@register('batch_norm')
+def _batch_norm(ctx, ins):
+    x = X(ins)
+    scale, bias = ins['Scale'][0], ins['Bias'][0]
+    mean, var = ins['Mean'][0], ins['Variance'][0]
+    eps = ctx.attr('epsilon', 1e-5)
+    momentum = ctx.attr('momentum', 0.9)
+    layout = ctx.attr('data_layout', 'NCHW')
+    use_global = ctx.attr('use_global_stats', False) or ctx.is_test
+
+    c_axis = 1 if layout == 'NCHW' else x.ndim - 1
+    red_axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    bshape = [1] * x.ndim
+    bshape[c_axis] = x.shape[c_axis]
+
+    if use_global:
+        m, v = mean, var
+        mean_out, var_out = mean, var
+        saved_mean = mean
+        saved_var = var
+    else:
+        m = jnp.mean(x, axis=red_axes)
+        v = jnp.mean(jnp.square(x), axis=red_axes) - jnp.square(m)
+        mean_out = momentum * mean + (1.0 - momentum) * m
+        var_out = momentum * var + (1.0 - momentum) * v
+        saved_mean, saved_var = m, v
+    inv = jax.lax.rsqrt(v.reshape(bshape) + eps)
+    y = (x - m.reshape(bshape)) * inv * scale.reshape(bshape) + bias.reshape(bshape)
+    return {'Y': [y], 'MeanOut': [mean_out], 'VarianceOut': [var_out],
+            'SavedMean': [saved_mean], 'SavedVariance': [inv.reshape(v.shape)]}
+
+
+@register('layer_norm')
+def _layer_norm(ctx, ins):
+    x = X(ins)
+    eps = ctx.attr('epsilon', 1e-5)
+    axis = ctx.attr('begin_norm_axis', 1)
+    red = tuple(range(axis, x.ndim))
+    m = jnp.mean(x, axis=red, keepdims=True)
+    v = jnp.mean(jnp.square(x - m), axis=red, keepdims=True)
+    y = (x - m) * jax.lax.rsqrt(v + eps)
+    norm_shape = x.shape[axis:]
+    if ins.get('Scale') and ins['Scale'][0] is not None:
+        y = y * ins['Scale'][0].reshape(norm_shape)
+    if ins.get('Bias') and ins['Bias'][0] is not None:
+        y = y + ins['Bias'][0].reshape(norm_shape)
+    lead = int(np.prod(x.shape[:axis]))
+    return {'Y': [y], 'Mean': [m.reshape(lead)], 'Variance': [v.reshape(lead)]}
+
+
+@register('group_norm')
+def _group_norm(ctx, ins):
+    x = X(ins)  # NCHW
+    g = ctx.attr('groups')
+    eps = ctx.attr('epsilon', 1e-5)
+    n, c = x.shape[0], x.shape[1]
+    xg = x.reshape((n, g, c // g) + x.shape[2:])
+    red = tuple(range(2, xg.ndim))
+    m = jnp.mean(xg, axis=red, keepdims=True)
+    v = jnp.mean(jnp.square(xg - m), axis=red, keepdims=True)
+    y = ((xg - m) * jax.lax.rsqrt(v + eps)).reshape(x.shape)
+    bshape = [1, c] + [1] * (x.ndim - 2)
+    if ins.get('Scale') and ins['Scale'][0] is not None:
+        y = y * ins['Scale'][0].reshape(bshape)
+    if ins.get('Bias') and ins['Bias'][0] is not None:
+        y = y + ins['Bias'][0].reshape(bshape)
+    return {'Y': [y], 'Mean': [m.reshape(n, g)], 'Variance': [v.reshape(n, g)]}
+
+
+@register('data_norm')
+def _data_norm(ctx, ins):
+    x = X(ins)
+    bsum = ins['BatchSum'][0]
+    bsize = ins['BatchSize'][0]
+    bsquare = ins['BatchSquareSum'][0]
+    means = bsum / bsize
+    scales = jnp.sqrt(bsize / bsquare)
+    y = (x - means) * scales
+    return {'Y': [y], 'Means': [means], 'Scales': [scales]}
+
+
+@register('lrn')
+def _lrn(ctx, ins):
+    x = X(ins)  # NCHW
+    n_ = ctx.attr('n', 5)
+    k = ctx.attr('k', 2.0)
+    alpha = ctx.attr('alpha', 1e-4)
+    beta = ctx.attr('beta', 0.75)
+    sq = jnp.square(x)
+    half = n_ // 2
+    pad = jnp.pad(sq, [(0, 0), (half, half), (0, 0), (0, 0)])
+    acc = sum(pad[:, i:i + x.shape[1]] for i in range(n_))
+    mid = k + alpha * acc
+    return {'Out': [x / jnp.power(mid, beta)], 'MidOut': [mid]}
+
+
+@register('l2_normalize')
+def _l2_normalize(ctx, ins):
+    x = X(ins)
+    axis = ctx.attr('axis', -1)
+    eps = ctx.attr('epsilon', 1e-10)
+    norm = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True))
+    return {'Out': [x / jnp.maximum(norm, eps)], 'Norm': [norm]}
+
+
+@register('affine_channel')
+def _affine_channel(ctx, ins):
+    x = X(ins)
+    layout = ctx.attr('data_layout', 'NCHW')
+    c_axis = 1 if layout == 'NCHW' else x.ndim - 1
+    shape = [1] * x.ndim
+    shape[c_axis] = x.shape[c_axis]
+    return {'Out': [x * ins['Scale'][0].reshape(shape)
+                    + ins['Bias'][0].reshape(shape)]}
+
+
+# ---------------------------------------------------------------------------
+# embedding (ref: operators/lookup_table_op.cc). is_sparse/remote prefetch
+# collapse into dense gather; sharded tables ride the mesh (see parallel/).
+# ---------------------------------------------------------------------------
+@register('lookup_table')
+def _lookup_table(ctx, ins):
+    w = ins['W'][0]
+    ids = ins['Ids'][0]
+    flat = ids.reshape(-1).astype(jnp.int32)
+    out = jnp.take(w, flat, axis=0)
+    pad = ctx.attr('padding_idx', -1)
+    if pad is not None and pad != -1:
+        if pad < 0:
+            pad += w.shape[0]
+        out = jnp.where((flat == pad)[:, None], 0.0, out)
+    shape = ids.shape
+    if shape[-1] == 1:
+        shape = shape[:-1]
+    return {'Out': [out.reshape(shape + (w.shape[1],))]}
+
+
+@register('embedding')
+def _embedding(ctx, ins):
+    return _lookup_table(ctx, ins)
+
+
+# ---------------------------------------------------------------------------
+# image resize (ref: operators/interpolate_op.cc)
+# ---------------------------------------------------------------------------
+def _out_hw(ctx, ins, x):
+    if ins.get('OutSize') and ins['OutSize'][0] is not None:
+        sz = np.asarray(ins['OutSize'][0])
+        return int(sz[0]), int(sz[1])
+    oh, ow = ctx.attr('out_h', -1), ctx.attr('out_w', -1)
+    scale = ctx.attr('scale', 0.0)
+    if (oh <= 0 or ow <= 0) and scale > 0:
+        oh = int(x.shape[2] * scale)
+        ow = int(x.shape[3] * scale)
+    return oh, ow
+
+
+def _src_index(out_len, in_len, align_corners, align_mode):
+    i = jnp.arange(out_len, dtype=jnp.float32)
+    if align_corners and out_len > 1:
+        return i * (in_len - 1) / (out_len - 1)
+    ratio = in_len / out_len
+    if align_mode == 0:
+        return jnp.clip((i + 0.5) * ratio - 0.5, 0.0)
+    return i * ratio
+
+
+@register('bilinear_interp')
+def _bilinear_interp(ctx, ins):
+    x = X(ins)
+    oh, ow = _out_hw(ctx, ins, x)
+    ac = ctx.attr('align_corners', True)
+    am = ctx.attr('align_mode', 1)
+    h, w = x.shape[2], x.shape[3]
+    fy = _src_index(oh, h, ac, am)
+    fx = _src_index(ow, w, ac, am)
+    y0 = jnp.floor(fy).astype(jnp.int32)
+    x0 = jnp.floor(fx).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    wy = (fy - y0).reshape(1, 1, -1, 1)
+    wx = (fx - x0).reshape(1, 1, 1, -1)
+    g = lambda yi, xi: x[:, :, yi, :][:, :, :, xi]
+    out = ((1 - wy) * (1 - wx) * g(y0, x0) + (1 - wy) * wx * g(y0, x1)
+           + wy * (1 - wx) * g(y1, x0) + wy * wx * g(y1, x1))
+    return {'Out': [out.astype(x.dtype)]}
+
+
+@register('nearest_interp')
+def _nearest_interp(ctx, ins):
+    x = X(ins)
+    oh, ow = _out_hw(ctx, ins, x)
+    ac = ctx.attr('align_corners', True)
+    h, w = x.shape[2], x.shape[3]
+    fy = _src_index(oh, h, ac, 1)
+    fx = _src_index(ow, w, ac, 1)
+    yi = (jnp.round(fy) if ac else jnp.floor(fy)).astype(jnp.int32)
+    xi = (jnp.round(fx) if ac else jnp.floor(fx)).astype(jnp.int32)
+    yi = jnp.clip(yi, 0, h - 1)
+    xi = jnp.clip(xi, 0, w - 1)
+    return {'Out': [x[:, :, yi, :][:, :, :, xi]]}
+
+
+@register('grid_sampler')
+def _grid_sampler(ctx, ins):
+    x = X(ins)           # [N, C, H, W]
+    grid = ins['Grid'][0]  # [N, H', W', 2] in [-1, 1]
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1.0) * (w - 1) / 2.0
+    gy = (grid[..., 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx).astype(jnp.int32)
+    y0 = jnp.floor(gy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+    wx, wy = gx - x0, gy - y0
+
+    def gather(yi, xi):
+        yi = jnp.clip(yi, 0, h - 1)
+        xi = jnp.clip(xi, 0, w - 1)
+        bidx = jnp.arange(n).reshape(n, 1, 1)
+        return x[bidx, :, yi, xi]  # [N, H', W', C]
+
+    out = (gather(y0, x0) * ((1 - wx) * (1 - wy))[..., None]
+           + gather(y0, x1) * (wx * (1 - wy))[..., None]
+           + gather(y1, x0) * ((1 - wx) * wy)[..., None]
+           + gather(y1, x1) * (wx * wy)[..., None])
+    return {'Output': [jnp.moveaxis(out, -1, 1)]}
+
+
+@register('affine_grid')
+def _affine_grid(ctx, ins):
+    theta = ins['Theta'][0]  # [N, 2, 3]
+    if ins.get('OutputShape') and ins['OutputShape'][0] is not None:
+        shape = [int(s) for s in np.asarray(ins['OutputShape'][0])]
+    else:
+        shape = ctx.attr('output_shape')
+    n, c, h, w = shape
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing='ij')
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H, W, 3]
+    out = jnp.einsum('nij,hwj->nhwi', theta, base)
+    return {'Output': [out]}

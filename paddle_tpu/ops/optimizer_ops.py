@@ -1,0 +1,215 @@
+"""Optimizer op lowerings (ref: paddle/fluid/operators/optimizers/).
+
+Each update is an op in the graph, exactly like the reference — the
+"in-place" ParamOut/MomentOut outputs are env rebindings inside the traced
+step function, so the whole update fuses into the compiled step. All are
+no_grad (OpRole kOptimize).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+def _lr(ins):
+    lr = ins['LearningRate'][0]
+    return lr.reshape(()) if hasattr(lr, 'reshape') else lr
+
+
+@register('sgd', no_grad=True, lod='none')
+def _sgd(ctx, ins):
+    p, g = ins['Param'][0], ins['Grad'][0]
+    return {'ParamOut': [p - _lr(ins) * g]}
+
+
+@register('momentum', no_grad=True, lod='none')
+def _momentum(ctx, ins):
+    p, g, v = ins['Param'][0], ins['Grad'][0], ins['Velocity'][0]
+    mu = ctx.attr('mu')
+    lr = _lr(ins)
+    v_out = mu * v + g
+    if ctx.attr('use_nesterov', False):
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {'ParamOut': [p_out], 'VelocityOut': [v_out]}
+
+
+@register('lars_momentum', no_grad=True, lod='none')
+def _lars_momentum(ctx, ins):
+    p, g, v = ins['Param'][0], ins['Grad'][0], ins['Velocity'][0]
+    mu = ctx.attr('mu')
+    coeff = ctx.attr('lars_coeff', 0.001)
+    decay = ctx.attr('lars_weight_decay', 0.0005)
+    lr = _lr(ins)
+    pn = jnp.sqrt(jnp.sum(jnp.square(p)))
+    gn = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = lr * coeff * pn / (gn + decay * pn + 1e-20)
+    v_out = mu * v + local_lr * (g + decay * p)
+    return {'ParamOut': [p - v_out], 'VelocityOut': [v_out]}
+
+
+@register('adam', no_grad=True, lod='none')
+def _adam(ctx, ins):
+    p, g = ins['Param'][0], ins['Grad'][0]
+    m, v = ins['Moment1'][0], ins['Moment2'][0]
+    b1p, b2p = ins['Beta1Pow'][0], ins['Beta2Pow'][0]
+    b1 = ctx.attr('beta1', 0.9)
+    b2 = ctx.attr('beta2', 0.999)
+    eps = ctx.attr('epsilon', 1e-8)
+    lr = _lr(ins)
+    m_out = b1 * m + (1 - b1) * g
+    v_out = b2 * v + (1 - b2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
+    p_out = p - lr_t * m_out / (jnp.sqrt(v_out) + eps)
+    return {'ParamOut': [p_out], 'Moment1Out': [m_out], 'Moment2Out': [v_out],
+            'Beta1PowOut': [b1p * b1], 'Beta2PowOut': [b2p * b2]}
+
+
+@register('adamax', no_grad=True, lod='none')
+def _adamax(ctx, ins):
+    p, g = ins['Param'][0], ins['Grad'][0]
+    m, inf = ins['Moment'][0], ins['InfNorm'][0]
+    b1p = ins['Beta1Pow'][0]
+    b1 = ctx.attr('beta1', 0.9)
+    b2 = ctx.attr('beta2', 0.999)
+    eps = ctx.attr('epsilon', 1e-8)
+    lr = _lr(ins)
+    m_out = b1 * m + (1 - b1) * g
+    inf_out = jnp.maximum(b2 * inf, jnp.abs(g))
+    p_out = p - (lr / (1 - b1p.reshape(()))) * (m_out / (inf_out + eps))
+    return {'ParamOut': [p_out], 'MomentOut': [m_out], 'InfNormOut': [inf_out]}
+
+
+@register('adagrad', no_grad=True, lod='none')
+def _adagrad(ctx, ins):
+    p, g, m = ins['Param'][0], ins['Grad'][0], ins['Moment'][0]
+    eps = ctx.attr('epsilon', 1e-6)
+    m_out = m + jnp.square(g)
+    p_out = p - _lr(ins) * g / (jnp.sqrt(m_out) + eps)
+    return {'ParamOut': [p_out], 'MomentOut': [m_out]}
+
+
+@register('decayed_adagrad', no_grad=True, lod='none')
+def _decayed_adagrad(ctx, ins):
+    p, g, m = ins['Param'][0], ins['Grad'][0], ins['Moment'][0]
+    decay = ctx.attr('decay', 0.95)
+    eps = ctx.attr('epsilon', 1e-6)
+    m_out = decay * m + (1 - decay) * jnp.square(g)
+    p_out = p - _lr(ins) * g / (jnp.sqrt(m_out) + eps)
+    return {'ParamOut': [p_out], 'MomentOut': [m_out]}
+
+
+@register('adadelta', no_grad=True, lod='none')
+def _adadelta(ctx, ins):
+    p, g = ins['Param'][0], ins['Grad'][0]
+    avg_sq_g, avg_sq_u = ins['AvgSquaredGrad'][0], ins['AvgSquaredUpdate'][0]
+    rho = ctx.attr('rho', 0.95)
+    eps = ctx.attr('epsilon', 1e-6)
+    g2 = rho * avg_sq_g + (1 - rho) * jnp.square(g)
+    upd = -jnp.sqrt((avg_sq_u + eps) / (g2 + eps)) * g
+    u2 = rho * avg_sq_u + (1 - rho) * jnp.square(upd)
+    return {'ParamOut': [p + upd], 'AvgSquaredGradOut': [g2],
+            'AvgSquaredUpdateOut': [u2]}
+
+
+@register('rmsprop', no_grad=True, lod='none')
+def _rmsprop(ctx, ins):
+    p, g = ins['Param'][0], ins['Grad'][0]
+    ms, mom = ins['MeanSquare'][0], ins['Moment'][0]
+    rho = ctx.attr('decay', 0.95)
+    eps = ctx.attr('epsilon', 1e-6)
+    mu = ctx.attr('momentum', 0.0)
+    lr = _lr(ins)
+    ms_out = rho * ms + (1 - rho) * jnp.square(g)
+    if ctx.attr('centered', False):
+        mg = ins['MeanGrad'][0]
+        mg_out = rho * mg + (1 - rho) * g
+        mom_out = mu * mom + lr * g / jnp.sqrt(ms_out - jnp.square(mg_out) + eps)
+        return {'ParamOut': [p - mom_out], 'MeanSquareOut': [ms_out],
+                'MomentOut': [mom_out], 'MeanGradOut': [mg_out]}
+    mom_out = mu * mom + lr * g / jnp.sqrt(ms_out + eps)
+    return {'ParamOut': [p - mom_out], 'MeanSquareOut': [ms_out],
+            'MomentOut': [mom_out]}
+
+
+@register('ftrl', no_grad=True, lod='none')
+def _ftrl(ctx, ins):
+    p, g = ins['Param'][0], ins['Grad'][0]
+    sq, lin = ins['SquaredAccumulator'][0], ins['LinearAccumulator'][0]
+    l1 = ctx.attr('l1', 0.0) + 1e-10
+    l2 = ctx.attr('l2', 0.0) + 1e-10
+    lr_power = ctx.attr('lr_power', -0.5)
+    lr = _lr(ins)
+    new_sq = sq + jnp.square(g)
+    if lr_power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+    else:
+        sigma = (jnp.power(new_sq, -lr_power) - jnp.power(sq, -lr_power)) / lr
+    lin_out = lin + g - sigma * p
+    if lr_power == -0.5:
+        denom = jnp.sqrt(new_sq) / lr + 2 * l2
+    else:
+        denom = jnp.power(new_sq, -lr_power) / lr + 2 * l2
+    pre = jnp.clip(lin_out, -l1, l1) - lin_out
+    p_out = pre / denom
+    return {'ParamOut': [p_out], 'SquaredAccumOut': [new_sq],
+            'LinearAccumOut': [lin_out]}
+
+
+@register('proximal_gd', no_grad=True, lod='none')
+def _proximal_gd(ctx, ins):
+    p, g = ins['Param'][0], ins['Grad'][0]
+    l1 = ctx.attr('l1', 0.0)
+    l2 = ctx.attr('l2', 0.0)
+    lr = _lr(ins)
+    prox = p - lr * g
+    if l1 > 0:
+        prox = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0))
+    return {'ParamOut': [prox / (1.0 + lr * l2)]}
+
+
+@register('proximal_adagrad', no_grad=True, lod='none')
+def _proximal_adagrad(ctx, ins):
+    p, g, m = ins['Param'][0], ins['Grad'][0], ins['Moment'][0]
+    l1 = ctx.attr('l1', 0.0)
+    l2 = ctx.attr('l2', 0.0)
+    lr = _lr(ins)
+    m_out = m + jnp.square(g)
+    eff_lr = lr / jnp.sqrt(m_out)
+    prox = p - eff_lr * g
+    if l1 > 0:
+        prox = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - eff_lr * l1, 0.0)
+    return {'ParamOut': [prox / (1.0 + eff_lr * l2)], 'MomentOut': [m_out]}
+
+
+@register('average_accumulates', no_grad=True, lod='none')
+def _average_accumulates(ctx, ins):
+    """ModelAverage support (ref: operators/average_accumulates_op.cc)."""
+    param = ins['param'][0]
+    sum1, sum2, sum3 = ins['in_sum_1'][0], ins['in_sum_2'][0], ins['in_sum_3'][0]
+    num_acc = ins['in_num_accumulates'][0]
+    old_num = ins['in_old_num_accumulates'][0]
+    num_upd = ins['in_num_updates'][0]
+    avg_window = ctx.attr('average_window', 0.0)
+    max_avg = ctx.attr('max_average_window', 10000)
+    min_avg = ctx.attr('min_average_window', 10000)
+
+    num_acc = num_acc + 1
+    num_upd = num_upd + 1
+    sum1 = sum1 + param
+    window = jnp.maximum(min_avg, jnp.minimum(
+        max_avg, num_upd.astype(jnp.float32) * avg_window)).astype(num_acc.dtype)
+    do_shift = num_acc >= window
+    new_sum1 = jnp.where(do_shift, jnp.zeros_like(sum1), sum1)
+    new_sum2 = jnp.where(do_shift, sum2 + sum1, sum2)
+    # shift sum2->sum3 when it, too, ages out (simplified single-window shift)
+    new_sum3 = jnp.where(do_shift & (old_num > 0), sum3 + sum2, sum3)
+    new_sum2 = jnp.where(do_shift & (old_num > 0), jnp.zeros_like(sum2), new_sum2)
+    new_old = jnp.where(do_shift, num_acc, old_num)
+    new_num = jnp.where(do_shift, jnp.zeros_like(num_acc), num_acc)
+    return {'out_sum_1': [new_sum1], 'out_sum_2': [new_sum2],
+            'out_sum_3': [new_sum3], 'out_num_accumulates': [new_num],
+            'out_old_num_accumulates': [new_old], 'out_num_updates': [num_upd]}

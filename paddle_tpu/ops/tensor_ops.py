@@ -1,0 +1,601 @@
+"""Tensor creation / manipulation op lowerings
+(ref: operators/reshape_op.cc, transpose_op.cc, concat_op.cc, split_op.cc,
+slice_op.cc, gather_op.cc, fill_constant_op.cc, uniform_random_op.cc, ...).
+Random ops draw from the per-op folded PRNG stream (ctx.rng()) — the
+counter-based TPU-native replacement for the reference's per-device curand
+generators.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+from ..framework import convert_dtype
+from .math_ops import X
+
+
+def _np_dtype(attr_dtype, default='float32'):
+    return jnp.dtype(convert_dtype(attr_dtype) if attr_dtype is not None
+                     else default)
+
+
+# -- creation ---------------------------------------------------------------
+@register('fill_constant', no_grad=True)
+def _fill_constant(ctx, ins):
+    shape = [int(s) for s in ctx.attr('shape', [1])]
+    dt = _np_dtype(ctx.attr('dtype'))
+    return {'Out': [jnp.full(shape, ctx.attr('value', 0.0), dtype=dt)]}
+
+
+@register('fill_constant_batch_size_like', no_grad=True)
+def _fill_constant_bsl(ctx, ins):
+    x = ins['Input'][0]
+    shape = [int(s) for s in ctx.attr('shape')]
+    in_idx = ctx.attr('input_dim_idx', 0)
+    out_idx = ctx.attr('output_dim_idx', 0)
+    shape[out_idx] = x.shape[in_idx]
+    dt = _np_dtype(ctx.attr('dtype'))
+    return {'Out': [jnp.full(shape, ctx.attr('value', 0.0), dtype=dt)]}
+
+
+@register('fill_zeros_like', no_grad=True)
+def _fill_zeros_like(ctx, ins):
+    return {'Out': [jnp.zeros_like(X(ins))]}
+
+
+@register('fill_any_like', no_grad=True)
+def _fill_any_like(ctx, ins):
+    dt = ctx.attr('dtype', None)
+    x = X(ins)
+    dtype = _np_dtype(dt, str(x.dtype)) if dt not in (None, -1) else x.dtype
+    return {'Out': [jnp.full_like(x, ctx.attr('value', 0.0), dtype=dtype)]}
+
+
+@register('assign')
+def _assign(ctx, ins):
+    return {'Out': [X(ins)]}
+
+
+@register('assign_value', no_grad=True)
+def _assign_value(ctx, ins):
+    dt = _np_dtype(ctx.attr('dtype'))
+    shape = ctx.attr('shape')
+    if jnp.issubdtype(dt, jnp.integer):
+        vals = ctx.attr('int32_values') or ctx.attr('int64_values')
+    else:
+        vals = ctx.attr('fp32_values')
+    return {'Out': [jnp.asarray(vals, dtype=dt).reshape(shape)]}
+
+
+@register('shape', no_grad=True)
+def _shape(ctx, ins):
+    x = ins['Input'][0] if 'Input' in ins else X(ins)
+    return {'Out': [jnp.asarray(x.shape, dtype=jnp.int32)]}
+
+
+# -- random -----------------------------------------------------------------
+@register('uniform_random', no_grad=True)
+def _uniform_random(ctx, ins):
+    shape = [int(s) for s in ctx.attr('shape')]
+    dt = _np_dtype(ctx.attr('dtype'))
+    lo, hi = ctx.attr('min', -1.0), ctx.attr('max', 1.0)
+    out = jax.random.uniform(ctx.rng(), shape, dtype=dt, minval=lo, maxval=hi)
+    return {'Out': [out]}
+
+
+@register('uniform_random_batch_size_like', no_grad=True)
+def _uniform_random_bsl(ctx, ins):
+    x = X(ins, 'Input') if 'Input' in ins else X(ins)
+    shape = [int(s) for s in ctx.attr('shape')]
+    shape[ctx.attr('output_dim_idx', 0)] = x.shape[ctx.attr('input_dim_idx', 0)]
+    dt = _np_dtype(ctx.attr('dtype'))
+    out = jax.random.uniform(ctx.rng(), shape, dtype=dt,
+                             minval=ctx.attr('min', -1.0),
+                             maxval=ctx.attr('max', 1.0))
+    return {'Out': [out]}
+
+
+@register('gaussian_random', no_grad=True)
+def _gaussian_random(ctx, ins):
+    shape = [int(s) for s in ctx.attr('shape')]
+    dt = _np_dtype(ctx.attr('dtype'))
+    out = (ctx.attr('mean', 0.0)
+           + ctx.attr('std', 1.0) * jax.random.normal(ctx.rng(), shape, dt))
+    return {'Out': [out]}
+
+
+@register('gaussian_random_batch_size_like', no_grad=True)
+def _gaussian_random_bsl(ctx, ins):
+    x = ins['Input'][0]
+    shape = [int(s) for s in ctx.attr('shape')]
+    shape[ctx.attr('output_dim_idx', 0)] = x.shape[ctx.attr('input_dim_idx', 0)]
+    dt = _np_dtype(ctx.attr('dtype'))
+    out = (ctx.attr('mean', 0.0)
+           + ctx.attr('std', 1.0) * jax.random.normal(ctx.rng(), shape, dt))
+    return {'Out': [out]}
+
+
+@register('truncated_gaussian_random', no_grad=True)
+def _truncated_gaussian_random(ctx, ins):
+    shape = [int(s) for s in ctx.attr('shape')]
+    dt = _np_dtype(ctx.attr('dtype'))
+    out = jax.random.truncated_normal(ctx.rng(), -2.0, 2.0, shape, dt)
+    return {'Out': [ctx.attr('mean', 0.0) + ctx.attr('std', 1.0) * out]}
+
+
+@register('randperm', no_grad=True)
+def _randperm(ctx, ins):
+    n = ctx.attr('n')
+    return {'Out': [jax.random.permutation(ctx.rng(), n).astype(
+        _np_dtype(ctx.attr('dtype'), 'int64'))]}
+
+
+@register('sampling_id', no_grad=True)
+def _sampling_id(ctx, ins):
+    x = X(ins)  # [batch, C] probabilities
+    out = jax.random.categorical(ctx.rng(), jnp.log(jnp.clip(x, 1e-20)), axis=1)
+    return {'Out': [out.astype(jnp.int64)]}
+
+
+@register('random_crop', no_grad=True)
+def _random_crop(ctx, ins):
+    x = X(ins)
+    shape = ctx.attr('shape')  # crop shape, trailing dims
+    ndim = x.ndim
+    crop = list(x.shape[:ndim - len(shape)]) + [int(s) for s in shape]
+    starts = []
+    key = ctx.rng()
+    for i, (xs, cs) in enumerate(zip(x.shape, crop)):
+        key, sub = jax.random.split(key)
+        starts.append(jax.random.randint(sub, (), 0, xs - cs + 1)
+                      if xs > cs else jnp.zeros((), jnp.int32))
+    out = jax.lax.dynamic_slice(x, [s.astype(jnp.int32) for s in starts], crop)
+    return {'Out': [out]}
+
+
+@register('dropout')
+def _dropout(ctx, ins):
+    x = X(ins)
+    p = ctx.attr('dropout_prob', 0.5)
+    impl = ctx.attr('dropout_implementation', 'downgrade_in_infer')
+    if ctx.is_test:
+        out = x if impl == 'upscale_in_train' else x * (1.0 - p)
+        return {'Out': [out], 'Mask': [jnp.ones_like(x)]}
+    keep = jax.random.bernoulli(ctx.rng(), 1.0 - p, x.shape)
+    if impl == 'upscale_in_train':
+        scale = 0.0 if p >= 1.0 else 1.0 / (1.0 - p)
+        out = jnp.where(keep, x * scale, 0.0)
+    else:
+        out = jnp.where(keep, x, 0.0)
+    return {'Out': [out], 'Mask': [keep.astype(x.dtype)]}
+
+
+# -- shape manipulation -----------------------------------------------------
+def _resolve_reshape(x, shape):
+    out = []
+    for i, s in enumerate(shape):
+        if s == 0:
+            out.append(x.shape[i])
+        else:
+            out.append(int(s))
+    return out
+
+
+@register('reshape')
+def _reshape(ctx, ins):
+    x = X(ins)
+    if ins.get('Shape') and ins['Shape'][0] is not None:
+        shape = [int(s) for s in np.asarray(ins['Shape'][0])]
+    else:
+        shape = ctx.attr('shape')
+    return {'Out': [x.reshape(_resolve_reshape(x, shape))]}
+
+
+@register('reshape2')
+def _reshape2(ctx, ins):
+    x = X(ins)
+    if ins.get('Shape') and ins['Shape'][0] is not None:
+        shape = [int(s) for s in np.asarray(ins['Shape'][0])]
+    else:
+        shape = ctx.attr('shape')
+    return {'Out': [x.reshape(_resolve_reshape(x, shape))],
+            'XShape': [jnp.zeros((0,) + x.shape, dtype=x.dtype)]}
+
+
+@register('transpose')
+def _transpose(ctx, ins):
+    return {'Out': [jnp.transpose(X(ins), ctx.attr('axis'))]}
+
+
+@register('transpose2')
+def _transpose2(ctx, ins):
+    x = X(ins)
+    return {'Out': [jnp.transpose(x, ctx.attr('axis'))],
+            'XShape': [jnp.zeros((0,) + x.shape, dtype=x.dtype)]}
+
+
+@register('flatten')
+def _flatten(ctx, ins):
+    x = X(ins)
+    ax = ctx.attr('axis', 1)
+    lead = int(np.prod(x.shape[:ax])) if ax else 1
+    return {'Out': [x.reshape(lead, -1)]}
+
+
+@register('flatten2')
+def _flatten2_op(ctx, ins):
+    x = X(ins)
+    ax = ctx.attr('axis', 1)
+    lead = int(np.prod(x.shape[:ax])) if ax else 1
+    return {'Out': [x.reshape(lead, -1)],
+            'XShape': [jnp.zeros((0,) + x.shape, dtype=x.dtype)]}
+
+
+@register('squeeze')
+def _squeeze(ctx, ins):
+    x = X(ins)
+    axes = ctx.attr('axes', [])
+    if not axes:
+        out = jnp.squeeze(x)
+    else:
+        out = jnp.squeeze(x, axis=tuple(a % x.ndim for a in axes))
+    return {'Out': [out]}
+
+
+@register('squeeze2')
+def _squeeze2(ctx, ins):
+    x = X(ins)
+    axes = ctx.attr('axes', [])
+    out = jnp.squeeze(x) if not axes else jnp.squeeze(
+        x, axis=tuple(a % x.ndim for a in axes))
+    return {'Out': [out], 'XShape': [jnp.zeros((0,) + x.shape, dtype=x.dtype)]}
+
+
+@register('unsqueeze')
+def _unsqueeze(ctx, ins):
+    x = X(ins)
+    for a in sorted(ctx.attr('axes')):
+        x = jnp.expand_dims(x, a)
+    return {'Out': [x]}
+
+
+@register('unsqueeze2')
+def _unsqueeze2(ctx, ins):
+    x0 = X(ins)
+    x = x0
+    for a in sorted(ctx.attr('axes')):
+        x = jnp.expand_dims(x, a)
+    return {'Out': [x], 'XShape': [jnp.zeros((0,) + x0.shape, dtype=x0.dtype)]}
+
+
+@register('concat')
+def _concat(ctx, ins):
+    xs = [x for x in ins['X'] if x is not None]
+    return {'Out': [jnp.concatenate(xs, axis=ctx.attr('axis', 0))]}
+
+
+@register('split')
+def _split(ctx, ins):
+    x = X(ins)
+    axis = ctx.attr('axis', 0)
+    num = ctx.attr('num', 0)
+    sections = ctx.attr('sections', [])
+    if num:
+        outs = jnp.split(x, num, axis=axis)
+    else:
+        idx = np.cumsum(sections)[:-1]
+        outs = jnp.split(x, idx, axis=axis)
+    return {'Out': outs}
+
+
+@register('slice')
+def _slice(ctx, ins):
+    x = ins['Input'][0]
+    axes = ctx.attr('axes')
+    starts = ctx.attr('starts')
+    ends = ctx.attr('ends')
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    return {'Out': [x[tuple(idx)]]}
+
+
+@register('strided_slice')
+def _strided_slice(ctx, ins):
+    x = ins['Input'][0]
+    axes = ctx.attr('axes')
+    starts, ends, strides = ctx.attr('starts'), ctx.attr('ends'), ctx.attr('strides')
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = slice(s, e, st)
+    return {'Out': [x[tuple(idx)]]}
+
+
+@register('crop')
+def _crop(ctx, ins):
+    x = X(ins)
+    shape = ctx.attr('shape')
+    if ins.get('Offsets') and ins['Offsets'][0] is not None:
+        offsets = [int(o) for o in np.asarray(ins['Offsets'][0])]
+    else:
+        offsets = ctx.attr('offsets', [0] * x.ndim)
+    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return {'Out': [x[idx]]}
+
+
+@register('expand')
+def _expand(ctx, ins):
+    x = X(ins)
+    times = ctx.attr('expand_times')
+    return {'Out': [jnp.tile(x, times)]}
+
+
+@register('tile')
+def _tile(ctx, ins):
+    return {'Out': [jnp.tile(X(ins), ctx.attr('repeat_times'))]}
+
+
+@register('stack')
+def _stack(ctx, ins):
+    xs = [x for x in ins['X'] if x is not None]
+    return {'Y': [jnp.stack(xs, axis=ctx.attr('axis', 0))]}
+
+
+@register('unstack')
+def _unstack(ctx, ins):
+    x = X(ins)
+    axis = ctx.attr('axis', 0)
+    num = ctx.attr('num', x.shape[axis])
+    outs = [jnp.squeeze(s, axis=axis)
+            for s in jnp.split(x, num, axis=axis)]
+    return {'Y': outs}
+
+
+@register('gather')
+def _gather(ctx, ins):
+    x = X(ins)
+    idx = ins['Index'][0].reshape(-1).astype(jnp.int32)
+    return {'Out': [jnp.take(x, idx, axis=0)]}
+
+
+@register('gather_nd')
+def _gather_nd(ctx, ins):
+    x = X(ins)
+    idx = ins['Index'][0]
+    return {'Out': [x[tuple(jnp.moveaxis(idx, -1, 0))]]}
+
+
+@register('scatter')
+def _scatter(ctx, ins):
+    x, idx, upd = ins['X'][0], ins['Ids'][0], ins['Updates'][0]
+    idx = idx.reshape(-1).astype(jnp.int32)
+    if ctx.attr('overwrite', True):
+        out = x.at[idx].set(upd)
+    else:
+        out = x.at[idx].set(0.0).at[idx].add(upd)
+    return {'Out': [out]}
+
+
+@register('pad')
+def _pad(ctx, ins):
+    x = X(ins)
+    p = ctx.attr('paddings')
+    pads = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {'Out': [jnp.pad(x, pads, constant_values=ctx.attr('pad_value', 0.0))]}
+
+
+@register('pad2d')
+def _pad2d(ctx, ins):
+    x = X(ins)
+    p = ctx.attr('paddings', [0, 0, 0, 0])
+    mode = ctx.attr('mode', 'constant')
+    fmt = ctx.attr('data_format', 'NCHW')
+    if fmt == 'NCHW':
+        pads = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    else:
+        pads = [(0, 0), (p[0], p[1]), (p[2], p[3]), (0, 0)]
+    modes = {'constant': 'constant', 'reflect': 'reflect', 'edge': 'edge'}
+    kw = {'constant_values': ctx.attr('pad_value', 0.0)} if mode == 'constant' else {}
+    return {'Out': [jnp.pad(x, pads, mode=modes[mode], **kw)]}
+
+
+@register('pad_constant_like')
+def _pad_constant_like(ctx, ins):
+    x, y = ins['X'][0], ins['Y'][0]
+    pads = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    return {'Out': [jnp.pad(y, pads, constant_values=ctx.attr('pad_value', 0.0))]}
+
+
+@register('reverse')
+def _reverse(ctx, ins):
+    axes = ctx.attr('axis')
+    if isinstance(axes, int):
+        axes = [axes]
+    return {'Out': [jnp.flip(X(ins), axis=tuple(axes))]}
+
+
+@register('one_hot', no_grad=True)
+def _one_hot(ctx, ins):
+    x = X(ins)
+    depth = ctx.attr('depth')
+    lab = x.reshape(x.shape[:-1]) if (x.ndim > 1 and x.shape[-1] == 1) else x
+    return {'Out': [jax.nn.one_hot(lab.astype(jnp.int32), depth,
+                                   dtype=jnp.float32)]}
+
+
+@register('cum_sum')
+def _cumsum(ctx, ins):
+    x = X(ins)
+    axis = ctx.attr('axis', -1)
+    if ctx.attr('flatten', False):
+        x = x.reshape(-1)
+        axis = 0
+    out = x
+    if ctx.attr('reverse', False):
+        out = jnp.flip(out, axis)
+    if ctx.attr('exclusive', False):
+        pad = [(0, 0)] * out.ndim
+        pad[axis] = (1, 0)
+        sliced = [slice(None)] * out.ndim
+        sliced[axis] = slice(0, out.shape[axis])
+        out = jnp.pad(out, pad)[tuple(sliced)]
+    out = jnp.cumsum(out, axis=axis)
+    if ctx.attr('reverse', False):
+        out = jnp.flip(out, axis)
+    return {'Out': [out]}
+
+
+@register('top_k')
+def _top_k(ctx, ins):
+    x = X(ins)
+    k = ctx.attr('k', 1)
+    vals, idx = jax.lax.top_k(x, k)
+    return {'Out': [vals], 'Indices': [idx.astype(jnp.int64)]}
+
+
+@register('arg_max', no_grad=True)
+def _arg_max(ctx, ins):
+    return {'Out': [jnp.argmax(X(ins), axis=ctx.attr('axis', -1)).astype(jnp.int64)]}
+
+
+@register('arg_min', no_grad=True)
+def _arg_min(ctx, ins):
+    return {'Out': [jnp.argmin(X(ins), axis=ctx.attr('axis', -1)).astype(jnp.int64)]}
+
+
+@register('argsort')
+def _argsort(ctx, ins):
+    x = X(ins)
+    axis = ctx.attr('axis', -1)
+    idx = jnp.argsort(x, axis=axis)
+    return {'Out': [jnp.sort(x, axis=axis)], 'Indices': [idx.astype(jnp.int64)]}
+
+
+@register('multiplex')
+def _multiplex(ctx, ins):
+    ids = ins['Ids'][0].reshape(-1).astype(jnp.int32)
+    xs = jnp.stack([x for x in ins['X'] if x is not None], axis=0)
+    rows = jnp.arange(ids.shape[0])
+    return {'Out': [xs[ids, rows]]}
+
+
+@register('where', no_grad=True)
+def _where(ctx, ins):
+    cond = ins['Condition'][0]
+    return {'Out': [jnp.stack(jnp.nonzero(cond), axis=-1).astype(jnp.int64)]}
+
+
+@register('maxout')
+def _maxout(ctx, ins):
+    x = X(ins)  # NCHW
+    groups = ctx.attr('groups')
+    n, c, h, w = x.shape
+    out = x.reshape(n, c // groups, groups, h, w).max(axis=2)
+    return {'Out': [out]}
+
+
+@register('space_to_depth')
+def _space_to_depth(ctx, ins):
+    x = X(ins)
+    b = ctx.attr('blocksize')
+    n, c, h, w = x.shape
+    out = x.reshape(n, c, h // b, b, w // b, b)
+    out = out.transpose(0, 3, 5, 1, 2, 4).reshape(n, c * b * b, h // b, w // b)
+    return {'Out': [out]}
+
+
+@register('pixel_shuffle')
+def _pixel_shuffle(ctx, ins):
+    x = X(ins)
+    r = ctx.attr('upscale_factor')
+    n, c, h, w = x.shape
+    out = x.reshape(n, c // (r * r), r, r, h, w)
+    out = out.transpose(0, 1, 4, 2, 5, 3).reshape(n, c // (r * r), h * r, w * r)
+    return {'Out': [out]}
+
+
+@register('shuffle_channel')
+def _shuffle_channel(ctx, ins):
+    x = X(ins)
+    g = ctx.attr('group')
+    n, c, h, w = x.shape
+    out = x.reshape(n, g, c // g, h, w).transpose(0, 2, 1, 3, 4).reshape(x.shape)
+    return {'Out': [out]}
+
+
+@register('add_position_encoding')
+def _add_position_encoding(ctx, ins):
+    x = X(ins)  # [batch, seq, dim] (dense path)
+    alpha = ctx.attr('alpha', 1.0)
+    beta = ctx.attr('beta', 1.0)
+    b, t, d = x.shape
+    half = d // 2
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32) / half)
+    enc = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)], axis=1)
+    return {'Out': [alpha * x + beta * enc[None, :, :].astype(x.dtype)]}
+
+
+@register('hash', no_grad=True)
+def _hash_op(ctx, ins):
+    """Deterministic integer hash bucketing (ref operators/hash_op.cc uses
+    xxhash; behaviorally equivalent bucketing, different hash family)."""
+    x = X(ins).astype(jnp.uint32)
+    num_hash = ctx.attr('num_hash', 1)
+    mod_by = ctx.attr('mod_by')
+    outs = []
+    flat = x.reshape(x.shape[0], -1)
+    for i in range(num_hash):
+        h = flat * jnp.uint32(2654435761) + jnp.uint32(0x9E3779B9 * (i + 1))
+        h = h ^ (h >> 16)
+        h = h * jnp.uint32(0x85EBCA6B)
+        h = h ^ (h >> 13)
+        # combine columns
+        acc = h[:, 0]
+        for c in range(1, h.shape[1]):
+            acc = acc * jnp.uint32(31) + h[:, c]
+        outs.append((acc % jnp.uint32(mod_by)).astype(jnp.int64))
+    return {'Out': [jnp.stack(outs, axis=1)[:, :, None]]}
+
+
+@register('similarity_focus', no_grad=True)
+def _similarity_focus(ctx, ins):
+    x = X(ins)  # [N, C, A, B]
+    axis = ctx.attr('axis')
+    indexes = ctx.attr('indexes')
+    n, c, a, b = x.shape
+    mask = jnp.zeros_like(x)
+    if axis == 1:
+        for idx in indexes:
+            ch = x[:, idx]  # [N, A, B]
+            row_max = (ch == ch.max(axis=2, keepdims=True))
+            col_max = (ch == ch.max(axis=1, keepdims=True))
+            m = (row_max | col_max).astype(x.dtype)[:, None, :, :]
+            mask = jnp.maximum(mask, jnp.broadcast_to(m, x.shape))
+    return {'Out': [mask]}
+
+
+@register('load', no_grad=True)
+def _load_op(ctx, ins):
+    """Load a tensor from disk at trace time (becomes an XLA constant);
+    ref operators/load_op.cc."""
+    from ..io import _deserialize_tensor
+    with open(ctx.attr('file_path'), 'rb') as f:
+        return {'Out': [_deserialize_tensor(f)]}
+
+
+@register('label_smooth')
+def _label_smooth(ctx, ins):
+    x = X(ins)
+    eps = ctx.attr('epsilon', 0.0)
+    if ins.get('PriorDist') and ins['PriorDist'][0] is not None:
+        prior = ins['PriorDist'][0]
+        out = (1.0 - eps) * x + eps * prior
+    else:
+        out = (1.0 - eps) * x + eps / x.shape[-1]
+    return {'Out': [out]}

@@ -1,0 +1,581 @@
+"""Optimizers (ref: python/paddle/fluid/optimizer.py — Optimizer:44,
+12 subclasses, ModelAverage:1468).
+
+`minimize` = append_backward + regularization/clip + optimizer ops, exactly
+the reference pipeline; everything lands in the same program and compiles
+into one XLA step function.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+
+from . import unique_name
+from .backward import append_backward, OP_ROLE_OPTIMIZE
+from .framework import (Variable, Parameter, default_main_program,
+                        default_startup_program, program_guard)
+from .initializer import ConstantInitializer
+from .layer_helper import LayerHelper
+from .clip import append_gradient_clip_ops, error_clip_callback
+from .regularizer import append_regularization_ops
+
+
+class Optimizer(object):
+    def __init__(self, learning_rate, regularization=None, name=None):
+        self.regularization = regularization
+        self._name = name
+        self._learning_rate = learning_rate
+        self._learning_rate_map = {}
+        self._accumulators = defaultdict(dict)
+        self.helper = None
+
+    # -- learning rate -----------------------------------------------------
+    def _create_global_learning_rate(self):
+        program = default_main_program()
+        lr = self._learning_rate_map.get(program)
+        if lr is not None:
+            return
+        if isinstance(self._learning_rate, Variable):
+            self._learning_rate_map[program] = self._learning_rate
+            return
+        helper = LayerHelper('learning_rate')
+        lr_name = unique_name.generate('learning_rate')
+        lr_var = helper.create_global_variable(
+            name=lr_name, shape=[1], dtype='float32', persistable=True)
+        helper.set_variable_initializer(
+            lr_var, ConstantInitializer(float(self._learning_rate)))
+        self._learning_rate_map[program] = lr_var
+
+    def _global_learning_rate(self, program=None):
+        program = program or default_main_program()
+        return self._learning_rate_map.get(program)
+
+    def _create_param_lr(self, param_and_grad):
+        param = param_and_grad[0]
+        param_lr = (param.optimize_attr or {}).get('learning_rate', 1.0)
+        base = self._global_learning_rate()
+        if param_lr == 1.0:
+            return base
+        helper = LayerHelper('param_lr')
+        out = helper.create_variable_for_type_inference('float32')
+        helper.append_op(type='scale', inputs={'X': [base]},
+                         outputs={'Out': [out]},
+                         attrs={'scale': float(param_lr),
+                                'op_role': OP_ROLE_OPTIMIZE})
+        return out
+
+    # -- accumulators ------------------------------------------------------
+    def _add_accumulator(self, name, param, dtype=None, fill_value=0.0,
+                         shape=None):
+        if param.name in self._accumulators[name]:
+            return self._accumulators[name][param.name]
+        helper = LayerHelper(name)
+        shape = shape if shape is not None else list(param.shape)
+        var = helper.create_global_variable(
+            name=unique_name.generate('_'.join([param.name, name])),
+            shape=shape, dtype=dtype or param.dtype, persistable=True)
+        helper.set_variable_initializer(
+            var, ConstantInitializer(float(fill_value)))
+        self._accumulators[name][param.name] = var
+        return var
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _finish_update(self, block, parameters_and_grads):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    # -- the pipeline ------------------------------------------------------
+    def _create_optimization_pass(self, parameters_and_grads, loss,
+                                  startup_program=None):
+        block = loss.block
+        self.helper = LayerHelper(self.__class__.__name__)
+        self._create_global_learning_rate()
+        self._create_accumulators(block,
+                                  [p for p, g in parameters_and_grads
+                                   if g is not None])
+        optimize_ops = []
+        for param_and_grad in parameters_and_grads:
+            if param_and_grad[1] is None:
+                continue
+            if param_and_grad[0].trainable:
+                op = self._append_optimize_op(block, param_and_grad)
+                optimize_ops.append(op)
+        self._finish_update(block, parameters_and_grads)
+        return optimize_ops
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return append_backward(loss, parameter_list, no_grad_set)
+
+    def apply_gradients(self, params_grads):
+        loss = None
+        params_grads = append_gradient_clip_ops(params_grads)
+        params_grads = append_regularization_ops(params_grads,
+                                                 self.regularization)
+        dummy_block = params_grads[0][0].block if params_grads else None
+        # _create_optimization_pass needs a loss var only for its block
+        class _L:  # minimal stand-in
+            block = dummy_block
+        return self._create_optimization_pass(params_grads, _L())
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        params_grads = append_gradient_clip_ops(params_grads)
+        params_grads = append_regularization_ops(params_grads,
+                                                 self.regularization)
+        optimize_ops = self._create_optimization_pass(params_grads, loss,
+                                                      startup_program)
+        return optimize_ops, params_grads
+
+
+class SGDOptimizer(Optimizer):
+    def __init__(self, learning_rate, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "sgd"
+
+    def _append_optimize_op(self, block, param_and_grad):
+        return block.append_op(
+            type=self.type,
+            inputs={"Param": [param_and_grad[0].name],
+                    "Grad": [param_and_grad[1].name],
+                    "LearningRate": [self._create_param_lr(param_and_grad).name]},
+            outputs={"ParamOut": [param_and_grad[0].name]},
+            attrs={'op_role': OP_ROLE_OPTIMIZE}, infer_shape=False)
+
+
+class MomentumOptimizer(Optimizer):
+    _velocity_acc_str = "velocity"
+
+    def __init__(self, learning_rate, momentum, use_nesterov=False,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "momentum"
+        self._momentum = momentum
+        self._use_nesterov = bool(use_nesterov)
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._velocity_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        velocity_acc = self._get_accumulator(self._velocity_acc_str,
+                                             param_and_grad[0])
+        return block.append_op(
+            type=self.type,
+            inputs={"Param": [param_and_grad[0].name],
+                    "Grad": [param_and_grad[1].name],
+                    "Velocity": [velocity_acc.name],
+                    "LearningRate": [self._create_param_lr(param_and_grad).name]},
+            outputs={"ParamOut": [param_and_grad[0].name],
+                     "VelocityOut": [velocity_acc.name]},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov,
+                   'op_role': OP_ROLE_OPTIMIZE}, infer_shape=False)
+
+
+class LarsMomentumOptimizer(Optimizer):
+    _velocity_acc_str = "velocity"
+
+    def __init__(self, learning_rate, momentum, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "lars_momentum"
+        self._momentum = momentum
+        self._lars_coeff = float(lars_coeff)
+        self._lars_weight_decay = float(lars_weight_decay)
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._velocity_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        velocity_acc = self._get_accumulator(self._velocity_acc_str,
+                                             param_and_grad[0])
+        return block.append_op(
+            type=self.type,
+            inputs={"Param": [param_and_grad[0].name],
+                    "Grad": [param_and_grad[1].name],
+                    "Velocity": [velocity_acc.name],
+                    "LearningRate": [self._create_param_lr(param_and_grad).name]},
+            outputs={"ParamOut": [param_and_grad[0].name],
+                     "VelocityOut": [velocity_acc.name]},
+            attrs={"mu": self._momentum, "lars_coeff": self._lars_coeff,
+                   "lars_weight_decay": self._lars_weight_decay,
+                   'op_role': OP_ROLE_OPTIMIZE}, infer_shape=False)
+
+
+class AdagradOptimizer(Optimizer):
+    _moment_acc_str = "moment"
+
+    def __init__(self, learning_rate, epsilon=1.0e-6, regularization=None,
+                 name=None, initial_accumulator_value=0.0):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "adagrad"
+        self._epsilon = epsilon
+        self.initial_accumulator_value = initial_accumulator_value
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment_acc_str, p,
+                                  fill_value=self.initial_accumulator_value)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        moment_acc = self._get_accumulator(self._moment_acc_str,
+                                           param_and_grad[0])
+        return block.append_op(
+            type=self.type,
+            inputs={"Param": [param_and_grad[0].name],
+                    "Grad": [param_and_grad[1].name],
+                    "Moment": [moment_acc.name],
+                    "LearningRate": [self._create_param_lr(param_and_grad).name]},
+            outputs={"ParamOut": [param_and_grad[0].name],
+                     "MomentOut": [moment_acc.name]},
+            attrs={"epsilon": self._epsilon, 'op_role': OP_ROLE_OPTIMIZE},
+            infer_shape=False)
+
+
+class AdamOptimizer(Optimizer):
+    _moment1_acc_str = "moment1"
+    _moment2_acc_str = "moment2"
+    _beta1_pow_acc_str = "beta1_pow_acc"
+    _beta2_pow_acc_str = "beta2_pow_acc"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, regularization=None, name=None,
+                 lazy_mode=False):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "adam"
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment1_acc_str, p)
+            self._add_accumulator(self._moment2_acc_str, p)
+            self._add_accumulator(self._beta1_pow_acc_str, p,
+                                  fill_value=self._beta1, shape=[1])
+            self._add_accumulator(self._beta2_pow_acc_str, p,
+                                  fill_value=self._beta2, shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        moment1 = self._get_accumulator(self._moment1_acc_str, param_and_grad[0])
+        moment2 = self._get_accumulator(self._moment2_acc_str, param_and_grad[0])
+        beta1_pow = self._get_accumulator(self._beta1_pow_acc_str,
+                                          param_and_grad[0])
+        beta2_pow = self._get_accumulator(self._beta2_pow_acc_str,
+                                          param_and_grad[0])
+        return block.append_op(
+            type=self.type,
+            inputs={"Param": [param_and_grad[0].name],
+                    "Grad": [param_and_grad[1].name],
+                    "LearningRate": [self._create_param_lr(param_and_grad).name],
+                    "Moment1": [moment1.name], "Moment2": [moment2.name],
+                    "Beta1Pow": [beta1_pow.name],
+                    "Beta2Pow": [beta2_pow.name]},
+            outputs={"ParamOut": [param_and_grad[0].name],
+                     "Moment1Out": [moment1.name],
+                     "Moment2Out": [moment2.name],
+                     "Beta1PowOut": [beta1_pow.name],
+                     "Beta2PowOut": [beta2_pow.name]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon, 'op_role': OP_ROLE_OPTIMIZE},
+            infer_shape=False)
+
+
+class AdamaxOptimizer(Optimizer):
+    _moment_acc_str = "moment"
+    _inf_norm_acc_str = "inf_norm"
+    _beta1_pow_acc_str = "beta1_pow_acc"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "adamax"
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment_acc_str, p)
+            self._add_accumulator(self._inf_norm_acc_str, p)
+            self._add_accumulator(self._beta1_pow_acc_str, p,
+                                  fill_value=self._beta1, shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        moment = self._get_accumulator(self._moment_acc_str, param_and_grad[0])
+        inf_norm = self._get_accumulator(self._inf_norm_acc_str,
+                                         param_and_grad[0])
+        beta1_pow = self._get_accumulator(self._beta1_pow_acc_str,
+                                          param_and_grad[0])
+        return block.append_op(
+            type=self.type,
+            inputs={"Param": [param_and_grad[0].name],
+                    "Grad": [param_and_grad[1].name],
+                    "LearningRate": [self._create_param_lr(param_and_grad).name],
+                    "Moment": [moment.name], "InfNorm": [inf_norm.name],
+                    "Beta1Pow": [beta1_pow.name]},
+            outputs={"ParamOut": [param_and_grad[0].name],
+                     "MomentOut": [moment.name],
+                     "InfNormOut": [inf_norm.name]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon, 'op_role': OP_ROLE_OPTIMIZE},
+            infer_shape=False)
+
+    def _finish_update(self, block, parameters_and_grads):
+        for param, grad in parameters_and_grads:
+            if grad is None:
+                continue
+            beta1_pow = self._get_accumulator(self._beta1_pow_acc_str, param)
+            block.append_op(
+                type="scale", inputs={"X": [beta1_pow.name]},
+                outputs={"Out": [beta1_pow.name]},
+                attrs={"scale": self._beta1, 'op_role': OP_ROLE_OPTIMIZE},
+                infer_shape=False)
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    _moment_acc_str = "moment"
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1.0e-6,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "decayed_adagrad"
+        self._decay = decay
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        moment_acc = self._get_accumulator(self._moment_acc_str,
+                                           param_and_grad[0])
+        return block.append_op(
+            type=self.type,
+            inputs={"Param": [param_and_grad[0].name],
+                    "Grad": [param_and_grad[1].name],
+                    "Moment": [moment_acc.name],
+                    "LearningRate": [self._create_param_lr(param_and_grad).name]},
+            outputs={"ParamOut": [param_and_grad[0].name],
+                     "MomentOut": [moment_acc.name]},
+            attrs={"epsilon": self._epsilon, "decay": self._decay,
+                   'op_role': OP_ROLE_OPTIMIZE}, infer_shape=False)
+
+
+class AdadeltaOptimizer(Optimizer):
+    _avg_squared_grad_acc_str = "_avg_squared_grad"
+    _avg_squared_update_acc_str = "_avg_squared_update"
+
+    def __init__(self, learning_rate, epsilon=1.0e-6, rho=0.95,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "adadelta"
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._avg_squared_grad_acc_str, p)
+            self._add_accumulator(self._avg_squared_update_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        avg_squared_grad = self._get_accumulator(
+            self._avg_squared_grad_acc_str, param_and_grad[0])
+        avg_squared_update = self._get_accumulator(
+            self._avg_squared_update_acc_str, param_and_grad[0])
+        return block.append_op(
+            type=self.type,
+            inputs={"Param": [param_and_grad[0].name],
+                    "Grad": [param_and_grad[1].name],
+                    "AvgSquaredGrad": [avg_squared_grad.name],
+                    "AvgSquaredUpdate": [avg_squared_update.name]},
+            outputs={"ParamOut": [param_and_grad[0].name],
+                     "AvgSquaredGradOut": [avg_squared_grad.name],
+                     "AvgSquaredUpdateOut": [avg_squared_update.name]},
+            attrs={"epsilon": self._epsilon, "rho": self._rho,
+                   'op_role': OP_ROLE_OPTIMIZE}, infer_shape=False)
+
+
+class RMSPropOptimizer(Optimizer):
+    _momentum_acc_str = "momentum"
+    _mean_square_acc_str = "mean_square"
+    _mean_grad_acc_str = "mean_grad"
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1.0e-6, momentum=0.0,
+                 centered=False, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "rmsprop"
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._momentum_acc_str, p)
+            self._add_accumulator(self._mean_square_acc_str, p)
+            self._add_accumulator(self._mean_grad_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        momentum_acc = self._get_accumulator(self._momentum_acc_str,
+                                             param_and_grad[0])
+        mean_square_acc = self._get_accumulator(self._mean_square_acc_str,
+                                                param_and_grad[0])
+        mean_grad_acc = self._get_accumulator(self._mean_grad_acc_str,
+                                              param_and_grad[0])
+        return block.append_op(
+            type=self.type,
+            inputs={"Param": [param_and_grad[0].name],
+                    "Grad": [param_and_grad[1].name],
+                    "Moment": [momentum_acc.name],
+                    "MeanSquare": [mean_square_acc.name],
+                    "MeanGrad": [mean_grad_acc.name],
+                    "LearningRate": [self._create_param_lr(param_and_grad).name]},
+            outputs={"ParamOut": [param_and_grad[0].name],
+                     "MomentOut": [momentum_acc.name],
+                     "MeanSquareOut": [mean_square_acc.name],
+                     "MeanGradOut": [mean_grad_acc.name]},
+            attrs={"epsilon": self._epsilon, "decay": self._rho,
+                   "momentum": self._momentum, "centered": self._centered,
+                   'op_role': OP_ROLE_OPTIMIZE}, infer_shape=False)
+
+
+class FtrlOptimizer(Optimizer):
+    _squared_acc_str = "squared"
+    _linear_acc_str = "linear"
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "ftrl"
+        self._l1 = l1
+        self._l2 = l2
+        self._lr_power = lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._squared_acc_str, p)
+            self._add_accumulator(self._linear_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        squared_acc = self._get_accumulator(self._squared_acc_str,
+                                            param_and_grad[0])
+        linear_acc = self._get_accumulator(self._linear_acc_str,
+                                           param_and_grad[0])
+        return block.append_op(
+            type=self.type,
+            inputs={"Param": [param_and_grad[0].name],
+                    "Grad": [param_and_grad[1].name],
+                    "SquaredAccumulator": [squared_acc.name],
+                    "LinearAccumulator": [linear_acc.name],
+                    "LearningRate": [self._create_param_lr(param_and_grad).name]},
+            outputs={"ParamOut": [param_and_grad[0].name],
+                     "SquaredAccumOut": [squared_acc.name],
+                     "LinearAccumOut": [linear_acc.name]},
+            attrs={"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power,
+                   'op_role': OP_ROLE_OPTIMIZE}, infer_shape=False)
+
+
+# reference exports short aliases too (optimizer.py bottom)
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adagrad = AdagradOptimizer
+Adam = AdamOptimizer
+Adamax = AdamaxOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+LarsMomentum = LarsMomentumOptimizer
+
+
+class ModelAverage(Optimizer):
+    """Accumulate averaged params (ref optimizer.py:1468). apply()/restore()
+    swap the averaged params in and out of the scope."""
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, regularization=None, name=None):
+        super().__init__(0.0, regularization, name)
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self.params_grads = []
+        self._sum_vars = {}
+        program = default_main_program()
+        for param in program.global_block().all_parameters():
+            if param.do_model_average:
+                self._append_average_accumulate_op(param)
+
+    def _append_average_accumulate_op(self, param):
+        self.helper = LayerHelper("average_accumulate")
+        sum_1 = self._add_accumulator('sum_1', param)
+        sum_2 = self._add_accumulator('sum_2', param)
+        sum_3 = self._add_accumulator('sum_3', param)
+        num_accumulates = self._add_accumulator('num_accumulates', param,
+                                                dtype='int64', shape=[1])
+        old_num_accumulates = self._add_accumulator('old_num_accumulates',
+                                                    param, dtype='int64',
+                                                    shape=[1])
+        num_updates = self._add_accumulator('num_updates', param,
+                                            dtype='int64', shape=[1])
+        self._sum_vars[param.name] = (sum_1, sum_2, sum_3, num_accumulates,
+                                      old_num_accumulates, num_updates)
+        param.block.program.global_block().append_op(
+            type='average_accumulates',
+            inputs={"param": [param.name], "in_sum_1": [sum_1.name],
+                    "in_sum_2": [sum_2.name], "in_sum_3": [sum_3.name],
+                    "in_num_accumulates": [num_accumulates.name],
+                    "in_old_num_accumulates": [old_num_accumulates.name],
+                    "in_num_updates": [num_updates.name]},
+            outputs={"out_sum_1": [sum_1.name], "out_sum_2": [sum_2.name],
+                     "out_sum_3": [sum_3.name],
+                     "out_num_accumulates": [num_accumulates.name],
+                     "out_old_num_accumulates": [old_num_accumulates.name],
+                     "out_num_updates": [num_updates.name]},
+            attrs={"average_window": self.average_window,
+                   "min_average_window": self.min_average_window,
+                   "max_average_window": self.max_average_window,
+                   'op_role': OP_ROLE_OPTIMIZE}, infer_shape=False)
+
+    def apply(self, executor, need_restore=True):
+        """Swap params for their accumulated averages (host-side)."""
+        import numpy as np
+        import contextlib
+        from .core.scope import global_scope
+
+        @contextlib.contextmanager
+        def _ctx():
+            scope = global_scope()
+            self._restore_vals = {}
+            for pname, accs in self._sum_vars.items():
+                s1, s2, s3, na, ona, nu = [scope.get(a.name) for a in accs]
+                n = float(np.asarray(na).sum() + np.asarray(ona).sum())
+                if n == 0:
+                    continue
+                avg = (np.asarray(s1) + np.asarray(s2) + np.asarray(s3)) / n
+                self._restore_vals[pname] = scope.get(pname)
+                import jax.numpy as jnp
+                scope.set(pname, jnp.asarray(avg,
+                                             dtype=self._restore_vals[pname].dtype))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore(executor)
+        return _ctx()
+
+    def restore(self, executor):
+        from .core.scope import global_scope
+        scope = global_scope()
+        for pname, val in getattr(self, '_restore_vals', {}).items():
+            scope.set(pname, val)
+        self._restore_vals = {}

@@ -1,0 +1,88 @@
+"""CompiledProgram / BuildStrategy / ExecutionStrategy
+(ref: python/paddle/fluid/compiler.py:35, framework/details/build_strategy.h:34,
+execution_strategy.h).
+
+The reference's with_data_parallel builds a replicated SSA graph with
+all_reduce op handles per gradient. Here it attaches a device mesh: the SAME
+single program runs under pjit with batch-sharded inputs, and GSPMD inserts
+the gradient all-reduces. BuildStrategy/ExecutionStrategy knobs that steer
+the reference's graph rewriting are accepted for compatibility; the ones
+with TPU meaning (num_trainers → mesh size) are honored, the rest are
+subsumed by XLA (fusion, memory optimize, op ordering).
+"""
+from __future__ import annotations
+
+
+class BuildStrategy(object):
+    class ReduceStrategy(object):
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy(object):
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = \
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.debug_graphviz_path = ""
+        self.enable_sequential_execution = False
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_relu_depthwise_conv = False
+        self.fuse_broadcast_op = False
+        self.memory_optimize = False
+        self.enable_inplace = False
+        self.num_trainers = 1
+        self.trainer_id = 0
+        self.remove_unnecessary_lock = True
+
+
+class ExecutionStrategy(object):
+    def __init__(self):
+        self.num_threads = 0
+        self.allow_op_delay = False
+        self.num_iteration_per_drop_scope = 1
+        self.use_experimental_executor = False
+
+
+class CompiledProgram(object):
+    """Wraps a Program; with_data_parallel attaches a mesh."""
+
+    _ptpu_compiled_program = True
+
+    def __init__(self, program):
+        self._program = program
+        self._mesh = None
+        self._is_data_parallel = False
+        self._loss_name = None
+        self._build_strategy = None
+        self._exec_strategy = None
+        self._places = None
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        self._is_data_parallel = True
+        self._loss_name = loss_name
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._exec_strategy = exec_strategy or ExecutionStrategy()
+        self._places = places
+        return self
+
+    def with_inference_optimize(self, config=None):
+        return self
+
+    def _get_mesh(self, executor):
+        if not self._is_data_parallel:
+            return None
+        if self._mesh is None:
+            from .mesh import make_mesh
+            n = len(self._places) if self._places else None
+            self._mesh = make_mesh(num_devices=n)  # backend via core.config
+        return self._mesh
+
+    # pass-through so Executor internals see the Program surface if needed
+    def __getattr__(self, item):
+        return getattr(self._program, item)

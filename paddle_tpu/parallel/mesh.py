@@ -1,0 +1,55 @@
+"""Device mesh management.
+
+TPU-native replacement for the reference's device bookkeeping
+(NCCLContextMap platform/nccl_helper.h:86, gen_nccl_id rendezvous,
+ParallelExecutor place lists): one jax.sharding.Mesh names the axes
+(dp/tp/pp/sp/ep) and XLA's GSPMD inserts the collectives the reference built
+op handles for (details/all_reduce_op_handle.cc). Multi-host: the same code
+— jax.devices() spans hosts under jax.distributed, collectives ride ICI
+within a slice and DCN across slices; no id exchange needed.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DATA_AXIS = 'dp'
+MODEL_AXIS = 'mp'
+PIPE_AXIS = 'pp'
+SEQ_AXIS = 'sp'
+EXPERT_AXIS = 'ep'
+
+
+def _accel_devices(backend=None):
+    if backend is not None:
+        return jax.devices(backend)
+    from ..core.config import accel_devices
+    return accel_devices()
+
+
+def make_mesh(num_devices=None, axes=None, backend=None):
+    """Build a Mesh. axes: dict axis_name -> size (row-major over devices);
+    default = pure data parallelism over all devices."""
+    devs = _accel_devices(backend)
+    if num_devices is None:
+        num_devices = int(os.environ.get('PTPU_NUM_DEVICES', len(devs)))
+    devs = devs[:num_devices]
+    if axes is None:
+        axes = {DATA_AXIS: len(devs)}
+    names = tuple(axes)
+    shape = tuple(axes.values())
+    assert int(np.prod(shape)) == len(devs), (
+        "mesh axes %r need %d devices, have %d" %
+        (axes, int(np.prod(shape)), len(devs)))
+    return Mesh(np.asarray(devs).reshape(shape), names)
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_sharded(mesh, ndim, axis=DATA_AXIS):
+    return NamedSharding(mesh, PartitionSpec(axis, *([None] * (ndim - 1))))

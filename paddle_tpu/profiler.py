@@ -1,0 +1,62 @@
+"""Profiler surface (ref: python/paddle/fluid/profiler.py).
+
+The reference aggregates per-op host events + CUPTI device spans
+(platform/profiler.cc, device_tracer.cc). TPU-native equivalent: the whole
+step is one XLA program, so per-op host timing is meaningless — we wrap runs
+in jax.profiler traces (viewable in TensorBoard/Perfetto, which subsumes
+tools/timeline.py) and keep the same context-manager API.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+_trace_dir = None
+_events = []
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file, output_mode=None, config=None):
+    yield  # CUDA-specific; no-op on TPU
+
+
+def start_profiler(state='All', tracer_option=None):
+    global _trace_dir
+    import jax
+    _trace_dir = os.environ.get('PTPU_PROFILE_DIR', '/tmp/paddle_tpu_profile')
+    os.makedirs(_trace_dir, exist_ok=True)
+    jax.profiler.start_trace(_trace_dir)
+
+
+def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
+    import jax
+    jax.profiler.stop_trace()
+    print("[paddle_tpu.profiler] trace written to %s "
+          "(open with TensorBoard / Perfetto)" % _trace_dir)
+
+
+def reset_profiler():
+    global _events
+    _events = []
+
+
+@contextlib.contextmanager
+def profiler(state='All', sorted_key=None, profile_path='/tmp/profile',
+             tracer_option=None):
+    start_profiler(state, tracer_option)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def record_event(name):
+    """Host-side RAII event (ref platform::RecordEvent) — annotates the jax
+    profiler trace when active, and records wall time always."""
+    import jax
+    t0 = time.perf_counter()
+    with jax.profiler.TraceAnnotation(name):
+        yield
+    _events.append((name, time.perf_counter() - t0))

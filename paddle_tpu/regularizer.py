@@ -1,0 +1,75 @@
+"""Weight-decay regularizers appended as graph ops
+(ref: python/paddle/fluid/regularizer.py)."""
+from __future__ import annotations
+
+from .framework import Parameter
+from .backward import OP_ROLE_BACKWARD
+
+
+class WeightDecayRegularizer(object):
+    def __call__(self, param, grad, block):
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._regularization_coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        decay = block.create_var(dtype=param.dtype, shape=param.shape)
+        block.append_op(type='scale', inputs={"X": [param.name]},
+                        outputs={"Out": [decay.name]},
+                        attrs={"scale": self._regularization_coeff,
+                               'op_role': OP_ROLE_BACKWARD},
+                        infer_shape=False)
+        return decay
+
+
+class L1DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._regularization_coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        sign = block.create_var(dtype=param.dtype, shape=param.shape)
+        decay = block.create_var(dtype=param.dtype, shape=param.shape)
+        block.append_op(type='sign', inputs={"X": [param.name]},
+                        outputs={"Out": [sign.name]},
+                        attrs={'op_role': OP_ROLE_BACKWARD}, infer_shape=False)
+        block.append_op(type='scale', inputs={"X": [sign.name]},
+                        outputs={"Out": [decay.name]},
+                        attrs={"scale": self._regularization_coeff,
+                               'op_role': OP_ROLE_BACKWARD},
+                        infer_shape=False)
+        return decay
+
+
+def append_regularization_ops(parameters_and_grads, regularization=None):
+    """Add `grad += reg(param)` ops (ref regularizer.py
+    append_regularization_ops)."""
+    params_and_grads = []
+    for param, grad in parameters_and_grads:
+        if grad is None:
+            params_and_grads.append((param, grad))
+            continue
+        regularization_term = None
+        if isinstance(param, Parameter) and param.regularizer is not None:
+            regularization_term = param.regularizer(param, grad, grad.block)
+        elif regularization is not None:
+            regularization_term = regularization(param, grad, grad.block)
+        if regularization_term is None:
+            params_and_grads.append((param, grad))
+            continue
+        block = grad.block
+        new_grad = block.create_var(dtype=grad.dtype, shape=grad.shape,
+                                    name=grad.name + '@REGULARIZED')
+        block.append_op(type='sum',
+                        inputs={"X": [grad.name, regularization_term.name]},
+                        outputs={"Out": [new_grad.name]},
+                        attrs={'op_role': OP_ROLE_BACKWARD}, infer_shape=False)
+        params_and_grads.append((param, new_grad))
+    return params_and_grads
+
+
+# short aliases per reference
+L1Decay = L1DecayRegularizer
+L2Decay = L2DecayRegularizer

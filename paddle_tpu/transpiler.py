@@ -1,0 +1,98 @@
+"""Transpilers (ref: python/paddle/fluid/transpiler/).
+
+DistributeTranspiler (ref distribute_transpiler.py:157) rewrote one program
+into trainer+pserver RPC programs; on TPU a single SPMD program over a mesh
+subsumes both pserver and nccl2 modes (SURVEY §2.4), so the transpiler keeps
+its API but marks the program for mesh execution: get_trainer_program()
+returns the original program (run it under CompiledProgram.with_data_parallel
+or ParallelExecutor and GSPMD provides the gradient reduction the pserver
+did); get_pserver_program() returns an empty no-op program since no separate
+parameter-server process exists.
+
+memory_optimize/release_memory (ref memory_optimization_transpiler.py:491)
+are no-op API shims: XLA's buffer assignment owns memory reuse.
+"""
+from __future__ import annotations
+
+from .framework import Program, default_main_program
+
+
+class DistributeTranspilerConfig(object):
+    slice_var_up = True
+    split_method = None
+    min_block_size = 8192
+    mode = "pserver"
+    print_log = False
+
+
+class DistributeTranspiler(object):
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+        self._transpiled = False
+
+    def transpile(self, trainer_id, program=None, pservers="127.0.0.1:6174",
+                  trainers=1, sync_mode=True, startup_program=None,
+                  current_endpoint="127.0.0.1:6174"):
+        self.trainer_id = trainer_id
+        self.trainer_num = trainers
+        self.sync_mode = sync_mode
+        self.origin_program = program or default_main_program()
+        self.pserver_endpoints = (pservers.split(",")
+                                  if isinstance(pservers, str) else pservers)
+        self._transpiled = True
+
+    def get_trainer_program(self, wait_port=True):
+        assert self._transpiled, "call transpile() first"
+        # the single SPMD program IS the trainer program
+        return self.origin_program
+
+    def get_pserver_program(self, endpoint):
+        assert self._transpiled, "call transpile() first"
+        return Program()  # no separate pserver process on TPU
+
+    def get_pserver_programs(self, endpoint):
+        return self.get_pserver_program(endpoint), Program()
+
+    def get_startup_program(self, endpoint, pserver_program=None,
+                            startup_program=None):
+        return Program()
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=False):
+    """No-op shim: XLA buffer assignment performs liveness-based reuse."""
+    return None
+
+
+def release_memory(input_program, skip_opt_set=None):
+    return None
+
+
+class InferenceTranspiler(object):
+    """BN-fold / conv+bn fuse for inference (ref inference_transpiler.py) —
+    subsumed by XLA fusion; clone(for_test) already freezes BN stats."""
+
+    def transpile(self, program, place, scope=None):
+        return None
+
+
+class HashName(object):
+    def __init__(self, pserver_endpoints):
+        self.pserver_endpoints = pserver_endpoints
+
+    def dispatch(self, varlist):
+        return [self.pserver_endpoints[hash(v.name) % len(self.pserver_endpoints)]
+                for v in varlist]
+
+
+class RoundRobin(object):
+    def __init__(self, pserver_endpoints):
+        self.pserver_endpoints = pserver_endpoints
+        self._i = 0
+
+    def dispatch(self, varlist):
+        out = []
+        for v in varlist:
+            out.append(self.pserver_endpoints[self._i])
+            self._i = (self._i + 1) % len(self.pserver_endpoints)
+        return out

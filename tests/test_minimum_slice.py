@@ -1,0 +1,112 @@
+"""Minimum end-to-end slice (SURVEY §7 phase 2): fc/softmax/cross_entropy/
+mean/sgd + append_backward + Executor feed/fetch + save/load."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def test_forward_fc():
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    y = fluid.layers.fc(input=x, size=3)
+    assert y.shape == (-1, 3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    out, = exe.run(feed={'x': np.ones((2, 4), np.float32)}, fetch_list=[y])
+    assert out.shape == (2, 3)
+
+
+def test_fit_a_line_converges():
+    """Linear regression must drive loss to ~0 (ref tests/book/test_fit_a_line)."""
+    np.random.seed(0)
+    true_w = np.array([[2.0], [-3.4]], np.float32)
+    true_b = 4.2
+
+    x = fluid.layers.data(name='x', shape=[2], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    y_pred = fluid.layers.fc(input=x, size=1)
+    cost = fluid.layers.square_error_cost(input=y_pred, label=y)
+    avg_cost = fluid.layers.mean(cost)
+
+    opt = fluid.optimizer.SGD(learning_rate=0.5)
+    opt.minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    loss = None
+    for i in range(300):
+        xs = np.random.rand(16, 2).astype(np.float32)
+        ys = xs @ true_w + true_b
+        loss, = exe.run(feed={'x': xs, 'y': ys}, fetch_list=[avg_cost])
+    assert loss[()] < 1e-3, "final loss %r" % loss
+
+
+def test_mnist_mlp_learns():
+    """Softmax classifier on a toy separable problem (ref
+    test_recognize_digits mlp)."""
+    np.random.seed(1)
+    img = fluid.layers.data(name='img', shape=[8], dtype='float32')
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    h = fluid.layers.fc(input=img, size=32, act='relu')
+    logits = fluid.layers.fc(input=h, size=4)
+    probs = fluid.layers.softmax(logits)
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=probs, label=label))
+    acc = fluid.layers.accuracy(input=probs, label=label)
+    fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    centers = np.random.randn(4, 8).astype(np.float32) * 3
+    acc_v = None
+    for i in range(150):
+        lab = np.random.randint(0, 4, size=(32, 1))
+        xs = centers[lab[:, 0]] + 0.1 * np.random.randn(32, 8).astype(np.float32)
+        loss_v, acc_v = exe.run(feed={'img': xs.astype(np.float32),
+                                      'label': lab.astype(np.int64)},
+                                fetch_list=[loss, acc])
+    assert acc_v[()] > 0.95, "final acc %r" % acc_v
+
+
+def test_save_load_roundtrip(tmp_path):
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    y = fluid.layers.fc(input=x, size=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xs = np.random.rand(2, 4).astype(np.float32)
+    out1, = exe.run(feed={'x': xs}, fetch_list=[y])
+    fluid.save_persistables(exe, str(tmp_path / 'ckpt'))
+
+    # clobber params, reload, verify identical output
+    scope = fluid.global_scope()
+    import jax.numpy as jnp
+    for p in fluid.default_main_program().all_parameters():
+        scope.set(p.name, jnp.zeros_like(scope.get(p.name)))
+    out_zero, = exe.run(feed={'x': xs}, fetch_list=[y])
+    assert not np.allclose(out1, out_zero)
+    fluid.load_persistables(exe, str(tmp_path / 'ckpt'))
+    out2, = exe.run(feed={'x': xs}, fetch_list=[y])
+    np.testing.assert_allclose(out1, out2, rtol=1e-6)
+
+
+def test_save_load_inference_model(tmp_path):
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    h = fluid.layers.fc(input=x, size=8, act='relu')
+    y = fluid.layers.fc(input=h, size=3, act='softmax')
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xs = np.random.rand(2, 4).astype(np.float32)
+    ref, = exe.run(feed={'x': xs}, fetch_list=[y])
+
+    fluid.save_inference_model(str(tmp_path / 'model'), ['x'], [y], exe)
+
+    scope2 = fluid.core.Scope()
+    with fluid.scope_guard(scope2):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        prog, feeds, fetches = fluid.load_inference_model(
+            str(tmp_path / 'model'), exe2)
+        out, = exe2.run(program=prog, feed={feeds[0]: xs},
+                        fetch_list=fetches)
+    np.testing.assert_allclose(ref, out, rtol=1e-5)
