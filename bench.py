@@ -1,0 +1,76 @@
+"""Benchmark: ResNet-50 training throughput (img/s) on one TPU chip.
+
+Methodology mirrors the reference's benchmark/fluid/fluid_benchmark.py
+(synthetic data, steady-state Images/sec after warmup). Baseline for
+vs_baseline is the only committed reference ResNet-50 training number:
+84.08 img/s (2S Xeon 6148 + MKL-DNN, bs=256 — benchmark/IntelOptimizedPaddle.md:45);
+the K40m/V100 fluid numbers are not committed in-tree (BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+BASELINE_IMG_S = 84.08  # ResNet-50 train, IntelOptimizedPaddle.md:45
+
+
+def main():
+    import paddle_tpu as fluid
+    from models.resnet import build_train_net
+
+    batch = int(os.environ.get('PTPU_BENCH_BATCH', '128'))
+    steps = int(os.environ.get('PTPU_BENCH_STEPS', '30'))
+
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup_p):
+        images, label, loss, acc = build_train_net(
+            dshape=(3, 224, 224), class_dim=1000, depth=50, imagenet=True,
+            lr=0.1)
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup_p)
+
+    # synthetic data staged on device ONCE (reference benchmark's synthetic
+    # mode, benchmark/fluid/args.py --use_reader_op=false path): steady-state
+    # throughput measures the train step, not the PCIe/tunnel transfer
+    import jax
+    import jax.numpy as jnp
+    dev = jax.devices(exe._device.platform)[0] if exe._device else None
+    xs = jax.device_put(
+        jnp.asarray(np.random.randn(batch, 3, 224, 224), jnp.float32), dev)
+    lab = jax.device_put(
+        jnp.asarray(np.random.randint(0, 1000, (batch, 1)), jnp.int32)
+        .astype(jnp.int64) if False else
+        jnp.asarray(np.random.randint(0, 1000, (batch, 1))), dev)
+    feed = {'data': xs, 'label': lab}
+
+    # warmup (compile) + steady steps; async dispatch pipelines the loop,
+    # one sync at the end
+    for _ in range(4):
+        l, = exe.run(program=main_p, feed=feed, fetch_list=[loss],
+                     return_numpy=False)
+    np.asarray(l)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        l, = exe.run(program=main_p, feed=feed, fetch_list=[loss],
+                     return_numpy=False)
+    _ = float(np.asarray(l).reshape(-1)[0])  # sync
+    dt = time.perf_counter() - t0
+
+    img_s = batch * steps / dt
+    print(json.dumps({
+        'metric': 'resnet50_train_img_s_per_chip',
+        'value': round(img_s, 2),
+        'unit': 'img/s',
+        'vs_baseline': round(img_s / BASELINE_IMG_S, 2),
+    }))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,2 @@
+"""Model zoo mirroring the reference's benchmark/fluid/models + book models,
+written against the paddle_tpu layers API."""

@@ -1,12 +1,15 @@
 """LoD (level-of-detail) runtime representation.
 
 The reference's LoDTensor (paddle/fluid/framework/lod_tensor.h:58,110) packs
-variable-length sequences into one dense tensor plus offset tables. TPU-native
-re-design: the dense data is a jax.Array; the offsets ride along as device
-int32 arrays inside a registered pytree (`LoDArray`) so they can flow through
-jit/pjit. Shapes stay static per (batch-size, total-token) signature; callers
-that need shape stability should bucket/pad on the host (see
-layers/io + lod_tensor helpers).
+variable-length sequences into one dense tensor plus offset tables, and its
+kernels read the offsets on the HOST (mixed_vector.h keeps a CPU home for
+the LoD). TPU-native re-design keeps that split: the dense data is a
+jax.Array; the offsets are STATIC host-side tuples carried in the pytree
+structure (aux data). Sequence ops therefore lower to fully static-shape XLA
+programs — the fastest form XLA can compile — and the jit cache keys on the
+lod pattern. Variable-length batches should be bucketed/padded on the host
+(reader decorators provide bucketing) to bound recompiles, exactly as
+TPU input pipelines do.
 """
 from __future__ import annotations
 
@@ -15,25 +18,30 @@ import jax
 import jax.numpy as jnp
 
 
+def _freeze(lod):
+    return tuple(tuple(int(v) for v in np.asarray(l).reshape(-1))
+                 for l in lod)
+
+
 @jax.tree_util.register_pytree_node_class
 class LoDArray(object):
-    """Dense data + per-level row-split offsets (device arrays)."""
+    """Dense device data + static per-level row-split offsets."""
 
     __slots__ = ('data', 'lod')
 
     def __init__(self, data, lod=()):
         self.data = data
-        self.lod = tuple(jnp.asarray(l, dtype=jnp.int32) for l in lod)
+        self.lod = _freeze(lod)
 
-    # -- pytree protocol ---------------------------------------------------
+    # -- pytree protocol: lod is STRUCTURE, not a leaf --------------------
     def tree_flatten(self):
-        return (self.data,) + self.lod, len(self.lod)
+        return (self.data,), self.lod
 
     @classmethod
-    def tree_unflatten(cls, nlod, children):
+    def tree_unflatten(cls, lod, children):
         obj = cls.__new__(cls)
         obj.data = children[0]
-        obj.lod = tuple(children[1:1 + nlod])
+        obj.lod = lod
         return obj
 
     @property
@@ -44,16 +52,27 @@ class LoDArray(object):
     def dtype(self):
         return self.data.dtype
 
+    @property
+    def nseq(self):
+        return len(self.lod[0]) - 1 if self.lod else None
+
+    def offsets(self, level=0):
+        return np.asarray(self.lod[level], dtype=np.int64)
+
+    def lengths(self, level=0):
+        off = self.offsets(level)
+        return off[1:] - off[:-1]
+
     def recursive_sequence_lengths(self):
-        out = []
-        for level in self.lod:
-            l = np.asarray(level)
-            out.append((l[1:] - l[:-1]).tolist())
-        return out
+        return [list(self.lengths(i)) for i in range(len(self.lod))]
+
+    def last_level_offsets(self):
+        return self.offsets(len(self.lod) - 1)
 
     def __repr__(self):
-        return "LoDArray(shape=%s, lod_levels=%d)" % (
-            tuple(self.data.shape), len(self.lod))
+        return "LoDArray(shape=%s, lod=%s)" % (
+            tuple(self.data.shape),
+            [list(l)[:8] for l in self.lod])
 
 
 def unwrap(x):
@@ -66,7 +85,7 @@ def lod_of(x):
 
 def lengths_to_offsets(lengths):
     lengths = np.asarray(lengths, dtype=np.int64)
-    return np.concatenate([[0], np.cumsum(lengths)]).astype(np.int32)
+    return np.concatenate([[0], np.cumsum(lengths)]).astype(np.int64)
 
 
 def create_lod_array(data, recursive_seq_lens=None, lod=None):
@@ -80,9 +99,10 @@ def create_lod_array(data, recursive_seq_lens=None, lod=None):
 
 
 def segment_ids_from_offsets(offsets, total):
-    """offsets: i32[nseq+1] device array; total: static int row count.
-    Returns i32[total] mapping row -> sequence index. The workhorse for
-    lowering sequence_* ops onto XLA segment primitives."""
-    rows = jnp.arange(total, dtype=jnp.int32)
-    # searchsorted(side='right') - 1 gives the segment of each row
-    return jnp.searchsorted(offsets, rows, side='right').astype(jnp.int32) - 1
+    """offsets: static int sequence [nseq+1]; total rows. Returns a host
+    int32[total] mapping row -> sequence index (becomes an XLA constant)."""
+    off = np.asarray(offsets, dtype=np.int64)
+    ids = np.zeros(total, dtype=np.int32)
+    for i in range(len(off) - 1):
+        ids[off[i]:off[i + 1]] = i
+    return jnp.asarray(ids)

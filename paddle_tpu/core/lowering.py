@@ -89,6 +89,9 @@ class Tracer(object):
         self.env = {}
         self.fetches = []
         self.written = set()
+        # static (host) side-channel: e.g. sequence_pad records the per-seq
+        # lengths so sequence_unpad can rebuild a static lod
+        self.static_lengths = {}
 
     def read(self, name, op):
         if name in self.env:

@@ -1,0 +1,20 @@
+"""Datasets (ref: python/paddle/dataset/ — 14 auto-downloading datasets).
+
+Same reader-creator API as the reference (`mnist.train()` returns a reader
+function yielding samples). This environment has no network egress, so each
+dataset loads from PADDLE_TPU_DATA_HOME (~/.cache/paddle_tpu/dataset) when
+the files exist and otherwise serves a deterministic synthetic surrogate
+with the exact sample shapes/dtypes/vocab of the real dataset — enough for
+training-loop, convergence-smoke, and benchmark runs.
+"""
+from . import mnist      # noqa: F401
+from . import cifar      # noqa: F401
+from . import uci_housing  # noqa: F401
+from . import imdb       # noqa: F401
+from . import imikolov   # noqa: F401
+from . import movielens  # noqa: F401
+from . import conll05    # noqa: F401
+from . import wmt14      # noqa: F401
+from . import wmt16      # noqa: F401
+from . import flowers    # noqa: F401
+from . import common     # noqa: F401

@@ -1,0 +1,71 @@
+"""CIFAR-10/100 (ref: python/paddle/dataset/cifar.py)."""
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from . import common
+
+
+def _tar_reader(path, sub_name):
+    def reader():
+        with tarfile.open(path, mode='r') as f:
+            names = [n for n in f.getnames() if sub_name in n]
+            for name in names:
+                batch = pickle.load(f.extractfile(name), encoding='bytes')
+                data = batch[b'data']
+                labels = batch.get(b'labels', batch.get(b'fine_labels'))
+                for sample, label in zip(data, labels):
+                    yield (sample / 255.0 * 2.0 - 1.0).astype(np.float32), \
+                        int(label)
+    return reader
+
+
+def _synthetic_reader(n, num_classes, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        templates = rng.rand(num_classes, 3072).astype(np.float32) * 2 - 1
+        for i in range(n):
+            lab = i % num_classes
+            img = templates[lab] + 0.4 * rng.randn(3072).astype(np.float32)
+            yield np.clip(img, -1, 1), lab
+    return reader
+
+
+def _path(name):
+    return os.path.join(common.DATA_HOME, 'cifar', name)
+
+
+def train10():
+    p = _path('cifar-10-python.tar.gz')
+    if os.path.exists(p):
+        return _tar_reader(p, 'data_batch')
+    return _synthetic_reader(8000, 10, 0)
+
+
+def test10():
+    p = _path('cifar-10-python.tar.gz')
+    if os.path.exists(p):
+        return _tar_reader(p, 'test_batch')
+    return _synthetic_reader(1000, 10, 1)
+
+
+def train100():
+    p = _path('cifar-100-python.tar.gz')
+    if os.path.exists(p):
+        return _tar_reader(p, 'train')
+    return _synthetic_reader(8000, 100, 0)
+
+
+def test100():
+    p = _path('cifar-100-python.tar.gz')
+    if os.path.exists(p):
+        return _tar_reader(p, 'test')
+    return _synthetic_reader(1000, 100, 1)
+
+
+def fetch():
+    pass

@@ -1,0 +1,44 @@
+"""Dataset cache helpers (ref: python/paddle/dataset/common.py)."""
+from __future__ import annotations
+
+import hashlib
+import os
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get('PADDLE_TPU_DATA_HOME', '~/.cache/paddle_tpu/dataset'))
+
+
+def md5file(fname):
+    hash_md5 = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            hash_md5.update(chunk)
+    return hash_md5.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    """No-egress environment: return the cached path if present, else raise
+    with instructions (synthetic surrogates don't call this)."""
+    dirname = os.path.join(DATA_HOME, module_name)
+    filename = os.path.join(dirname,
+                            save_name or url.split('/')[-1])
+    if os.path.exists(filename):
+        return filename
+    raise RuntimeError(
+        "dataset file %s not present and downloads are disabled; place the "
+        "file there or use the synthetic readers" % filename)
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=None):
+    import glob
+
+    def reader():
+        flist = sorted(glob.glob(files_pattern))
+        my = flist[trainer_id::trainer_count]
+        for fn in my:
+            with open(fn, 'rb') as f:
+                if loader:
+                    for item in loader(f):
+                        yield item
+    return reader

@@ -1,0 +1,48 @@
+"""CoNLL-05 SRL (ref: python/paddle/dataset/conll05.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+WORD_DICT_LEN = 44068
+LABEL_DICT_LEN = 59
+PRED_DICT_LEN = 3162
+MARK_DICT_LEN = 2
+
+
+def get_dict():
+    word_dict = {('w%d' % i): i for i in range(WORD_DICT_LEN)}
+    verb_dict = {('v%d' % i): i for i in range(PRED_DICT_LEN)}
+    label_dict = {('l%d' % i): i for i in range(LABEL_DICT_LEN)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    return None
+
+
+def _synthetic(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            length = rng.randint(5, 30)
+            word = rng.randint(0, WORD_DICT_LEN, length).tolist()
+            pred_idx = rng.randint(0, PRED_DICT_LEN)
+            predicate = [pred_idx] * length
+            ctx = [rng.randint(0, WORD_DICT_LEN)] * length
+            mark = (rng.rand(length) < 0.2).astype('int64').tolist()
+            label = rng.randint(0, LABEL_DICT_LEN, length).tolist()
+            # (word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, verb, mark, label)
+            yield (word, ctx, ctx, ctx, ctx, ctx, predicate, mark, label)
+    return reader
+
+
+def test():
+    return _synthetic(500, 1)
+
+
+def train():
+    return _synthetic(4000, 0)
+
+
+def fetch():
+    pass

@@ -1,0 +1,31 @@
+"""102 Flowers (ref: python/paddle/dataset/flowers.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _synthetic(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        templates = rng.rand(102, 3 * 224 * 224).astype(np.float32)
+        for i in range(n):
+            lab = i % 102
+            img = templates[lab] + 0.2 * rng.randn(3 * 224 * 224).astype(np.float32)
+            yield np.clip(img, 0, 1).astype(np.float32), lab
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True):
+    return _synthetic(2000, 0)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True):
+    return _synthetic(200, 1)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    return _synthetic(200, 2)
+
+
+def fetch():
+    pass

@@ -1,0 +1,36 @@
+"""IMDB sentiment (ref: python/paddle/dataset/imdb.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def word_dict():
+    return {('w%d' % i).encode(): i for i in range(5148)}
+
+
+def _synthetic(n, seed, vocab=5148):
+    """Sentiment-like sequences: positive docs draw from low token ids,
+    negative from high ids (learnable by an embedding classifier)."""
+    def reader():
+        rng = np.random.RandomState(seed)
+        for i in range(n):
+            label = i % 2
+            length = rng.randint(8, 60)
+            if label == 0:
+                toks = rng.randint(0, vocab // 2, length)
+            else:
+                toks = rng.randint(vocab // 2, vocab, length)
+            yield toks.tolist(), label
+    return reader
+
+
+def train(word_idx=None):
+    return _synthetic(4000, 0, len(word_idx) if word_idx else 5148)
+
+
+def test(word_idx=None):
+    return _synthetic(500, 1, len(word_idx) if word_idx else 5148)
+
+
+def fetch():
+    pass

@@ -1,0 +1,36 @@
+"""PTB language-model dataset (ref: python/paddle/dataset/imikolov.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+N_GRAM = 5
+
+
+def build_dict(min_word_freq=50):
+    return {('w%d' % i): i for i in range(2074)}
+
+
+def _synthetic(n, seed, vocab, ngram):
+    def reader():
+        rng = np.random.RandomState(seed)
+        # markov-ish chain so n-gram prediction is learnable
+        trans = rng.randint(0, vocab, (vocab,))
+        for i in range(n):
+            start = rng.randint(0, vocab)
+            seq = [start]
+            for _ in range(ngram - 1):
+                seq.append(int((trans[seq[-1]] + rng.randint(0, 3)) % vocab))
+            yield tuple(seq)
+    return reader
+
+
+def train(word_idx, n=N_GRAM, data_type=1):
+    return _synthetic(6000, 0, len(word_idx), n)
+
+
+def test(word_idx, n=N_GRAM, data_type=1):
+    return _synthetic(600, 1, len(word_idx), n)
+
+
+def fetch():
+    pass

@@ -1,0 +1,71 @@
+"""MNIST (ref: python/paddle/dataset/mnist.py). Real files from
+idx-format caches when present; deterministic synthetic digits otherwise."""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from . import common
+
+TRAIN_IMAGE = 'train-images-idx3-ubyte.gz'
+TRAIN_LABEL = 'train-labels-idx1-ubyte.gz'
+TEST_IMAGE = 't10k-images-idx3-ubyte.gz'
+TEST_LABEL = 't10k-labels-idx1-ubyte.gz'
+
+
+def _idx_reader(image_path, label_path, buffer_size=100):
+    def reader():
+        with gzip.open(image_path, 'rb') as imgf, \
+                gzip.open(label_path, 'rb') as labf:
+            imgf.read(16)
+            labf.read(8)
+            while True:
+                buf = imgf.read(784 * buffer_size)
+                if not buf:
+                    break
+                n = len(buf) // 784
+                images = np.frombuffer(buf, np.uint8).reshape(n, 784)
+                images = images.astype(np.float32) / 255.0 * 2.0 - 1.0
+                labels = np.frombuffer(labf.read(n), np.uint8).astype('int64')
+                for i in range(n):
+                    yield images[i, :], int(labels[i])
+    return reader
+
+
+def _synthetic_reader(n, seed):
+    """Deterministic digit-like blobs: each class is a fixed template +
+    noise; linearly separable enough for convergence smoke tests."""
+    def reader():
+        rng = np.random.RandomState(seed)
+        templates = rng.rand(10, 784).astype(np.float32) * 2.0 - 1.0
+        for i in range(n):
+            lab = i % 10
+            img = templates[lab] + 0.3 * rng.randn(784).astype(np.float32)
+            yield np.clip(img, -1.0, 1.0), lab
+    return reader
+
+
+def _paths(image, label):
+    d = os.path.join(common.DATA_HOME, 'mnist')
+    return os.path.join(d, image), os.path.join(d, label)
+
+
+def train():
+    ip, lp = _paths(TRAIN_IMAGE, TRAIN_LABEL)
+    if os.path.exists(ip) and os.path.exists(lp):
+        return _idx_reader(ip, lp)
+    return _synthetic_reader(8000, seed=0)
+
+
+def test():
+    ip, lp = _paths(TEST_IMAGE, TEST_LABEL)
+    if os.path.exists(ip) and os.path.exists(lp):
+        return _idx_reader(ip, lp)
+    return _synthetic_reader(1000, seed=1)
+
+
+def fetch():
+    pass

@@ -1,0 +1,61 @@
+"""MovieLens-1M (ref: python/paddle/dataset/movielens.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+MAX_USER = 6040
+MAX_MOVIE = 3952
+MAX_JOB = 21
+MAX_AGE_GROUP = 7
+
+
+def max_user_id():
+    return MAX_USER
+
+
+def max_movie_id():
+    return MAX_MOVIE
+
+
+def max_job_id():
+    return MAX_JOB - 1
+
+
+def age_table():
+    return [1, 18, 25, 35, 45, 50, 56]
+
+
+def movie_categories():
+    return {('cat%d' % i): i for i in range(18)}
+
+
+def get_movie_title_dict():
+    return {('t%d' % i): i for i in range(5174)}
+
+
+def _synthetic(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            uid = rng.randint(1, MAX_USER + 1)
+            gender = rng.randint(0, 2)
+            age = rng.randint(0, MAX_AGE_GROUP)
+            job = rng.randint(0, MAX_JOB)
+            mid = rng.randint(1, MAX_MOVIE + 1)
+            cat = rng.randint(0, 18, rng.randint(1, 4)).tolist()
+            title = rng.randint(0, 5174, rng.randint(1, 6)).tolist()
+            score = float((uid * 7 + mid * 3) % 5 + 1)
+            yield [uid, gender, age, job, mid, cat, title, score]
+    return reader
+
+
+def train():
+    return _synthetic(6000, 0)
+
+
+def test():
+    return _synthetic(600, 1)
+
+
+def fetch():
+    pass

@@ -1,0 +1,51 @@
+"""UCI housing (ref: python/paddle/dataset/uci_housing.py)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from . import common
+
+feature_names = ['CRIM', 'ZN', 'INDUS', 'CHAS', 'NOX', 'RM', 'AGE', 'DIS',
+                 'RAD', 'TAX', 'PTRATIO', 'B', 'LSTAT']
+
+
+def _load():
+    p = os.path.join(common.DATA_HOME, 'uci_housing', 'housing.data')
+    if os.path.exists(p):
+        data = np.loadtxt(p)
+    else:
+        # synthetic linear data with fixed ground-truth weights
+        rng = np.random.RandomState(42)
+        X = rng.rand(506, 13).astype(np.float32)
+        w = rng.randn(13, 1).astype(np.float32)
+        y = X @ w + 3.0 + 0.01 * rng.randn(506, 1).astype(np.float32)
+        data = np.concatenate([X, y], axis=1)
+    # normalize features like the reference (max/min/avg)
+    maxs = data.max(axis=0)
+    mins = data.min(axis=0)
+    avgs = data.mean(axis=0)
+    for i in range(data.shape[1] - 1):
+        data[:, i] = (data[:, i] - avgs[i]) / (maxs[i] - mins[i] + 1e-9)
+    return data
+
+
+def train():
+    def reader():
+        data = _load()
+        for row in data[:int(len(data) * 0.8)]:
+            yield row[:-1].astype(np.float32), row[-1:].astype(np.float32)
+    return reader
+
+
+def test():
+    def reader():
+        data = _load()
+        for row in data[int(len(data) * 0.8):]:
+            yield row[:-1].astype(np.float32), row[-1:].astype(np.float32)
+    return reader
+
+
+def fetch():
+    pass

@@ -1,0 +1,41 @@
+"""WMT-14 fr-en (ref: python/paddle/dataset/wmt14.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+START = "<s>"
+END = "<e>"
+UNK = "<unk>"
+
+
+def _synthetic(n, seed, dict_size):
+    """Copy-task surrogate: target = permuted source (learnable seq2seq)."""
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            length = rng.randint(4, 12)
+            src = rng.randint(3, dict_size, length).tolist()
+            trg = [(t + 1) % dict_size if t + 1 >= 3 else 3 for t in src]
+            yield src, [0] + trg, trg + [1]
+    return reader
+
+
+def train(dict_size):
+    return _synthetic(4000, 0, dict_size)
+
+
+def test(dict_size):
+    return _synthetic(400, 1, dict_size)
+
+
+def get_dict(dict_size, reverse=False):
+    src_dict = {('w%d' % i): i for i in range(dict_size)}
+    trg_dict = dict(src_dict)
+    if reverse:
+        src_dict = {v: k for k, v in src_dict.items()}
+        trg_dict = {v: k for k, v in trg_dict.items()}
+    return src_dict, trg_dict
+
+
+def fetch():
+    pass

@@ -1,0 +1,25 @@
+"""WMT-16 (ref: python/paddle/dataset/wmt16.py)."""
+from __future__ import annotations
+
+from . import wmt14
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en"):
+    return wmt14.train(min(src_dict_size, trg_dict_size))
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en"):
+    return wmt14.test(min(src_dict_size, trg_dict_size))
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en"):
+    return wmt14.test(min(src_dict_size, trg_dict_size))
+
+
+def get_dict(lang, dict_size, reverse=False):
+    return {('%s_w%d' % (lang, i)): i for i in range(dict_size)} \
+        if not reverse else {i: '%s_w%d' % (lang, i) for i in range(dict_size)}
+
+
+def fetch():
+    pass

@@ -22,6 +22,13 @@ from .core.lowering import Tracer
 from .core.lod import LoDArray, unwrap
 
 
+import contextlib
+
+
+def _nullcontext():
+    return contextlib.nullcontext()
+
+
 def _fetch_name(f):
     if isinstance(f, Variable):
         return f.name
@@ -30,12 +37,24 @@ def _fetch_name(f):
     raise TypeError("fetch_list entries must be Variable or str, got %r" % (f,))
 
 
-def _collect_written(program):
-    names = set()
+_analysis_cache = {}
+
+
+def _program_analysis(program):
+    """(persistable names, persistable∩written) — memoized per build epoch."""
+    key = (id(program), program._build_epoch,
+           sum(len(b.ops) for b in program.blocks))
+    hit = _analysis_cache.get(key)
+    if hit is not None:
+        return hit
+    persist = {v.name for v in program.list_vars() if v.persistable}
+    written = set()
     for b in program.blocks:
         for op in b.ops:
-            names.update(op.output_arg_names())
-    return names
+            written.update(op.output_arg_names())
+    out = (tuple(sorted(persist)), tuple(sorted(persist & written)))
+    _analysis_cache[key] = out
+    return out
 
 
 class Executor(object):
@@ -73,16 +92,24 @@ class Executor(object):
             feed_vals[name] = self._to_device_value(value,
                                                     self._feed_var(program, name))
 
+        # py_reader path: pull a staged batch for data vars not explicitly fed
+        for reader in getattr(program, '_py_readers', []):
+            if not all(n in feed_vals for n in reader.var_names):
+                batch = reader._next_batch()  # raises EOFException at end
+                for n, v in batch.items():
+                    if n not in feed_vals:
+                        feed_vals[n] = self._to_device_value(
+                            v, self._feed_var(program, n))
+
         # persistable state present in scope
-        persist = {v.name for v in program.list_vars() if v.persistable}
+        persist, persist_written = _program_analysis(program)
         state = {}
-        for name in sorted(persist):
+        for name in persist:
             val = scope.get(name)
             if val is not None:
                 state[name] = val
 
-        written = _collect_written(program)
-        out_state_names = tuple(sorted(set(state) | (persist & written)))
+        out_state_names = tuple(sorted(set(state) | set(persist_written)))
 
         mesh_key = (tuple(mesh.shape.items()) if mesh is not None else None)
         key = self._cache_key(program, feed_vals, fetch_names, state,
@@ -97,7 +124,9 @@ class Executor(object):
         step = self._step_counters.get(id(program), 0)
         self._step_counters[id(program)] = step + 1
         seed = program.random_seed or 1234567
-        rng = jax.random.fold_in(jax.random.key(seed), step)
+        with jax.default_device(self._device) if self._device is not None \
+                else _nullcontext():
+            rng = jax.random.fold_in(jax.random.key(seed), step)
 
         fetches, new_state = fn(state, feed_vals, rng)
         for name, val in new_state.items():
@@ -120,12 +149,19 @@ class Executor(object):
     def _to_device_value(self, value, var=None):
         if isinstance(value, LoDArray):
             return value
+        dtype = var.dtype if var is not None and var.dtype else None
+        if isinstance(value, jax.Array):
+            # already on device: never round-trip through the host
+            if dtype:
+                want = jax.dtypes.canonicalize_dtype(np.dtype(dtype))
+                if value.dtype != want:
+                    value = value.astype(want)
+            return value
         # host-side LoDTensor from lod_tensor.py
         lod = getattr(value, 'lod', None)
         data = getattr(value, 'data', value)
         if callable(lod):  # reference-style LoDTensor API
             lod, data = value.lod(), np.asarray(value)
-        dtype = var.dtype if var is not None and var.dtype else None
         arr = jnp.asarray(np.asarray(data), dtype=jnp.dtype(dtype) if dtype else None)
         if self._device is not None:
             arr = jax.device_put(arr, self._device)
@@ -135,8 +171,8 @@ class Executor(object):
 
     def _sig(self, v):
         if isinstance(v, LoDArray):
-            return ('lod', v.data.shape, str(v.data.dtype),
-                    tuple(l.shape for l in v.lod))
+            # lod offsets are static structure: part of the compile key
+            return ('lod', v.data.shape, str(v.data.dtype), v.lod)
         return (tuple(np.shape(v)), str(getattr(v, 'dtype', type(v).__name__)))
 
     def _cache_key(self, program, feed_vals, fetch_names, state, out_names):
@@ -162,29 +198,51 @@ class Executor(object):
             jitted = jax.jit(step, donate_argnums=(0,))
             dev = self._device
 
-            def run_single(state, feed, rng):
-                # scope state may live sharded across a mesh from an earlier
-                # ParallelExecutor run (shared-scope interop, ref
-                # parallel_executor.py/executor.py share global scope):
-                # gather anything multi-device back to this executor's device
-                def _home(v):
-                    arrs = v.data if hasattr(v, 'data') and hasattr(v, 'lod') \
-                        else v
-                    if hasattr(arrs, 'sharding') and \
-                            len(arrs.sharding.device_set) > 1:
-                        return jax.device_put(v, dev or
-                                              list(arrs.sharding.device_set)[0])
+            def _pin(v):
+                # device_put through a remote-tunnel backend is an RPC even
+                # when it's a no-op; skip arrays already committed here
+                data = v.data if isinstance(v, LoDArray) else v
+                s = getattr(data, 'sharding', None)
+                if s is not None and s.device_set == {dev}:
                     return v
-                state = {n: _home(v) for n, v in state.items()}
+                return jax.device_put(v, dev)
+
+            def run_single(state, feed, rng):
+                # Pin every input to this executor's device, COMMITTED —
+                # keeps avals/shardings identical across runs (no silent
+                # pjit recompiles) and gathers state left sharded across a
+                # mesh by an earlier ParallelExecutor run on the same scope.
+                if dev is not None:
+                    state = {n: _pin(v) for n, v in state.items()}
+                    feed = {n: _pin(v) for n, v in feed.items()}
+                    rng = _pin(rng)
+                    with jax.default_device(dev):
+                        return jitted(state, feed, rng)
                 return jitted(state, feed, rng)
             return run_single
 
-        # SPMD: batch-shard the feeds over the data axis, replicate state;
+        # SPMD: batch-shard the feeds over the data axis; state replicated
+        # unless a parameter carries a sharding_spec (TP/EP annotation);
         # GSPMD partitions the program and inserts gradient all-reduces
         # (subsumes ParallelExecutor + nccl2 + pserver-dense, SURVEY §2.4).
+        from jax.sharding import NamedSharding, PartitionSpec
         from .parallel.mesh import replicated, batch_sharded, DATA_AXIS
         rep = replicated(mesh)
         ndp = mesh.shape.get(DATA_AXIS, 1)
+
+        state_shardings = {}
+        for n in state_names:
+            spec = None
+            for b in program.blocks:
+                v = b.vars.get(n)
+                if v is not None and getattr(v, 'sharding_spec', None):
+                    spec = v.sharding_spec
+                    break
+            if spec is not None and all(a is None or a in mesh.shape
+                                        for a in spec):
+                state_shardings[n] = NamedSharding(mesh, PartitionSpec(*spec))
+            else:
+                state_shardings[n] = rep
 
         def feed_spec(name):
             v = feed_vals.get(name)
@@ -202,7 +260,8 @@ class Executor(object):
         def run_with_mesh(state, feed, rng):
             # place inputs on the mesh (resharding no-op when already there);
             # jit compiles to the arg shardings, GSPMD does the rest
-            state = {n: jax.device_put(v, rep) for n, v in state.items()}
+            state = {n: jax.device_put(v, state_shardings.get(n, rep))
+                     for n, v in state.items()}
             feed = {n: jax.device_put(v, feed_specs[n])
                     for n, v in feed.items()}
             rng = jax.device_put(rng, rep)

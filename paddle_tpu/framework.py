@@ -77,6 +77,9 @@ class Variable(object):
         self.initializer = initializer
         self.is_data = is_data
         self.is_parameter = False
+        # optional GSPMD partition spec (tuple of mesh axis names / None per
+        # dim) — set via paddle_tpu.parallel.shard_parameter for TP/EP
+        self.sharding_spec = None
 
     # -- python operator sugar (ref: layers/math_op_patch.py) is installed by
     #    paddle_tpu.layers.math_op_patch at import time.

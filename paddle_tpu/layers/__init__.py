@@ -12,7 +12,16 @@ from .tensor import (create_tensor, create_parameter, create_global_var,  # noqa
                      sums, assign, fill_constant, fill_constant_batch_size_like,
                      ones, zeros, zeros_like, reverse, has_inf, has_nan,
                      isfinite, tensor_array_to_tensor)
-from .io import data, read_file, load  # noqa: F401
+from .io import (data, read_file, load, py_reader,  # noqa: F401
+                 create_py_reader_by_data, double_buffer)
+from .sequence import (sequence_pool, sequence_first_step,  # noqa: F401
+                       sequence_last_step, sequence_softmax, sequence_conv,
+                       sequence_expand, sequence_expand_as, sequence_concat,
+                       sequence_reshape, sequence_reverse, sequence_slice,
+                       sequence_enumerate, sequence_erase, sequence_pad,
+                       sequence_unpad, sequence_mask, sequence_scatter,
+                       lod_reset, im2sequence, row_conv, dynamic_lstm,
+                       dynamic_lstmp, dynamic_gru, gru_unit, lstm_unit, lstm)
 from .control_flow import (increment, less_than, less_equal, greater_than,  # noqa
                            greater_equal, equal, not_equal, is_empty, Print)
 from .metric_op import accuracy, auc  # noqa: F401

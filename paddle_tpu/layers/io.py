@@ -34,6 +34,56 @@ def read_file(reader):
     return reader.read()
 
 
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """Async host→device input queue (ref io.py:633). Creates the data vars
+    and registers the reader on the program; Executor.run pulls a staged
+    batch whenever these vars aren't explicitly fed, raising
+    fluid.core.EOFException at end of data."""
+    from ..reader.pipeline import PyReader
+    from .. import unique_name
+    helper = LayerHelper('py_reader')
+    lod_levels = lod_levels or [0] * len(shapes)
+    feed_vars = []
+    base = name or unique_name.generate('py_reader')
+    for i, (shape, dtype, lod) in enumerate(zip(shapes, dtypes, lod_levels)):
+        v = helper.block.create_var(
+            name='%s_slot_%d' % (base, i), shape=list(shape),
+            dtype=dtype, lod_level=lod, stop_gradient=True, is_data=True)
+        feed_vars.append(v)
+    reader = PyReader(feed_vars, capacity, use_double_buffer)
+    program = default_main_program()
+    if not hasattr(program, '_py_readers'):
+        program._py_readers = []
+    program._py_readers.append(reader)
+    return reader
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    from ..reader.pipeline import PyReader
+    reader = PyReader(list(feed_list), capacity, use_double_buffer)
+    program = default_main_program()
+    if not hasattr(program, '_py_readers'):
+        program._py_readers = []
+    program._py_readers.append(reader)
+    return reader
+
+
+def double_buffer(reader, place=None, name=None):
+    return reader  # staging to device is built into PyReader
+
+
+def batch(reader, batch_size):
+    from ..reader import decorator
+    return decorator.batch(reader, batch_size)
+
+
+def shuffle(reader, buffer_size):
+    from ..reader import decorator
+    return decorator.shuffle(reader, buffer_size)
+
+
 def load(out, file_path, load_as_fp16=None):
     helper = LayerHelper('load')
     helper.append_op(type='load', inputs={}, outputs={'Out': [out]},

@@ -1,0 +1,378 @@
+"""Sequence (LoD) + recurrent layer functions
+(ref: python/paddle/fluid/layers/nn.py — sequence_* family, dynamic_lstm:443,
+dynamic_gru, gru_unit, lstm_unit, warpctc, edit_distance, beam search wrappers).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
+
+
+def _seq_op(op_type, out_slot='Out'):
+    def layer(input, name=None, **attrs):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(input.dtype)
+        out.lod_level = input.lod_level
+        helper.append_op(type=op_type, inputs={'X': input},
+                         outputs={out_slot: out}, attrs=attrs)
+        return out
+    layer.__name__ = op_type
+    return layer
+
+
+def sequence_pool(input, pool_type, is_test=False):
+    helper = LayerHelper('sequence_pool')
+    out = helper.create_variable_for_type_inference(input.dtype)
+    max_index = helper.create_variable_for_type_inference('int32', True)
+    helper.append_op(type='sequence_pool', inputs={'X': input},
+                     outputs={'Out': out, 'MaxIndex': max_index},
+                     attrs={'pooltype': pool_type.upper(),
+                            'is_test': is_test})
+    return out
+
+
+def sequence_first_step(input):
+    return sequence_pool(input, 'first')
+
+
+def sequence_last_step(input):
+    return sequence_pool(input, 'last')
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):
+    helper = LayerHelper('sequence_softmax', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.lod_level = input.lod_level
+    helper.append_op(type='sequence_softmax', inputs={'X': input},
+                     outputs={'Out': out}, attrs={})
+    return out
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=None, bias_attr=None, param_attr=None, act=None,
+                  name=None):
+    helper = LayerHelper('sequence_conv', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    filter_shape = [filter_size * input.shape[1], num_filters]
+    filter_param = helper.create_parameter(attr=helper.param_attr,
+                                           shape=filter_shape, dtype=dtype)
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    pre_bias.lod_level = input.lod_level
+    helper.append_op(
+        type='sequence_conv',
+        inputs={'X': [input], 'Filter': [filter_param]},
+        outputs={'Out': pre_bias},
+        attrs={'contextStride': filter_stride,
+               'contextStart': -int(filter_size // 2),
+               'contextLength': filter_size})
+    pre_act = helper.append_bias_op(pre_bias)
+    return helper.append_activation(pre_act)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    helper = LayerHelper('sequence_expand', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.lod_level = max(x.lod_level, 1)
+    helper.append_op(type='sequence_expand', inputs={'X': x, 'Y': y},
+                     outputs={'Out': out}, attrs={'ref_level': ref_level})
+    return out
+
+
+def sequence_expand_as(x, y, name=None):
+    helper = LayerHelper('sequence_expand_as', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.lod_level = 1
+    helper.append_op(type='sequence_expand_as', inputs={'X': x, 'Y': y},
+                     outputs={'Out': out}, attrs={})
+    return out
+
+
+def sequence_concat(input, name=None):
+    helper = LayerHelper('sequence_concat', name=name)
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    out.lod_level = 1
+    helper.append_op(type='sequence_concat', inputs={'X': input},
+                     outputs={'Out': [out]}, attrs={})
+    return out
+
+
+def sequence_reshape(input, new_dim):
+    helper = LayerHelper('sequence_reshape')
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.lod_level = input.lod_level
+    helper.append_op(type='sequence_reshape', inputs={'X': [input]},
+                     outputs={'Out': [out]}, attrs={'new_dim': new_dim})
+    return out
+
+
+def sequence_reverse(x, name=None):
+    helper = LayerHelper('sequence_reverse', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.lod_level = x.lod_level
+    helper.append_op(type='sequence_reverse', inputs={'X': x},
+                     outputs={'Y': out}, attrs={})
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    helper = LayerHelper('sequence_slice', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.lod_level = input.lod_level
+    helper.append_op(type='sequence_slice',
+                     inputs={'X': input, 'Offset': offset, 'Length': length},
+                     outputs={'Out': out}, attrs={})
+    return out
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    helper = LayerHelper('sequence_enumerate', name=name)
+    out = helper.create_variable_for_type_inference('int64')
+    out.lod_level = input.lod_level
+    helper.append_op(type='sequence_enumerate', inputs={'X': input},
+                     outputs={'Out': out},
+                     attrs={'win_size': win_size, 'pad_value': pad_value})
+    return out
+
+
+def sequence_erase(input, tokens, name=None):
+    helper = LayerHelper('sequence_erase', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.lod_level = input.lod_level
+    helper.append_op(type='sequence_erase', inputs={'X': input},
+                     outputs={'Out': out}, attrs={'tokens': list(tokens)})
+    return out
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    helper = LayerHelper('sequence_pad', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    length = helper.create_variable_for_type_inference('int64', True)
+    helper.append_op(
+        type='sequence_pad',
+        inputs={'X': x, 'PadValue': pad_value},
+        outputs={'Out': out, 'Length': length},
+        attrs={'padded_length': maxlen if maxlen is not None else -1})
+    return out, length
+
+
+def sequence_unpad(x, length, name=None):
+    helper = LayerHelper('sequence_unpad', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.lod_level = 1
+    helper.append_op(type='sequence_unpad',
+                     inputs={'X': x, 'Length': length},
+                     outputs={'Out': out}, attrs={})
+    return out
+
+
+def sequence_mask(x, maxlen=None, dtype='int64', name=None):
+    helper = LayerHelper('sequence_mask', name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='sequence_mask', inputs={'X': [x]},
+                     outputs={'Y': out},
+                     attrs={'maxlen': maxlen if maxlen is not None else -1,
+                            'out_dtype': dtype})
+    return out
+
+
+def sequence_scatter(input, index, updates, name=None):
+    helper = LayerHelper('sequence_scatter', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='sequence_scatter',
+                     inputs={'X': input, 'Ids': index, 'Updates': updates},
+                     outputs={'Out': out}, attrs={})
+    return out
+
+
+def lod_reset(x, y=None, target_lod=None):
+    helper = LayerHelper('lod_reset')
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.lod_level = 1
+    inputs = {'X': x}
+    attrs = {}
+    if y is not None:
+        inputs['Y'] = y
+    elif target_lod is not None:
+        attrs['target_lod'] = list(target_lod)
+    else:
+        raise ValueError("y and target_lod can not be both none")
+    helper.append_op(type='lod_reset', inputs=inputs, outputs={'Out': out},
+                     attrs=attrs)
+    return out
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
+                out_stride=1, name=None):
+    helper = LayerHelper('im2sequence', name=name)
+
+    def _pair(v, n):
+        return list(v) if isinstance(v, (list, tuple)) else [v] * n
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.lod_level = 1
+    padding = _pair(padding, 4) if isinstance(padding, (list, tuple)) and \
+        len(padding) == 4 else _pair(padding, 2) * 2
+    helper.append_op(type='im2sequence', inputs={'X': input},
+                     outputs={'Out': out},
+                     attrs={'kernels': _pair(filter_size, 2),
+                            'strides': _pair(stride, 2),
+                            'paddings': padding})
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    helper = LayerHelper('row_conv', param_attr=param_attr, act=act)
+    dtype = input.dtype
+    filter_shape = [future_context_size + 1, input.shape[1]]
+    filter_param = helper.create_parameter(attr=helper.param_attr,
+                                           shape=filter_shape, dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    out.lod_level = input.lod_level
+    helper.append_op(type='row_conv',
+                     inputs={'X': [input], 'Filter': [filter_param]},
+                     outputs={'Out': [out]}, attrs={})
+    return helper.append_activation(out)
+
+
+# ---------------------------------------------------------------------------
+# recurrent layers
+# ---------------------------------------------------------------------------
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
+                 gate_activation='sigmoid', cell_activation='tanh',
+                 candidate_activation='tanh', dtype='float32', name=None):
+    helper = LayerHelper('lstm', param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    size = size // 4
+    weight = helper.create_parameter(attr=helper.param_attr,
+                                     shape=[size, 4 * size], dtype=dtype)
+    bias_size = [1, 7 * size] if use_peepholes else [1, 4 * size]
+    bias = helper.create_parameter(attr=helper.bias_attr, shape=bias_size,
+                                   dtype=dtype, is_bias=True)
+    hidden = helper.create_variable_for_type_inference(dtype)
+    hidden.lod_level = input.lod_level
+    cell = helper.create_variable_for_type_inference(dtype)
+    cell.lod_level = input.lod_level
+    batch_gate = helper.create_variable_for_type_inference(dtype, True)
+    batch_cell_pre_act = helper.create_variable_for_type_inference(dtype, True)
+    inputs = {'Input': input, 'Weight': weight, 'Bias': bias}
+    if h_0 is not None:
+        inputs['H0'] = h_0
+    if c_0 is not None:
+        inputs['C0'] = c_0
+    helper.append_op(
+        type='lstm', inputs=inputs,
+        outputs={'Hidden': hidden, 'Cell': cell, 'BatchGate': batch_gate,
+                 'BatchCellPreAct': batch_cell_pre_act},
+        attrs={'use_peepholes': use_peepholes, 'is_reverse': is_reverse,
+               'gate_activation': gate_activation,
+               'cell_activation': cell_activation,
+               'candidate_activation': candidate_activation})
+    return hidden, cell
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=True, is_reverse=False,
+                  gate_activation='sigmoid', cell_activation='tanh',
+                  candidate_activation='tanh', proj_activation='tanh',
+                  dtype='float32', name=None):
+    """LSTM with projection: lstm then fc projection of hidden (composite)."""
+    from .nn import fc
+    hidden, cell = dynamic_lstm(
+        input, size, param_attr=param_attr, bias_attr=bias_attr,
+        use_peepholes=use_peepholes, is_reverse=is_reverse,
+        gate_activation=gate_activation, cell_activation=cell_activation,
+        candidate_activation=candidate_activation, dtype=dtype, name=name)
+    proj = fc(input=hidden, size=proj_size, act=proj_activation,
+              bias_attr=False)
+    proj.lod_level = hidden.lod_level
+    return proj, cell
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation='sigmoid',
+                candidate_activation='tanh', h_0=None, origin_mode=False):
+    helper = LayerHelper('gru', param_attr=param_attr, bias_attr=bias_attr)
+    dtype = input.dtype
+    weight = helper.create_parameter(attr=helper.param_attr,
+                                     shape=[size, 3 * size], dtype=dtype)
+    bias = helper.create_parameter(attr=helper.bias_attr, shape=[1, 3 * size],
+                                   dtype=dtype, is_bias=True)
+    hidden = helper.create_variable_for_type_inference(dtype)
+    hidden.lod_level = input.lod_level
+    batch_gate = helper.create_variable_for_type_inference(dtype, True)
+    batch_reset = helper.create_variable_for_type_inference(dtype, True)
+    batch_hidden = helper.create_variable_for_type_inference(dtype, True)
+    inputs = {'Input': input, 'Weight': weight, 'Bias': bias}
+    if h_0 is not None:
+        inputs['H0'] = h_0
+    helper.append_op(
+        type='gru', inputs=inputs,
+        outputs={'Hidden': hidden, 'BatchGate': batch_gate,
+                 'BatchResetHiddenPrev': batch_reset,
+                 'BatchHidden': batch_hidden},
+        attrs={'is_reverse': is_reverse,
+               'gate_activation': gate_activation,
+               'activation': candidate_activation,
+               'origin_mode': origin_mode})
+    return hidden
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation='tanh', gate_activation='sigmoid',
+             origin_mode=False):
+    helper = LayerHelper('gru_unit', param_attr=param_attr,
+                         bias_attr=bias_attr)
+    dtype = input.dtype
+    size = size // 3
+    weight = helper.create_parameter(attr=helper.param_attr,
+                                     shape=[size, 3 * size], dtype=dtype)
+    gate = helper.create_variable_for_type_inference(dtype)
+    reset_hidden_pre = helper.create_variable_for_type_inference(dtype)
+    updated_hidden = helper.create_variable_for_type_inference(dtype)
+    inputs = {'Input': input, 'HiddenPrev': hidden, 'Weight': weight}
+    if helper.bias_attr:
+        bias_size = [1, 3 * size]
+        bias = helper.create_parameter(attr=helper.bias_attr,
+                                       shape=bias_size, dtype=dtype,
+                                       is_bias=True)
+        inputs['Bias'] = bias
+    helper.append_op(type='gru_unit', inputs=inputs,
+                     outputs={'Gate': gate,
+                              'ResetHiddenPrev': reset_hidden_pre,
+                              'Hidden': updated_hidden},
+                     attrs={'activation': activation,
+                            'gate_activation': gate_activation})
+    return updated_hidden, reset_hidden_pre, gate
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    from .nn import fc, concat
+    helper = LayerHelper('lstm_unit', param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    size = cell_t_prev.shape[1]
+    concat_in = concat([x_t, hidden_t_prev], axis=1)
+    fc_out = fc(input=concat_in, size=4 * size, param_attr=param_attr,
+                bias_attr=bias_attr)
+    dtype = x_t.dtype
+    c = helper.create_variable_for_type_inference(dtype)
+    h = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='lstm_unit',
+                     inputs={'X': fc_out, 'C_prev': cell_t_prev},
+                     outputs={'C': c, 'H': h},
+                     attrs={'forget_bias': forget_bias})
+    return h, c
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1):
+    """cudnn_lstm equivalent (ref nn.py lstm): stacked dense LSTM over
+    [batch, seq, dim] via composed dynamic steps — here built on lax.scan
+    through the 'lstm' op after packing."""
+    raise NotImplementedError(
+        "layers.lstm (cudnn packed variant) pending; use dynamic_lstm")

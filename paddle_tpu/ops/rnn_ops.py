@@ -1,0 +1,275 @@
+"""Recurrent op lowerings: dynamic LSTM/GRU over LoD sequences
+(ref: operators/lstm_op.cc, gru_op.cc, gru_unit_op.cc, lstm_unit_op.cc,
+math/detail/lstm_kernel.h:30-42, gru_kernel.h).
+
+The reference re-batches variable-length sequences by time step on the host
+(math/sequence2batch.h) and runs a per-step GEMM. TPU-native: pad to
+[batch, maxlen, ...] from the static lod, run ONE lax.scan over time (the
+whole unrolled loop compiles to a single XLA while-op with MXU GEMMs), mask
+carries at sequence ends, and unpad back to LoD rows. Gate layouts follow the
+reference exactly: LSTM {c, i, f, o} with optional peepholes
+(Bias = {b_c,b_i,b_f,b_o,W_ic,W_fc,W_oc}); GRU {u, r, c} with
+h_t = (1-u)⊙h_{t-1} + u⊙ĉ.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+from ..core.lod import LoDArray, unwrap
+
+
+def _require_lod(x, op_name):
+    if not (isinstance(x, LoDArray) and x.lod):
+        raise TypeError(
+            "%s requires a LoD (variable-length) input — feed it as a "
+            "LoDTensor (fluid.create_lod_tensor) or via DataFeeder with "
+            "lod_level=1; got a dense tensor" % op_name)
+    return x
+
+_ACT = {
+    'sigmoid': jax.nn.sigmoid,
+    'tanh': jnp.tanh,
+    'relu': jax.nn.relu,
+    'identity': lambda x: x,
+}
+
+
+def _pad_from_lod(x, off):
+    """[T, D] + offsets -> ([N, L, D], mask [N, L])."""
+    lens = off[1:] - off[:-1]
+    n, maxlen = len(lens), int(lens.max()) if len(lens) else 0
+    d = x.shape[1:]
+    gather = np.zeros((n, maxlen), dtype=np.int32)
+    mask = np.zeros((n, maxlen), dtype=bool)
+    for i in range(n):
+        l = int(lens[i])
+        gather[i, :l] = np.arange(off[i], off[i] + l)
+        mask[i, :l] = True
+    rows = jnp.take(x, jnp.asarray(gather.reshape(-1)), axis=0)
+    return rows.reshape((n, maxlen) + d), jnp.asarray(mask)
+
+
+def _unpad_to_lod(y, off):
+    lens = off[1:] - off[:-1]
+    maxlen = y.shape[1]
+    idx = []
+    for i in range(len(lens)):
+        idx.extend(range(i * maxlen, i * maxlen + int(lens[i])))
+    flat = y.reshape((-1,) + y.shape[2:])
+    return jnp.take(flat, jnp.asarray(idx, dtype=jnp.int32), axis=0)
+
+
+def _reverse_lod_rows(x, off):
+    idx = np.arange(x.shape[0], dtype=np.int32)
+    for i in range(len(off) - 1):
+        idx[off[i]:off[i + 1]] = idx[off[i]:off[i + 1]][::-1]
+    return jnp.take(x, jnp.asarray(idx), axis=0)
+
+
+@register('lstm', lod='aware')
+def _lstm(ctx, ins):
+    x = _require_lod(ins['Input'][0], 'dynamic_lstm')
+    w = unwrap(ins['Weight'][0])      # [D, 4D] hidden-to-hidden {c,i,f,o}
+    bias = unwrap(ins['Bias'][0]).reshape(-1)
+    use_peepholes = ctx.attr('use_peepholes', True)
+    is_reverse = ctx.attr('is_reverse', False)
+    act_gate = _ACT[ctx.attr('gate_activation', 'sigmoid')]
+    act_cell = _ACT[ctx.attr('cell_activation', 'tanh')]
+    act_cand = _ACT[ctx.attr('candidate_activation', 'tanh')]
+
+    off = np.asarray(x.lod[0], dtype=np.int64)
+    xd = x.data
+    d = w.shape[0]
+    if is_reverse:
+        xd = _reverse_lod_rows(xd, off)
+    xp, mask = _pad_from_lod(xd, off)          # [N, L, 4D], [N, L]
+    n, maxlen = mask.shape
+
+    b = bias[:4 * d]
+    if use_peepholes:
+        w_ic = bias[4 * d:5 * d]
+        w_fc = bias[5 * d:6 * d]
+        w_oc = bias[6 * d:7 * d]
+
+    h0 = (unwrap(ins['H0'][0]) if ins.get('H0') and ins['H0'][0] is not None
+          else jnp.zeros((n, d), xd.dtype))
+    c0 = (unwrap(ins['C0'][0]) if ins.get('C0') and ins['C0'][0] is not None
+          else jnp.zeros((n, d), xd.dtype))
+
+    xs = jnp.swapaxes(xp, 0, 1)      # [L, N, 4D]
+    ms = jnp.swapaxes(mask, 0, 1)    # [L, N]
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        x_t, m_t = inp
+        gates = x_t + h_prev @ w + b
+        g_c, g_i, g_f, g_o = jnp.split(gates, 4, axis=1)
+        cand = act_cand(g_c)
+        if use_peepholes:
+            i = act_gate(g_i + c_prev * w_ic)
+            f = act_gate(g_f + c_prev * w_fc)
+        else:
+            i = act_gate(g_i)
+            f = act_gate(g_f)
+        c = cand * i + c_prev * f
+        if use_peepholes:
+            o = act_gate(g_o + c * w_oc)
+        else:
+            o = act_gate(g_o)
+        h = o * act_cell(c)
+        m = m_t[:, None]
+        h = jnp.where(m, h, h_prev)
+        c = jnp.where(m, c, c_prev)
+        return (h, c), (h, c, jnp.concatenate([cand, i, f, o], axis=1))
+
+    (_, _), (hs, cs, gs) = jax.lax.scan(step, (h0, c0), (xs, ms))
+    hidden = _unpad_to_lod(jnp.swapaxes(hs, 0, 1), off)
+    cell = _unpad_to_lod(jnp.swapaxes(cs, 0, 1), off)
+    gates_out = _unpad_to_lod(jnp.swapaxes(gs, 0, 1), off)
+    if is_reverse:
+        hidden = _reverse_lod_rows(hidden, off)
+        cell = _reverse_lod_rows(cell, off)
+        gates_out = _reverse_lod_rows(gates_out, off)
+    lod = x.lod
+    return {'Hidden': [LoDArray(hidden, lod)],
+            'Cell': [LoDArray(cell, lod)],
+            'BatchGate': [LoDArray(gates_out, lod)],
+            'BatchCellPreAct': [LoDArray(cell, lod)]}
+
+
+@register('gru', lod='aware')
+def _gru(ctx, ins):
+    x = _require_lod(ins['Input'][0], 'dynamic_gru')
+    w = unwrap(ins['Weight'][0])  # [D, 3D]: [:, :2D] = u,r ; [:, 2D:] = c
+    d = w.shape[0]
+    bias = (unwrap(ins['Bias'][0]).reshape(-1)
+            if ins.get('Bias') and ins['Bias'][0] is not None
+            else jnp.zeros((3 * d,), w.dtype))
+    is_reverse = ctx.attr('is_reverse', False)
+    act_gate = _ACT[ctx.attr('gate_activation', 'sigmoid')]
+    act_node = _ACT[ctx.attr('activation', 'tanh')]
+    origin_mode = ctx.attr('origin_mode', False)
+
+    off = np.asarray(x.lod[0], dtype=np.int64)
+    xd = x.data
+    if is_reverse:
+        xd = _reverse_lod_rows(xd, off)
+    xp, mask = _pad_from_lod(xd, off)
+    n, maxlen = mask.shape
+    w_g = w[:, :2 * d]
+    w_c = w[:, 2 * d:]
+
+    h0 = (unwrap(ins['H0'][0]) if ins.get('H0') and ins['H0'][0] is not None
+          else jnp.zeros((n, d), xd.dtype))
+    xs = jnp.swapaxes(xp, 0, 1)
+    ms = jnp.swapaxes(mask, 0, 1)
+
+    def step(h_prev, inp):
+        x_t, m_t = inp
+        xg = x_t[:, :2 * d] + h_prev @ w_g + bias[:2 * d]
+        u = act_gate(xg[:, :d])
+        r = act_gate(xg[:, d:])
+        c = act_node(x_t[:, 2 * d:] + (r * h_prev) @ w_c + bias[2 * d:])
+        if origin_mode:
+            h = u * h_prev + (1.0 - u) * c
+        else:
+            h = (1.0 - u) * h_prev + u * c
+        h = jnp.where(m_t[:, None], h, h_prev)
+        return h, (h, jnp.concatenate([u, r, c], axis=1), r * h_prev)
+
+    _, (hs, gs, rs) = jax.lax.scan(step, h0, (xs, ms))
+    hidden = _unpad_to_lod(jnp.swapaxes(hs, 0, 1), off)
+    gates_out = _unpad_to_lod(jnp.swapaxes(gs, 0, 1), off)
+    resets = _unpad_to_lod(jnp.swapaxes(rs, 0, 1), off)
+    if is_reverse:
+        hidden = _reverse_lod_rows(hidden, off)
+        gates_out = _reverse_lod_rows(gates_out, off)
+        resets = _reverse_lod_rows(resets, off)
+    lod = x.lod
+    return {'Hidden': [LoDArray(hidden, lod)],
+            'BatchGate': [LoDArray(gates_out, lod)],
+            'BatchResetHiddenPrev': [LoDArray(resets, lod)],
+            'BatchHidden': [LoDArray(hidden, lod)]}
+
+
+@register('gru_unit', lod='none')
+def _gru_unit(ctx, ins):
+    x = ins['Input'][0]           # [N, 3D]
+    h_prev = ins['HiddenPrev'][0]
+    w = ins['Weight'][0]          # [D, 3D]
+    d = w.shape[0]
+    bias = (ins['Bias'][0].reshape(-1)
+            if ins.get('Bias') and ins['Bias'][0] is not None else 0.0)
+    act_gate = _ACT[{1: 'sigmoid', 2: 'tanh', 0: 'identity',
+                     3: 'relu'}.get(ctx.attr('gate_activation', 1),
+                                    'sigmoid')] \
+        if isinstance(ctx.attr('gate_activation', 1), int) \
+        else _ACT[ctx.attr('gate_activation')]
+    act_node = _ACT[{1: 'sigmoid', 2: 'tanh', 0: 'identity',
+                     3: 'relu'}.get(ctx.attr('activation', 2), 'tanh')] \
+        if isinstance(ctx.attr('activation', 2), int) \
+        else _ACT[ctx.attr('activation')]
+    # per reference: u, r from first 2D columns; candidate uses r⊙h_prev
+    xu = x[:, :d]
+    xr = x[:, d:2 * d]
+    xc = x[:, 2 * d:]
+    b_u = bias[:d] if not np.isscalar(bias) else 0.0
+    b_r = bias[d:2 * d] if not np.isscalar(bias) else 0.0
+    b_c = bias[2 * d:] if not np.isscalar(bias) else 0.0
+    u = act_gate(xu + h_prev @ w[:, :d] + b_u)
+    r = act_gate(xr + h_prev @ w[:, d:2 * d] + b_r)
+    c = act_node(xc + (r * h_prev) @ w[:, 2 * d:] + b_c)
+    h = (1.0 - u) * h_prev + u * c
+    gate = jnp.concatenate([u, r, c], axis=1)
+    return {'Gate': [gate], 'ResetHiddenPrev': [r * h_prev], 'Hidden': [h]}
+
+
+@register('lstm_unit', lod='none')
+def _lstm_unit(ctx, ins):
+    x = ins['X'][0]       # [N, 4D] projections
+    c_prev = ins['C_prev'][0]
+    forget_bias = ctx.attr('forget_bias', 0.0)
+    d = c_prev.shape[1]
+    g_i = x[:, :d]
+    g_f = x[:, d:2 * d]
+    g_c = x[:, 2 * d:3 * d]
+    g_o = x[:, 3 * d:]
+    i = jax.nn.sigmoid(g_i)
+    f = jax.nn.sigmoid(g_f + forget_bias)
+    c = f * c_prev + i * jnp.tanh(g_c)
+    h = jax.nn.sigmoid(g_o) * jnp.tanh(c)
+    return {'C': [c], 'H': [h]}
+
+
+# compile-time shape inference (LoD-aware; see sequence_ops._install)
+from ..core import registry as _registry
+from .sequence_ops import _set_out
+
+
+def _lstm_infer(op, block):
+    w = block._find_var_recursive(op.inputs['Weight'][0])
+    if w is None or w.shape is None:
+        return
+    d = w.shape[0]
+    _set_out(op, block, 'Hidden', (-1, d))
+    _set_out(op, block, 'Cell', (-1, d))
+    _set_out(op, block, 'BatchGate', (-1, 4 * d))
+    _set_out(op, block, 'BatchCellPreAct', (-1, d))
+
+
+def _gru_infer(op, block):
+    w = block._find_var_recursive(op.inputs['Weight'][0])
+    if w is None or w.shape is None:
+        return
+    d = w.shape[0]
+    _set_out(op, block, 'Hidden', (-1, d))
+    _set_out(op, block, 'BatchGate', (-1, 3 * d))
+    _set_out(op, block, 'BatchResetHiddenPrev', (-1, d))
+    _set_out(op, block, 'BatchHidden', (-1, d))
+
+
+_registry.get('lstm').infer_shape = _lstm_infer
+_registry.get('gru').infer_shape = _gru_infer

@@ -1,0 +1,512 @@
+"""Sequence (LoD) op lowerings (ref: paddle/fluid/operators/sequence_ops/ —
+~20 ops — plus lod_reset_op.cc, im2sequence_op.cc, row_conv_op.cc).
+
+Design (core/lod.py): LoD offsets are STATIC host metadata; every lowering
+here turns them into constant index/segment arrays, so the compiled program
+is pure static-shape XLA — gathers, segment reductions, matmuls. The jit
+cache keys on the lod pattern; host-side bucketing (reader decorators)
+bounds recompiles. This trades the reference's per-batch dynamic kernels
+(e.g. math/sequence2batch.h re-batching) for XLA-optimal static programs.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+from ..core.lod import LoDArray, unwrap, segment_ids_from_offsets
+
+
+def _off(x, level=-1):
+    assert isinstance(x, LoDArray) and x.lod, (
+        "sequence op input must carry LoD (got %r)" % (x,))
+    return np.asarray(x.lod[level], dtype=np.int64)
+
+
+def _seg_ids(x):
+    off = _off(x)
+    return segment_ids_from_offsets(off, x.data.shape[0]), len(off) - 1
+
+
+# ---------------------------------------------------------------------------
+# pooling / softmax — reductions within sequences
+# ---------------------------------------------------------------------------
+@register('sequence_pool', lod='aware')
+def _sequence_pool(ctx, ins):
+    x = ins['X'][0]
+    ptype = ctx.attr('pooltype', 'AVERAGE').upper()
+    data = x.data
+    off = _off(x)
+    n = len(off) - 1
+    seg, _ = _seg_ids(x)
+    lens = jnp.asarray((off[1:] - off[:-1]).astype(np.float32))
+    lens_col = lens.reshape((n,) + (1,) * (data.ndim - 1))
+    if ptype == 'SUM':
+        out = jax.ops.segment_sum(data, seg, num_segments=n)
+    elif ptype == 'AVERAGE':
+        out = jax.ops.segment_sum(data, seg, num_segments=n) / jnp.maximum(
+            lens_col, 1.0)
+    elif ptype == 'SQRT':
+        out = jax.ops.segment_sum(data, seg, num_segments=n) / jnp.sqrt(
+            jnp.maximum(lens_col, 1.0))
+    elif ptype == 'MAX':
+        out = jax.ops.segment_max(data, seg, num_segments=n)
+        idx = jnp.argmax(
+            jnp.where((seg[:, None] == jnp.arange(n)[None, :]).T[..., None]
+                      if data.ndim > 1 else
+                      (seg[None, :] == jnp.arange(n)[:, None]),
+                      data[None], -jnp.inf).reshape(n, data.shape[0], -1),
+            axis=1)
+        return {'Out': [out], 'MaxIndex': [idx.astype(jnp.int32)]}
+    elif ptype == 'LAST':
+        out = jnp.take(data, jnp.asarray(off[1:] - 1), axis=0)
+    elif ptype == 'FIRST':
+        out = jnp.take(data, jnp.asarray(off[:-1]), axis=0)
+    else:
+        raise ValueError("unknown pooltype %r" % ptype)
+    return {'Out': [out]}
+
+
+@register('sequence_softmax', lod='aware')
+def _sequence_softmax(ctx, ins):
+    x = ins['X'][0]
+    data = x.data
+    flat = data.reshape(-1)
+    seg, n = _seg_ids(x)
+    mx = jax.ops.segment_max(flat, seg, num_segments=n)
+    e = jnp.exp(flat - mx[seg])
+    s = jax.ops.segment_sum(e, seg, num_segments=n)
+    out = (e / s[seg]).reshape(data.shape)
+    return {'Out': [LoDArray(out, x.lod)]}
+
+
+# ---------------------------------------------------------------------------
+# expand / concat / reshape / reverse — row-index gathers from static lod
+# ---------------------------------------------------------------------------
+def _expand_index(x_off, y_off):
+    """Row gather index replicating x regions to match y lengths."""
+    idx = []
+    for i in range(len(y_off) - 1):
+        xs, xe = x_off[i], x_off[i + 1]
+        reps = y_off[i + 1] - y_off[i]
+        if xe - xs == 0:
+            continue
+        # reference semantics: repeat x's region `reps` times
+        region = list(range(xs, xe))
+        idx.extend(region * int(reps))
+    return np.asarray(idx, dtype=np.int32)
+
+
+@register('sequence_expand', lod='aware')
+def _sequence_expand(ctx, ins):
+    x, y = ins['X'][0], ins['Y'][0]
+    ref_level = ctx.attr('ref_level', -1)
+    y_lod = y.lod
+    y_off = np.asarray(y_lod[ref_level], dtype=np.int64)
+    xd = unwrap(x)
+    if isinstance(x, LoDArray) and x.lod:
+        x_off = _off(x, 0)
+    else:
+        x_off = np.arange(xd.shape[0] + 1, dtype=np.int64)
+    # out region i = x region i tiled (y_len_i) times
+    idx = []
+    out_lens = []
+    for i in range(len(y_off) - 1):
+        xs, xe = int(x_off[i]), int(x_off[i + 1])
+        reps = int(y_off[i + 1] - y_off[i])
+        region = list(range(xs, xe))
+        idx.extend(region * reps)
+        out_lens.append(len(region) * reps)
+    out = jnp.take(xd, jnp.asarray(idx, dtype=jnp.int32), axis=0)
+    off = np.concatenate([[0], np.cumsum(out_lens)])
+    return {'Out': [LoDArray(out, (off,))]}
+
+
+@register('sequence_expand_as', lod='aware')
+def _sequence_expand_as(ctx, ins):
+    x, y = ins['X'][0], ins['Y'][0]
+    y_off = _off(y, 0)
+    xd = unwrap(x)
+    reps = (y_off[1:] - y_off[:-1]).astype(np.int64)
+    idx = np.repeat(np.arange(xd.shape[0]), reps).astype(np.int32)
+    out = jnp.take(xd, jnp.asarray(idx), axis=0)
+    return {'Out': [LoDArray(out, (y_off,))]}
+
+
+@register('sequence_concat', lod='aware')
+def _sequence_concat(ctx, ins):
+    xs = [x for x in ins['X'] if x is not None]
+    offs = [_off(x, 0) for x in xs]
+    n = len(offs[0]) - 1
+    idx = []
+    out_lens = []
+    bases = np.cumsum([0] + [unwrap(x).shape[0] for x in xs])
+    for i in range(n):
+        total = 0
+        for k, off in enumerate(offs):
+            s, e = int(off[i]), int(off[i + 1])
+            idx.extend(range(bases[k] + s, bases[k] + e))
+            total += e - s
+        out_lens.append(total)
+    big = jnp.concatenate([unwrap(x) for x in xs], axis=0)
+    out = jnp.take(big, jnp.asarray(idx, dtype=jnp.int32), axis=0)
+    off = np.concatenate([[0], np.cumsum(out_lens)])
+    return {'Out': [LoDArray(out, (off,))]}
+
+
+@register('sequence_reshape', lod='aware')
+def _sequence_reshape(ctx, ins):
+    x = ins['X'][0]
+    new_dim = ctx.attr('new_dim')
+    off = _off(x, 0)
+    d = x.data.shape[1]
+    out = x.data.reshape(-1, new_dim)
+    new_off = (off * d) // new_dim
+    return {'Out': [LoDArray(out, (new_off,))]}
+
+
+@register('sequence_reverse', lod='aware')
+def _sequence_reverse(ctx, ins):
+    x = ins['X'][0]
+    off = _off(x)
+    idx = np.arange(unwrap(x).shape[0], dtype=np.int32)
+    for i in range(len(off) - 1):
+        idx[off[i]:off[i + 1]] = idx[off[i]:off[i + 1]][::-1]
+    out = jnp.take(unwrap(x), jnp.asarray(idx), axis=0)
+    return {'Y': [LoDArray(out, x.lod)]}
+
+
+@register('sequence_slice', lod='aware')
+def _sequence_slice(ctx, ins):
+    x = ins['X'][0]
+    offset = np.asarray(unwrap(ins['Offset'][0]))
+    length = np.asarray(unwrap(ins['Length'][0]))
+    # Offset/Length must be trace-time constants (host numpy); the layers API
+    # passes them as fed numpy or assign_value constants.
+    off = _off(x, 0)
+    idx = []
+    lens = []
+    for i in range(len(off) - 1):
+        s = int(off[i] + offset.reshape(-1)[i])
+        l = int(length.reshape(-1)[i])
+        idx.extend(range(s, s + l))
+        lens.append(l)
+    out = jnp.take(unwrap(x), jnp.asarray(idx, dtype=jnp.int32), axis=0)
+    return {'Out': [LoDArray(out, (np.concatenate([[0], np.cumsum(lens)]),))]}
+
+
+@register('sequence_enumerate', lod='aware', no_grad=True)
+def _sequence_enumerate(ctx, ins):
+    x = ins['X'][0]
+    win = ctx.attr('win_size')
+    pad = ctx.attr('pad_value', 0)
+    off = _off(x)
+    t = unwrap(x).shape[0]
+    flat = unwrap(x).reshape(t)
+    gather = np.zeros((t, win), dtype=np.int32)
+    mask = np.zeros((t, win), dtype=bool)
+    for i in range(len(off) - 1):
+        for r in range(off[i], off[i + 1]):
+            for k in range(win):
+                if r + k < off[i + 1]:
+                    gather[r, k] = r + k
+                    mask[r, k] = True
+    out = jnp.where(jnp.asarray(mask), jnp.take(flat, jnp.asarray(gather)),
+                    jnp.asarray(pad, dtype=flat.dtype))
+    return {'Out': [LoDArray(out, x.lod)]}
+
+
+@register('sequence_erase', lod='aware', no_grad=True)
+def _sequence_erase(ctx, ins):
+    x = ins['X'][0]
+    tokens = set(ctx.attr('tokens', []))
+    data = np.asarray(unwrap(x))  # trace-time constant path only
+    off = _off(x)
+    keep = ~np.isin(data.reshape(-1), list(tokens))
+    lens = []
+    for i in range(len(off) - 1):
+        lens.append(int(keep[off[i]:off[i + 1]].sum()))
+    out = jnp.asarray(data.reshape(-1)[keep].reshape(-1, 1))
+    return {'Out': [LoDArray(out, (np.concatenate([[0], np.cumsum(lens)]),))]}
+
+
+# ---------------------------------------------------------------------------
+# pad / unpad / mask — ragged <-> dense bridges
+# ---------------------------------------------------------------------------
+@register('sequence_pad', lod='aware')
+def _sequence_pad(ctx, ins):
+    x = ins['X'][0]
+    pad_value = unwrap(ins['PadValue'][0])
+    padded_len = ctx.attr('padded_length', -1)
+    off = _off(x, 0)
+    lens = off[1:] - off[:-1]
+    n = len(lens)
+    maxlen = int(lens.max()) if padded_len in (-1, None) else int(padded_len)
+    feat = unwrap(x).shape[1:]
+    gather = np.zeros((n, maxlen), dtype=np.int32)
+    mask = np.zeros((n, maxlen), dtype=bool)
+    for i in range(n):
+        l = min(int(lens[i]), maxlen)
+        gather[i, :l] = np.arange(off[i], off[i] + l)
+        mask[i, :l] = True
+    rows = jnp.take(unwrap(x), jnp.asarray(gather.reshape(-1)), axis=0)
+    rows = rows.reshape((n, maxlen) + feat)
+    m = jnp.asarray(mask).reshape((n, maxlen) + (1,) * len(feat))
+    out = jnp.where(m, rows, pad_value.astype(rows.dtype).reshape(
+        (1, 1) + pad_value.shape if pad_value.ndim else (1, 1) + (1,) * len(feat)))
+    ctx.tracer.static_lengths[ctx.op.outputs['Length'][0]] = tuple(
+        int(v) for v in lens)
+    return {'Out': [out], 'Length': [jnp.asarray(lens, dtype=jnp.int64)]}
+
+
+@register('sequence_unpad', lod='aware')
+def _sequence_unpad(ctx, ins):
+    x = unwrap(ins['X'][0])  # [N, L, ...]
+    len_name = ctx.op.inputs['Length'][0]
+    lens = ctx.tracer.static_lengths.get(len_name)
+    if lens is None:
+        lv = ins['Length'][0]
+        lens_np = np.asarray(unwrap(lv))  # works only for constants
+        lens = tuple(int(v) for v in lens_np.reshape(-1))
+    idx = []
+    for i, l in enumerate(lens):
+        idx.extend(range(i * x.shape[1], i * x.shape[1] + int(l)))
+    flat = x.reshape((-1,) + x.shape[2:])
+    out = jnp.take(flat, jnp.asarray(idx, dtype=jnp.int32), axis=0)
+    off = np.concatenate([[0], np.cumsum(lens)])
+    return {'Out': [LoDArray(out, (off,))]}
+
+
+@register('sequence_mask', no_grad=True, lod='none')
+def _sequence_mask(ctx, ins):
+    x = ins['X'][0]  # lengths
+    maxlen = ctx.attr('maxlen', -1)
+    if ins.get('MaxLenTensor') and ins['MaxLenTensor'][0] is not None:
+        maxlen = int(np.asarray(unwrap(ins['MaxLenTensor'][0])))
+    if maxlen in (-1, None):
+        raise ValueError(
+            "sequence_mask needs a static maxlen on TPU (pass maxlen=...)")
+    from ..framework import convert_dtype
+    dt = convert_dtype(ctx.attr('out_dtype', 'int64'))
+    rng = jnp.arange(maxlen, dtype=x.dtype if jnp.issubdtype(
+        x.dtype, jnp.integer) else jnp.int64)
+    out = (rng[None, :] < x.reshape(-1)[:, None]).astype(jnp.dtype(dt))
+    return {'Y': [out.reshape(tuple(x.shape) + (maxlen,))]}
+
+
+@register('lod_reset', lod='aware')
+def _lod_reset(ctx, ins):
+    x = ins['X'][0]
+    data = unwrap(x)
+    if ins.get('Y') and ins['Y'][0] is not None:
+        y = ins['Y'][0]
+        if isinstance(y, LoDArray) and y.lod:
+            return {'Out': [LoDArray(data, y.lod)]}
+        target = np.asarray(unwrap(y)).reshape(-1)
+        return {'Out': [LoDArray(data, (target,))]}
+    target = np.asarray(ctx.attr('target_lod'), dtype=np.int64)
+    return {'Out': [LoDArray(data, (target,))]}
+
+
+# ---------------------------------------------------------------------------
+# sequence_conv / row_conv — context-window convolutions
+# ---------------------------------------------------------------------------
+@register('sequence_conv', lod='aware')
+def _sequence_conv(ctx, ins):
+    x = ins['X'][0]
+    w = unwrap(ins['Filter'][0])  # [ctx_len * D, num_filters]
+    ctx_len = ctx.attr('contextLength')
+    ctx_start = ctx.attr('contextStart', -(ctx_len // 2) if ctx_len else 0)
+    off = _off(x, 0)
+    t, d = unwrap(x).shape
+    gather = np.zeros((t, ctx_len), dtype=np.int32)
+    mask = np.zeros((t, ctx_len), dtype=bool)
+    for i in range(len(off) - 1):
+        for r in range(off[i], off[i + 1]):
+            for k in range(ctx_len):
+                src = r + ctx_start + k
+                if off[i] <= src < off[i + 1]:
+                    gather[r, k] = src
+                    mask[r, k] = True
+    cols = jnp.take(unwrap(x), jnp.asarray(gather.reshape(-1)), axis=0)
+    cols = cols.reshape(t, ctx_len, d)
+    cols = jnp.where(jnp.asarray(mask)[:, :, None], cols, 0.0)
+    out = cols.reshape(t, ctx_len * d) @ w
+    return {'Out': [LoDArray(out, x.lod)]}
+
+
+@register('row_conv', lod='aware')
+def _row_conv(ctx, ins):
+    x = ins['X'][0]
+    w = unwrap(ins['Filter'][0])  # [future_ctx, D]
+    fut = w.shape[0]
+    off = _off(x, 0)
+    t, d = unwrap(x).shape
+    gather = np.zeros((t, fut), dtype=np.int32)
+    mask = np.zeros((t, fut), dtype=bool)
+    for i in range(len(off) - 1):
+        for r in range(off[i], off[i + 1]):
+            for k in range(fut):
+                if r + k < off[i + 1]:
+                    gather[r, k] = r + k
+                    mask[r, k] = True
+    cols = jnp.take(unwrap(x), jnp.asarray(gather.reshape(-1)), axis=0)
+    cols = cols.reshape(t, fut, d)
+    cols = jnp.where(jnp.asarray(mask)[:, :, None], cols, 0.0)
+    out = jnp.einsum('tfd,fd->td', cols, w)
+    return {'Out': [LoDArray(out, x.lod)]}
+
+
+@register('im2sequence')
+def _im2sequence(ctx, ins):
+    x = X = ins['X'][0]  # [N, C, H, W]
+    kernels = ctx.attr('kernels')
+    strides = ctx.attr('strides', [1, 1])
+    paddings = ctx.attr('paddings', [0, 0, 0, 0])
+    n, c, h, w = x.shape
+    kh, kw = kernels
+    ph0, pw0, ph1, pw1 = (paddings + paddings)[:4] if len(paddings) == 2 \
+        else paddings
+    xp = jnp.pad(x, [(0, 0), (0, 0), (ph0, ph1), (pw0, pw1)])
+    oh = (h + ph0 + ph1 - kh) // strides[0] + 1
+    ow = (w + pw0 + pw1 - kw) // strides[1] + 1
+    patches = []
+    for i in range(oh):
+        for j in range(ow):
+            si, sj = i * strides[0], j * strides[1]
+            patches.append(xp[:, :, si:si + kh, sj:sj + kw])
+    stacked = jnp.stack(patches, axis=1)  # [N, oh*ow, C, kh, kw]
+    out = stacked.reshape(n * oh * ow, c * kh * kw)
+    off = np.arange(n + 1, dtype=np.int64) * (oh * ow)
+    return {'Out': [LoDArray(out, (off,))]}
+
+
+@register('sequence_scatter', lod='aware')
+def _sequence_scatter(ctx, ins):
+    x = unwrap(ins['X'][0])
+    ids = ins['Ids'][0]
+    updates = ins['Updates'][0]
+    off = _off(ids, 0)
+    idx_np = np.asarray(unwrap(ids)).reshape(-1)
+    rows = []
+    for i in range(len(off) - 1):
+        rows.extend([i] * int(off[i + 1] - off[i]))
+    out = x.at[(jnp.asarray(np.asarray(rows, np.int32)),
+                jnp.asarray(idx_np.astype(np.int32)))].add(
+        unwrap(updates).reshape(-1))
+    return {'Out': [out]}
+
+
+# ---------------------------------------------------------------------------
+# compile-time shape inference for LoD-aware ops (eval_shape probing can't
+# construct LoDArrays; mirror the reference's InferShape rules instead)
+# ---------------------------------------------------------------------------
+from ..core import registry as _registry
+
+
+def _set_out(op, block, slot, shape, dtype=None):
+    for n in op.outputs.get(slot, []):
+        v = block._find_var_recursive(n)
+        if v is not None:
+            v.shape = tuple(shape)
+            if dtype is not None:
+                v.dtype = dtype
+
+
+def _in_var(op, block, slot='X'):
+    return block._find_var_recursive(op.inputs[slot][0])
+
+
+def _rows_like_infer(*slots_out):
+    def infer(op, block):
+        x = _in_var(op, block)
+        if x is None or x.shape is None:
+            return
+        for slot in slots_out:
+            _set_out(op, block, slot, (-1,) + tuple(x.shape[1:]))
+    return infer
+
+
+def _install():
+    R = _registry.get
+    R('sequence_softmax').infer_shape = _rows_like_infer('Out')
+    R('sequence_reverse').infer_shape = _rows_like_infer('Y')
+    R('sequence_expand').infer_shape = _rows_like_infer('Out')
+    R('sequence_expand_as').infer_shape = _rows_like_infer('Out')
+    R('sequence_slice').infer_shape = _rows_like_infer('Out')
+    R('sequence_erase').infer_shape = _rows_like_infer('Out')
+    R('sequence_scatter').infer_shape = _rows_like_infer('Out')
+
+    def _pool_infer(op, block):
+        x = _in_var(op, block)
+        if x is None or x.shape is None:
+            return
+        _set_out(op, block, 'Out', (-1,) + tuple(x.shape[1:]))
+        _set_out(op, block, 'MaxIndex', (-1,) + tuple(x.shape[1:]), 'int32')
+    R('sequence_pool').infer_shape = _pool_infer
+
+    def _concat_infer(op, block):
+        x = _in_var(op, block)
+        if x is None or x.shape is None:
+            return
+        _set_out(op, block, 'Out', (-1,) + tuple(x.shape[1:]))
+    R('sequence_concat').infer_shape = _concat_infer
+
+    def _reshape_infer(op, block):
+        _set_out(op, block, 'Out', (-1, op.attrs['new_dim']))
+    R('sequence_reshape').infer_shape = _reshape_infer
+
+    def _conv_infer(op, block):
+        f = block._find_var_recursive(op.inputs['Filter'][0])
+        if f is None or f.shape is None:
+            return
+        _set_out(op, block, 'Out', (-1, f.shape[1]))
+    R('sequence_conv').infer_shape = _conv_infer
+    R('row_conv').infer_shape = _rows_like_infer('Out')
+
+    def _pad_infer(op, block):
+        x = _in_var(op, block)
+        if x is None or x.shape is None:
+            return
+        plen = op.attrs.get('padded_length', -1)
+        _set_out(op, block, 'Out',
+                 (-1, plen if plen and plen > 0 else -1) + tuple(x.shape[1:]))
+        _set_out(op, block, 'Length', (-1,), 'int64')
+    R('sequence_pad').infer_shape = _pad_infer
+
+    def _unpad_infer(op, block):
+        x = _in_var(op, block)
+        if x is None or x.shape is None:
+            return
+        _set_out(op, block, 'Out', (-1,) + tuple(x.shape[2:]))
+    R('sequence_unpad').infer_shape = _unpad_infer
+
+    def _enum_infer(op, block):
+        _set_out(op, block, 'Out', (-1, op.attrs['win_size']), 'int64')
+    R('sequence_enumerate').infer_shape = _enum_infer
+
+    def _mask_infer(op, block):
+        x = _in_var(op, block)
+        maxlen = op.attrs.get('maxlen', -1)
+        shape = tuple(x.shape) if x is not None and x.shape else (-1,)
+        _set_out(op, block, 'Y', shape + (maxlen if maxlen > 0 else -1,),
+                 op.attrs.get('out_dtype', 'int64'))
+    R('sequence_mask').infer_shape = _mask_infer
+
+    def _lod_reset_infer(op, block):
+        x = _in_var(op, block)
+        if x is not None and x.shape is not None:
+            _set_out(op, block, 'Out', x.shape)
+    R('lod_reset').infer_shape = _lod_reset_infer
+
+    def _im2seq_infer(op, block):
+        x = _in_var(op, block)
+        if x is None or x.shape is None:
+            return
+        kh, kw = op.attrs['kernels']
+        _set_out(op, block, 'Out', (-1, x.shape[1] * kh * kw))
+    R('im2sequence').infer_shape = _im2seq_infer
+
+
+_install()

@@ -1,0 +1,33 @@
+"""User-facing sharding annotations (TPU-native extension).
+
+The reference's tensor-model-parallelism story was layer-device placement in
+the legacy stack (ParallelNeuralNetwork.h:34) and sharded embedding tables on
+pservers (distribute_transpiler.py:1012). Here both collapse into GSPMD
+partition specs on parameters: annotate, and XLA partitions the matmuls and
+inserts the collectives (all-gather/reduce-scatter over ICI).
+"""
+from __future__ import annotations
+
+from ..framework import Variable
+from .mesh import MODEL_AXIS, EXPERT_AXIS
+
+
+def shard_parameter(param, spec):
+    """Attach a partition spec to a parameter.
+
+    spec: tuple with one entry per tensor dim — a mesh axis name to shard
+    that dim over, or None to replicate it. e.g. for an fc weight [in, out]:
+    shard_parameter(w, (None, 'mp')) = column-parallel (Megatron-style).
+    """
+    assert isinstance(param, Variable)
+    param.sharding_spec = tuple(spec)
+    return param
+
+
+def shard_embedding(param, axis=0, mesh_axis=EXPERT_AXIS):
+    """Shard an embedding table over a mesh axis (row-sharded vocab) — the
+    dist-lookup-table capability (SURVEY §2.3): XLA turns the gathers into
+    all-to-all traffic on the mesh."""
+    spec = [None] * len(param.shape)
+    spec[axis] = mesh_axis
+    return shard_parameter(param, spec)

@@ -63,12 +63,14 @@ class CompiledProgram(object):
 
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, share_vars_from=None,
-                           places=None):
+                           places=None, mesh=None):
         self._is_data_parallel = True
         self._loss_name = loss_name
         self._build_strategy = build_strategy or BuildStrategy()
         self._exec_strategy = exec_strategy or ExecutionStrategy()
         self._places = places
+        if mesh is not None:
+            self._mesh = mesh  # explicit multi-axis mesh (dp/mp/pp/...)
         return self
 
     def with_inference_optimize(self, config=None):

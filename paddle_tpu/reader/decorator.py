@@ -1,0 +1,235 @@
+"""Reader decorators (ref: python/paddle/reader/decorator.py).
+
+A reader is a function returning an iterable of samples; decorators compose
+them. TPU addition: `bucket_by_length` groups variable-length samples into
+a small set of padded length buckets so LoD batches hit a bounded number of
+XLA compilations (see core/lod.py design note).
+"""
+from __future__ import annotations
+
+import itertools
+import random
+import threading
+import queue as _queue
+
+__all__ = ['map_readers', 'buffered', 'compose', 'chain', 'shuffle',
+           'firstn', 'xmap_readers', 'multiprocess_reader', 'cache',
+           'batch', 'bucket_by_length', 'Fake', 'ComposeNotAligned']
+
+
+def map_readers(func, *readers):
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+    return reader
+
+
+def shuffle(reader, buf_size):
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if len(buf) > 0:
+            random.shuffle(buf)
+            for b in buf:
+                yield b
+    return data_reader
+
+
+def chain(*readers):
+    def reader():
+        rs = [r() for r in readers]
+        for e in itertools.chain(*rs):
+            yield e
+    return reader
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, **kwargs):
+    check_alignment = kwargs.pop('check_alignment', True)
+
+    def make_tuple(x):
+        if isinstance(x, tuple):
+            return x
+        return (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum(list(map(make_tuple, outputs)), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                for o in outputs:
+                    if o is None:
+                        raise ComposeNotAligned(
+                            "outputs of readers are not aligned.")
+                yield sum(list(map(make_tuple, outputs)), ())
+    return reader
+
+
+def buffered(reader, size):
+    class EndSignal:
+        pass
+    end = EndSignal()
+
+    def read_worker(r, q):
+        for d in r:
+            q.put(d)
+        q.put(end)
+
+    def data_reader():
+        r = reader()
+        q = _queue.Queue(maxsize=size)
+        t = threading.Thread(target=read_worker, args=(r, q))
+        t.daemon = True
+        t.start()
+        e = q.get()
+        while e is not end:
+            yield e
+            e = q.get()
+    return data_reader
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over a reader with worker threads."""
+    end = object()
+
+    def data_reader():
+        in_q = _queue.Queue(buffer_size)
+        out_q = _queue.Queue(buffer_size)
+        flags = {'ended': 0}
+        lock = threading.Lock()
+
+        def feed():
+            for i, sample in enumerate(reader()):
+                in_q.put((i, sample))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    with lock:
+                        flags['ended'] += 1
+                        if flags['ended'] == process_num:
+                            out_q.put(end)
+                    return
+                i, sample = item
+                out_q.put((i, mapper(sample)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        if not order:
+            while True:
+                item = out_q.get()
+                if item is end:
+                    return
+                yield item[1]
+        else:
+            pending = {}
+            next_i = 0
+            while True:
+                item = out_q.get()
+                if item is end:
+                    for i in sorted(pending):
+                        yield pending[i]
+                    return
+                pending[item[0]] = item[1]
+                while next_i in pending:
+                    yield pending.pop(next_i)
+                    next_i += 1
+    return data_reader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Thread-based fan-in (the reference uses processes; host feed here is
+    not the bottleneck on TPU — the step is device-bound)."""
+    return chain(*readers)
+
+
+def cache(reader):
+    all_data = []
+
+    def __impl__():
+        if not all_data:
+            all_data.extend(reader())
+        for item in all_data:
+            yield item
+    return __impl__
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Group samples into lists of batch_size (ref: paddle/batch.py)."""
+    def batch_reader():
+        r = reader()
+        b = []
+        for instance in r:
+            b.append(instance)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if drop_last is False and len(b) != 0:
+            yield b
+    return batch_reader
+
+
+def bucket_by_length(reader, length_fn, bucket_boundaries, batch_size,
+                     drop_last=False):
+    """Batch samples whose length falls in the same bucket — bounds the
+    number of distinct LoD shapes reaching the compiler (TPU addition)."""
+    def bucket_reader():
+        buckets = {b: [] for b in list(bucket_boundaries) + [None]}
+
+        def bucket_of(l):
+            for b in bucket_boundaries:
+                if l <= b:
+                    return b
+            return None
+        for sample in reader():
+            b = bucket_of(length_fn(sample))
+            buckets[b].append(sample)
+            if len(buckets[b]) == batch_size:
+                yield buckets[b]
+                buckets[b] = []
+        if not drop_last:
+            for b, items in buckets.items():
+                if items:
+                    yield items
+    return bucket_reader
+
+
+class Fake(object):
+    """Replays the first sample of a reader forever (ref reader.Fake)."""
+
+    def __init__(self):
+        self.fake_reader = None
+
+    def __call__(self, reader, length):
+        def fake_reader():
+            if self.fake_reader is None:
+                self.fake_reader = list(itertools.islice(reader(), 1))
+            for _ in range(length):
+                yield self.fake_reader[0]
+        return fake_reader

@@ -1,0 +1,124 @@
+"""Host→device input pipeline: the py_reader / double_buffer equivalent
+(ref: fluid/layers/io.py:633 py_reader, :1002 double_buffer,
+operators/reader/buffered_reader.cc, lod_tensor_blocking_queue.h).
+
+A feeding thread converts python batches and stages them to the device
+(double-buffer prefetch); the executor pops a staged batch when the program's
+data vars are not covered by an explicit feed. EOF surfaces as
+fluid.core.EOFException exactly like the reference (read_op throws on a
+closed queue).
+"""
+from __future__ import annotations
+
+import queue as _q
+import threading
+
+import numpy as np
+
+from ..core import EOFException
+from ..framework import default_main_program
+
+
+class PyReader(object):
+    def __init__(self, feed_vars, capacity, use_double_buffer=True,
+                 feed_converter=None):
+        self.feed_vars = feed_vars
+        self.var_names = [v.name for v in feed_vars]
+        self.capacity = capacity
+        self.use_double_buffer = use_double_buffer
+        self._queue = _q.Queue(maxsize=capacity)
+        self._feeder_fn = None
+        self._thread = None
+        self._closed = True
+        self._exc = None
+        self._converter = feed_converter
+
+    # -- graph side --------------------------------------------------------
+    def read(self):
+        """Returns the data vars (the read_file() surface)."""
+        return list(self.feed_vars)
+
+    # -- host side ---------------------------------------------------------
+    def decorate_paddle_reader(self, reader, places=None):
+        from ..data_feeder import DataFeeder
+        feeder = DataFeeder(self.feed_vars, program=None) \
+            if self._converter is None else None
+
+        def fn():
+            for batch in reader():
+                if feeder is not None:
+                    yield feeder.feed(batch)
+                else:
+                    yield self._converter(batch)
+        self._feeder_fn = fn
+
+    decorate_sample_list_generator = decorate_paddle_reader
+
+    def decorate_tensor_provider(self, reader, places=None):
+        def fn():
+            for batch in reader():
+                if isinstance(batch, dict):
+                    yield batch
+                else:
+                    yield dict(zip(self.var_names, batch))
+        self._feeder_fn = fn
+
+    decorate_batch_generator = decorate_tensor_provider
+
+    def start(self):
+        assert self._feeder_fn is not None, (
+            "call decorate_paddle_reader/decorate_tensor_provider first")
+        self._closed = False
+        self._exc = None
+        self._queue = _q.Queue(maxsize=self.capacity)
+
+        def work():
+            try:
+                import jax
+                for feed in self._feeder_fn():
+                    if self._closed:
+                        return
+                    if self.use_double_buffer:
+                        # stage to device from the feeding thread so the
+                        # consumer finds data already resident (the
+                        # double_buffer/buffered_reader prefetch)
+                        feed = {k: (v if not isinstance(v, np.ndarray)
+                                    else jax.device_put(v))
+                                for k, v in feed.items()}
+                    self._queue.put(feed)
+                self._queue.put(_EOF)
+            except Exception as e:  # surface in consumer
+                self._exc = e
+                self._queue.put(_EOF)
+
+        self._thread = threading.Thread(target=work, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._closed = True
+        try:
+            while True:
+                self._queue.get_nowait()
+        except _q.Empty:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._thread = None
+
+    def _next_batch(self):
+        if self._thread is None and self._closed:
+            raise EOFException("py_reader not started")
+        item = self._queue.get()
+        if item is _EOF:
+            self._closed = True
+            if self._exc is not None:
+                raise self._exc
+            raise EOFException("py_reader reached end of data")
+        return item
+
+
+class _EOFSentinel(object):
+    pass
+
+
+_EOF = _EOFSentinel()

@@ -1,0 +1,164 @@
+"""OpTest harness (ref: python/paddle/fluid/tests/unittests/op_test.py).
+
+check_output: run a single op via a scratch program and compare against the
+test's numpy reference. check_grad: compare the framework's analytic grads
+(append_backward's vjp-derived grad ops) against central-difference numeric
+gradients of a summed output — the same methodology as the reference
+(op_test.py:43 get_numeric_gradient, :303 check_output, :414 check_grad).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import LoDArray
+from paddle_tpu.lod_tensor import create_lod_tensor
+
+
+def _as_feed_value(v):
+    if isinstance(v, tuple):  # (data, recursive_seq_lens) LoD convention
+        return create_lod_tensor(v[0], v[1])
+    return v
+
+
+class OpTest(object):
+    """Subclass sets: op_type, inputs {slot: np | [(name, np), ...]},
+    attrs, outputs {slot: np | [(name, np), ...]}."""
+
+    op_type = None
+    inputs = {}
+    outputs = {}
+    attrs = {}
+
+    def _build(self):
+        main = fluid.Program()
+        startup = fluid.Program()
+        feed = {}
+        with fluid.program_guard(main, startup):
+            block = main.global_block()
+            in_names = {}
+            for slot, val in self.inputs.items():
+                entries = val if isinstance(val, list) else [(slot, val)]
+                names = []
+                for name, arr in entries:
+                    arr_v = _as_feed_value(arr)
+                    data = arr_v.data if isinstance(arr_v, LoDArray) else arr_v
+                    lod_level = len(arr_v.lod) if isinstance(arr_v, LoDArray) else 0
+                    block.create_var(
+                        name=name, shape=list(np.shape(data)),
+                        dtype=str(np.asarray(data).dtype)
+                        if not isinstance(arr_v, LoDArray)
+                        else str(np.asarray(data).dtype),
+                        lod_level=lod_level, stop_gradient=False)
+                    feed[name] = arr_v
+                    names.append(name)
+                in_names[slot] = names
+
+            out_names = {}
+            out_expect = {}
+            for slot, val in self.outputs.items():
+                entries = val if isinstance(val, list) else [(slot, val)]
+                names = []
+                for name, arr in entries:
+                    block.create_var(name=name, dtype='float32',
+                                     stop_gradient=False)
+                    names.append(name)
+                    out_expect[name] = arr
+                out_names[slot] = names
+
+            block.append_op(type=self.op_type, inputs=in_names,
+                            outputs=out_names, attrs=dict(self.attrs))
+        return main, startup, feed, out_names, out_expect
+
+    def check_output(self, atol=1e-5, rtol=1e-5, no_check_set=()):
+        main, startup, feed, out_names, expect = self._build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.core.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            fetch = [n for names in out_names.values() for n in names
+                     if n not in no_check_set and expect.get(n) is not None]
+            outs = exe.run(program=main, feed=feed, fetch_list=fetch)
+        for name, got in zip(fetch, outs):
+            want = expect[name]
+            if isinstance(want, tuple):
+                want = want[0]
+            np.testing.assert_allclose(
+                got, np.asarray(want), atol=atol, rtol=rtol,
+                err_msg="output %r of op %s mismatch" % (name, self.op_type))
+
+    def check_grad(self, inputs_to_check, output_name, max_relative_error=5e-3,
+                   numeric_delta=1e-3, no_grad_set=None):
+        main, startup, feed, out_names, expect = self._build()
+        with fluid.program_guard(main, startup):
+            block = main.global_block()
+            out_var = block.var(output_name)
+            # loss = sum(output * fixed random weights): nonzero cotangents
+            # even for outputs with structural zero-sum grads (softmax etc.)
+            rng = np.random.RandomState(7)
+            weighted = block.create_var(name='__loss_weighted__',
+                                        dtype='float32', stop_gradient=False)
+            wname = '__loss_w__'
+            block.create_var(name=wname, dtype='float32',
+                             stop_gradient=True)
+            wshape = [int(s) for s in (out_var.shape or (1,))]
+            wvals = rng.uniform(0.1, 1.0, size=wshape).astype(np.float32)
+            block.append_op(type='assign_value',
+                            outputs={'Out': [wname]},
+                            attrs={'shape': wshape, 'dtype': 'float32',
+                                   'fp32_values': [float(v)
+                                                   for v in wvals.flat]})
+            block.append_op(type='elementwise_mul',
+                            inputs={'X': [output_name], 'Y': [wname]},
+                            outputs={'Out': [weighted.name]},
+                            attrs={'axis': -1})
+            flat = block.create_var(name='__loss_flat__', dtype='float32',
+                                    stop_gradient=False)
+            block.append_op(type='reduce_sum', inputs={'X': [weighted.name]},
+                            outputs={'Out': [flat.name]},
+                            attrs={'reduce_all': True, 'dim': [0],
+                                   'keep_dim': False})
+            grads = fluid.append_backward(flat, no_grad_set=no_grad_set)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.core.Scope()
+        grad_names = [n + '@GRAD' for n in inputs_to_check]
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            analytic = exe.run(program=main, feed=feed, fetch_list=grad_names)
+
+        # numeric gradients by central difference on the summed output
+        def eval_loss(feed_over):
+            with fluid.scope_guard(scope):
+                out, = exe.run(program=main, feed=feed_over,
+                               fetch_list=[flat.name])
+            return float(np.asarray(out).reshape(-1)[0])
+
+        for in_name, got in zip(inputs_to_check, analytic):
+            base = feed[in_name]
+            base_data = np.array(base.data if isinstance(base, LoDArray)
+                                 else base, dtype=np.float64)
+            num = np.zeros_like(base_data, dtype=np.float64)
+            flat_view = base_data.reshape(-1)
+            num_flat = num.reshape(-1)
+            for i in range(flat_view.size):
+                orig = flat_view[i]
+                for sign in (+1, -1):
+                    flat_view[i] = orig + sign * numeric_delta
+                    f2 = dict(feed)
+                    pert = base_data.astype(np.float32)
+                    f2[in_name] = (LoDArray(pert, base.lod)
+                                   if isinstance(base, LoDArray) else pert)
+                    if sign > 0:
+                        f_pos = eval_loss(f2)
+                    else:
+                        f_neg = eval_loss(f2)
+                flat_view[i] = orig
+                num_flat[i] = (f_pos - f_neg) / (2 * numeric_delta)
+            got = np.asarray(got, dtype=np.float64)
+            abs_max = max(np.abs(num).max(), np.abs(got).max(), 1e-3)
+            rel_err = np.abs(got - num).max() / abs_max
+            assert rel_err < max_relative_error, (
+                "gradient of %s w.r.t %s: max rel err %.5f (analytic vs "
+                "numeric)\nanalytic:\n%s\nnumeric:\n%s" %
+                (self.op_type, in_name, rel_err, got, num))

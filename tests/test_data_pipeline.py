@@ -1,0 +1,87 @@
+"""Data pipeline: reader decorators, py_reader queue/EOF semantics,
+DataFeeder, datasets (ref: test_py_reader_using_executor.py, reader tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import reader as reader_mod
+
+
+def test_decorators():
+    def r():
+        return iter(range(10))
+    b = reader_mod.batch(lambda: iter(range(10)), 3)
+    batches = list(b())
+    assert batches[0] == [0, 1, 2] and batches[-1] == [9]
+    s = reader_mod.shuffle(lambda: iter(range(100)), 50)
+    assert sorted(s()) == list(range(100))
+    f = reader_mod.firstn(lambda: iter(range(100)), 5)
+    assert list(f()) == [0, 1, 2, 3, 4]
+    c = reader_mod.chain(lambda: iter([1]), lambda: iter([2]))
+    assert list(c()) == [1, 2]
+    m = reader_mod.map_readers(lambda a: a * 2, lambda: iter([1, 2]))
+    assert list(m()) == [2, 4]
+
+
+def test_bucket_by_length():
+    samples = [[0] * l for l in [2, 9, 3, 8, 2, 9]]
+    br = reader_mod.bucket_by_length(lambda: iter(samples), len,
+                                     [4, 16], 2)
+    batches = list(br())
+    for b in batches:
+        lens = [len(s) for s in b]
+        assert all(l <= 4 for l in lens) or all(4 < l <= 16 for l in lens)
+
+
+def test_py_reader_trains_with_eof():
+    reader = fluid.layers.py_reader(
+        capacity=8, shapes=[(-1, 4), (-1, 1)], dtypes=['float32', 'int64'])
+    x, label = fluid.layers.read_file(reader)
+    logits = fluid.layers.fc(input=x, size=3)
+    loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+        logits=logits, label=label))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    def data():
+        for i in range(7):
+            yield [(np.random.rand(4).astype(np.float32),
+                    np.array([i % 3], np.int64)) for _ in range(6)]
+
+    reader.decorate_paddle_reader(data)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    for epoch in range(2):
+        reader.start()
+        steps = 0
+        while True:
+            try:
+                l, = exe.run(fetch_list=[loss])
+                steps += 1
+            except fluid.core.EOFException:
+                reader.reset()
+                break
+        assert steps == 7, steps
+
+
+def test_datasets_shapes():
+    import paddle_tpu.dataset as ds
+    img, lab = next(iter(ds.mnist.train()()))
+    assert img.shape == (784,) and isinstance(lab, int)
+    x, y = next(iter(ds.uci_housing.train()()))
+    assert x.shape == (13,) and y.shape == (1,)
+    toks, sent = next(iter(ds.imdb.train()()))
+    assert isinstance(toks, list) and sent in (0, 1)
+    src, tin, tout = next(iter(ds.wmt14.train(1000)()))
+    assert len(tin) == len(src) + 1 and len(tout) == len(src) + 1
+
+
+def test_data_feeder_lod():
+    x = fluid.layers.data('x', shape=[1], dtype='int64', lod_level=1)
+    y = fluid.layers.data('y', shape=[1], dtype='int64')
+    feeder = fluid.DataFeeder(feed_list=[x, y], place=fluid.CPUPlace())
+    feed = feeder.feed([([1, 2, 3], [0]), ([4, 5], [1])])
+    lod_val = feed['x']
+    assert lod_val.lod[0] == (0, 3, 5)
+    assert np.asarray(lod_val.data).shape == (5, 1)
+    assert feed['y'].shape == (2, 1)

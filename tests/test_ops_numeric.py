@@ -1,0 +1,351 @@
+"""Per-op numeric forward + gradient checks through the OpTest harness
+(ref: the ~300 test_*_op.py files; representative coverage per group)."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _softmax_np(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+class TestElementwiseAdd(OpTest):
+    op_type = 'elementwise_add'
+
+    def setup_method(self, m):
+        x = np.random.rand(3, 4).astype(np.float32)
+        y = np.random.rand(3, 4).astype(np.float32)
+        self.inputs = {'X': x, 'Y': y}
+        self.outputs = {'Out': x + y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(['X', 'Y'], 'Out')
+
+
+class TestElementwiseAddBroadcastAxis(OpTest):
+    op_type = 'elementwise_add'
+
+    def setup_method(self, m):
+        x = np.random.rand(2, 3, 4).astype(np.float32)
+        y = np.random.rand(3).astype(np.float32)
+        self.inputs = {'X': x, 'Y': y}
+        self.attrs = {'axis': 1}
+        self.outputs = {'Out': x + y.reshape(1, 3, 1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(['X', 'Y'], 'Out')
+
+
+class TestMul(OpTest):
+    op_type = 'mul'
+
+    def setup_method(self, m):
+        x = np.random.rand(4, 5).astype(np.float32)
+        y = np.random.rand(5, 3).astype(np.float32)
+        self.inputs = {'X': x, 'Y': y}
+        self.outputs = {'Out': x @ y}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(['X', 'Y'], 'Out', max_relative_error=1e-2)
+
+
+class TestMatmulTranspose(OpTest):
+    op_type = 'matmul'
+
+    def setup_method(self, m):
+        x = np.random.rand(4, 5).astype(np.float32)
+        y = np.random.rand(3, 5).astype(np.float32)
+        self.inputs = {'X': x, 'Y': y}
+        self.attrs = {'transpose_X': False, 'transpose_Y': True}
+        self.outputs = {'Out': x @ y.T}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestSoftmax(OpTest):
+    op_type = 'softmax'
+
+    def setup_method(self, m):
+        x = np.random.rand(5, 7).astype(np.float32)
+        self.inputs = {'X': x}
+        self.outputs = {'Out': _softmax_np(x)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(['X'], 'Out')
+
+
+class TestCrossEntropy(OpTest):
+    op_type = 'cross_entropy'
+
+    def setup_method(self, m):
+        probs = _softmax_np(np.random.rand(6, 4).astype(np.float32))
+        label = np.random.randint(0, 4, (6, 1)).astype(np.int64)
+        out = -np.log(probs[np.arange(6), label[:, 0]])[:, None]
+        self.inputs = {'X': probs, 'Label': label}
+        self.outputs = {'Y': out}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestReduceSum(OpTest):
+    op_type = 'reduce_sum'
+
+    def setup_method(self, m):
+        x = np.random.rand(3, 4, 5).astype(np.float32)
+        self.inputs = {'X': x}
+        self.attrs = {'dim': [1], 'keep_dim': False, 'reduce_all': False}
+        self.outputs = {'Out': x.sum(axis=1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(['X'], 'Out')
+
+
+class TestReduceMeanAll(OpTest):
+    op_type = 'reduce_mean'
+
+    def setup_method(self, m):
+        x = np.random.rand(3, 4).astype(np.float32)
+        self.inputs = {'X': x}
+        self.attrs = {'reduce_all': True, 'dim': [0]}
+        self.outputs = {'Out': np.asarray(x.mean(), np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+
+@pytest.mark.parametrize("act,fn", [
+    ('relu', lambda x: np.maximum(x, 0)),
+    ('sigmoid', lambda x: 1 / (1 + np.exp(-x))),
+    ('tanh', np.tanh),
+    ('exp', np.exp),
+    ('square', np.square),
+    ('softplus', lambda x: np.log1p(np.exp(x))),
+    ('abs', np.abs),
+    ('reciprocal', lambda x: 1.0 / x),
+    ('sqrt', np.sqrt),
+])
+def test_activation_forward(act, fn):
+    class T(OpTest):
+        op_type = act
+    t = T()
+    x = (np.random.rand(4, 5).astype(np.float32) + 0.5)
+    t.inputs = {'X': x}
+    t.outputs = {'Out': fn(x).astype(np.float32)}
+    t.attrs = {}
+    t.check_output(atol=1e-5)
+
+
+@pytest.mark.parametrize("act", ['sigmoid', 'tanh', 'softplus', 'square'])
+def test_activation_grad(act):
+    class T(OpTest):
+        op_type = act
+    t = T()
+    x = (np.random.rand(3, 4).astype(np.float32) + 0.5)
+    t.inputs = {'X': x}
+    t.outputs = {'Out': x}  # unused for grad
+    t.attrs = {}
+    t.check_grad(['X'], 'Out', max_relative_error=1e-2)
+
+
+class TestConv2d(OpTest):
+    op_type = 'conv2d'
+
+    def setup_method(self, m):
+        x = np.random.rand(2, 3, 5, 5).astype(np.float32)
+        w = np.random.rand(4, 3, 3, 3).astype(np.float32)
+        # numpy reference conv (stride 1, pad 1)
+        xp = np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)])
+        out = np.zeros((2, 4, 5, 5), np.float32)
+        for n in range(2):
+            for o in range(4):
+                for i in range(5):
+                    for j in range(5):
+                        out[n, o, i, j] = np.sum(
+                            xp[n, :, i:i + 3, j:j + 3] * w[o])
+        self.inputs = {'Input': x, 'Filter': w}
+        self.attrs = {'strides': [1, 1], 'paddings': [1, 1],
+                      'dilations': [1, 1], 'groups': 1}
+        self.outputs = {'Output': out}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(['Input', 'Filter'], 'Output',
+                        max_relative_error=2e-2)
+
+
+class TestPool2dMax(OpTest):
+    op_type = 'pool2d'
+
+    def setup_method(self, m):
+        x = np.random.rand(2, 3, 4, 4).astype(np.float32)
+        out = x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5))
+        self.inputs = {'X': x}
+        self.attrs = {'pooling_type': 'max', 'ksize': [2, 2],
+                      'strides': [2, 2], 'paddings': [0, 0]}
+        self.outputs = {'Out': out}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(['X'], 'Out', max_relative_error=1e-2)
+
+
+class TestPool2dAvg(OpTest):
+    op_type = 'pool2d'
+
+    def setup_method(self, m):
+        x = np.random.rand(2, 3, 4, 4).astype(np.float32)
+        out = x.reshape(2, 3, 2, 2, 2, 2).mean(axis=(3, 5))
+        self.inputs = {'X': x}
+        self.attrs = {'pooling_type': 'avg', 'ksize': [2, 2],
+                      'strides': [2, 2], 'paddings': [0, 0]}
+        self.outputs = {'Out': out}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestLayerNorm(OpTest):
+    op_type = 'layer_norm'
+
+    def setup_method(self, m):
+        x = np.random.rand(4, 6).astype(np.float32)
+        scale = np.random.rand(6).astype(np.float32)
+        bias = np.random.rand(6).astype(np.float32)
+        mu = x.mean(axis=1, keepdims=True)
+        var = x.var(axis=1, keepdims=True)
+        out = (x - mu) / np.sqrt(var + 1e-5) * scale + bias
+        self.inputs = {'X': x, 'Scale': scale, 'Bias': bias}
+        self.attrs = {'begin_norm_axis': 1, 'epsilon': 1e-5}
+        self.outputs = {'Y': out}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(['X', 'Scale', 'Bias'], 'Y', max_relative_error=2e-2)
+
+
+class TestLookupTable(OpTest):
+    op_type = 'lookup_table'
+
+    def setup_method(self, m):
+        w = np.random.rand(10, 4).astype(np.float32)
+        ids = np.random.randint(0, 10, (5, 1)).astype(np.int64)
+        self.inputs = {'W': w, 'Ids': ids}
+        self.attrs = {'padding_idx': -1}
+        self.outputs = {'Out': w[ids[:, 0]]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(['W'], 'Out', max_relative_error=1e-2)
+
+
+class TestTranspose(OpTest):
+    op_type = 'transpose'
+
+    def setup_method(self, m):
+        x = np.random.rand(2, 3, 4).astype(np.float32)
+        self.inputs = {'X': x}
+        self.attrs = {'axis': [1, 0, 2]}
+        self.outputs = {'Out': x.transpose(1, 0, 2)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestConcat(OpTest):
+    op_type = 'concat'
+
+    def setup_method(self, m):
+        a = np.random.rand(2, 3).astype(np.float32)
+        b = np.random.rand(2, 5).astype(np.float32)
+        self.inputs = {'X': [('x0', a), ('x1', b)]}
+        self.attrs = {'axis': 1}
+        self.outputs = {'Out': np.concatenate([a, b], axis=1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(['x0', 'x1'], 'Out')
+
+
+class TestGather(OpTest):
+    op_type = 'gather'
+
+    def setup_method(self, m):
+        x = np.random.rand(6, 3).astype(np.float32)
+        idx = np.array([0, 2, 5], np.int64)
+        self.inputs = {'X': x, 'Index': idx}
+        self.outputs = {'Out': x[idx]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(['X'], 'Out', max_relative_error=1e-2)
+
+
+class TestBatchNormInference(OpTest):
+    op_type = 'batch_norm'
+
+    def setup_method(self, m):
+        x = np.random.rand(2, 3, 4, 4).astype(np.float32)
+        scale = np.random.rand(3).astype(np.float32)
+        bias = np.random.rand(3).astype(np.float32)
+        mean = np.random.rand(3).astype(np.float32)
+        var = np.random.rand(3).astype(np.float32) + 0.5
+        out = ((x - mean.reshape(1, 3, 1, 1)) /
+               np.sqrt(var.reshape(1, 3, 1, 1) + 1e-5) *
+               scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1))
+        self.inputs = {'X': x, 'Scale': scale, 'Bias': bias, 'Mean': mean,
+                       'Variance': var}
+        self.attrs = {'is_test': True, 'epsilon': 1e-5}
+        self.outputs = {'Y': out}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, no_check_set=(
+            'MeanOut', 'VarianceOut', 'SavedMean', 'SavedVariance'))
+
+
+class TestSoftmaxWithCrossEntropy(OpTest):
+    op_type = 'softmax_with_cross_entropy'
+
+    def setup_method(self, m):
+        logits = np.random.rand(5, 7).astype(np.float32)
+        label = np.random.randint(0, 7, (5, 1)).astype(np.int64)
+        sm = _softmax_np(logits)
+        loss = -np.log(sm[np.arange(5), label[:, 0]])[:, None]
+        self.inputs = {'Logits': logits, 'Label': label}
+        self.outputs = {'Softmax': sm, 'Loss': loss}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(['Logits'], 'Loss', max_relative_error=1e-2)

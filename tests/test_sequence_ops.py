@@ -1,0 +1,160 @@
+"""Sequence/LoD op tests: feed LoDTensors, check against per-sequence numpy
+references (ref: test_sequence_pool.py, test_sequence_expand.py, test_lstm_op.py...)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.lod_tensor import create_lod_tensor
+
+
+def _run(layer_fn, feeds, fetch, lod_feeds=None):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe.run(feed=feeds, fetch_list=fetch)
+
+
+def test_sequence_pool_types():
+    x = fluid.layers.data('x', shape=[3], dtype='float32', lod_level=1)
+    outs = {
+        'sum': fluid.layers.sequence_pool(x, 'sum'),
+        'avg': fluid.layers.sequence_pool(x, 'average'),
+        'max': fluid.layers.sequence_pool(x, 'max'),
+        'first': fluid.layers.sequence_first_step(x),
+        'last': fluid.layers.sequence_last_step(x),
+    }
+    data = np.arange(15, dtype=np.float32).reshape(5, 3)
+    lt = create_lod_tensor(data, [[2, 3]])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    names = list(outs)
+    vals = exe.run(feed={'x': lt}, fetch_list=[outs[n] for n in names])
+    got = dict(zip(names, vals))
+    seqs = [data[:2], data[2:]]
+    np.testing.assert_allclose(got['sum'], [s.sum(0) for s in seqs], rtol=1e-6)
+    np.testing.assert_allclose(got['avg'], [s.mean(0) for s in seqs], rtol=1e-6)
+    np.testing.assert_allclose(got['max'], [s.max(0) for s in seqs], rtol=1e-6)
+    np.testing.assert_allclose(got['first'], [s[0] for s in seqs], rtol=1e-6)
+    np.testing.assert_allclose(got['last'], [s[-1] for s in seqs], rtol=1e-6)
+
+
+def test_sequence_softmax():
+    x = fluid.layers.data('x', shape=[1], dtype='float32', lod_level=1)
+    y = fluid.layers.sequence_softmax(x)
+    data = np.array([[1.], [2.], [3.], [1.], [1.]], np.float32)
+    lt = create_lod_tensor(data, [[3, 2]])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    out, = exe.run(feed={'x': lt}, fetch_list=[y])
+
+    def sm(v):
+        e = np.exp(v - v.max())
+        return e / e.sum()
+    want = np.concatenate([sm(data[:3, 0]), sm(data[3:, 0])])[:, None]
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_sequence_expand():
+    x = fluid.layers.data('x', shape=[1], dtype='float32', lod_level=1)
+    y = fluid.layers.data('y', shape=[1], dtype='float32', lod_level=1)
+    out = fluid.layers.sequence_expand(x, y, ref_level=0)
+    xd = np.array([[1.], [2.], [3.], [4.]], np.float32)
+    yd = np.zeros((5, 1), np.float32)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    o, = exe.run(feed={'x': create_lod_tensor(xd, [[2, 2]]),
+                       'y': create_lod_tensor(yd, [[2, 3]])},
+                 fetch_list=[out])
+    # x seq0=[1,2] repeated 2x, x seq1=[3,4] repeated 3x
+    want = np.array([1, 2, 1, 2, 3, 4, 3, 4, 3, 4], np.float32)[:, None]
+    np.testing.assert_allclose(o, want)
+
+
+def test_sequence_pad_unpad_roundtrip():
+    x = fluid.layers.data('x', shape=[2], dtype='float32', lod_level=1)
+    pad_v = fluid.layers.assign(np.array([0.0], np.float32))
+    padded, length = fluid.layers.sequence_pad(x, pad_v)
+    unpadded = fluid.layers.sequence_unpad(padded, length)
+    data = np.arange(10, dtype=np.float32).reshape(5, 2)
+    lt = create_lod_tensor(data, [[2, 3]])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    p, u = exe.run(feed={'x': lt}, fetch_list=[padded, unpadded])
+    assert p.shape == (2, 3, 2)
+    np.testing.assert_allclose(p[0, :2], data[:2])
+    np.testing.assert_allclose(p[0, 2], 0.0)
+    np.testing.assert_allclose(u, data)
+
+
+def test_sequence_reverse():
+    x = fluid.layers.data('x', shape=[1], dtype='float32', lod_level=1)
+    rev = fluid.layers.sequence_reverse(x)
+    data = np.arange(5, dtype=np.float32)[:, None]
+    lt = create_lod_tensor(data, [[3, 2]])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    r, = exe.run(feed={'x': lt}, fetch_list=[rev])
+    np.testing.assert_allclose(r[:, 0], [2, 1, 0, 4, 3])
+
+
+def test_sequence_mask():
+    lens = fluid.layers.data('lens', shape=[3], dtype='int64',
+                             append_batch_size=False)
+    m = fluid.layers.sequence_mask(lens, maxlen=4, dtype='float32')
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    mv, = exe.run(feed={'lens': np.array([1, 3, 4], np.int64)},
+                  fetch_list=[m])
+    np.testing.assert_allclose(mv, [[1, 0, 0, 0], [1, 1, 1, 0], [1, 1, 1, 1]])
+
+
+def test_dynamic_lstm_trains():
+    """LSTM text classifier on LoD input learns a simple rule."""
+    np.random.seed(0)
+    words = fluid.layers.data('words', shape=[1], dtype='int64', lod_level=1)
+    label = fluid.layers.data('label', shape=[1], dtype='int64')
+    emb = fluid.layers.embedding(input=words, size=[20, 16])
+    proj = fluid.layers.fc(input=emb, size=64, bias_attr=False)
+    proj.lod_level = 1
+    hidden, cell = fluid.layers.dynamic_lstm(input=proj, size=64)
+    pooled = fluid.layers.sequence_pool(hidden, 'last')
+    logits = fluid.layers.fc(input=pooled, size=2)
+    loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+        logits=logits, label=label))
+    fluid.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    # rule: label = whether token 7 appears in the sequence
+    def batch():
+        seqs, labels = [], []
+        for _ in range(16):
+            s = np.random.randint(0, 20, 6)  # fixed length: one compile (bucketed)
+            labels.append([int(7 in s)])
+            seqs.append(s)
+        flat = np.concatenate(seqs)[:, None].astype(np.int64)
+        return (create_lod_tensor(flat, [[len(s) for s in seqs]]),
+                np.asarray(labels, np.int64))
+
+    losses = []
+    for i in range(40):
+        w, lab = batch()
+        l, = exe.run(feed={'words': w, 'label': lab}, fetch_list=[loss])
+        losses.append(float(l[0]))
+    assert np.mean(losses[-10:]) < np.mean(losses[:10]), losses[:3] + losses[-3:]
+
+
+def test_dynamic_gru_runs():
+    x = fluid.layers.data('x', shape=[1], dtype='int64', lod_level=1)
+    emb = fluid.layers.embedding(input=x, size=[10, 9])
+    proj = fluid.layers.fc(input=emb, size=15, bias_attr=False)
+    proj.lod_level = 1
+    hidden = fluid.layers.dynamic_gru(input=proj, size=5)
+    pooled = fluid.layers.sequence_pool(hidden, 'average')
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    flat = np.random.randint(0, 10, (6, 1)).astype(np.int64)
+    out, = exe.run(feed={'x': create_lod_tensor(flat, [[4, 2]])},
+                   fetch_list=[pooled])
+    assert out.shape == (2, 5)
+    assert np.isfinite(out).all()
