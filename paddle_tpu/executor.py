@@ -42,11 +42,13 @@ _analysis_cache = {}
 
 def _program_analysis(program):
     """(persistable names, persistable∩written) — memoized per build epoch."""
-    key = (id(program), program._build_epoch,
+    key = (program._uid, program._build_epoch,
            sum(len(b.ops) for b in program.blocks))
     hit = _analysis_cache.get(key)
     if hit is not None:
         return hit
+    for k in [k for k in _analysis_cache if k[0] == program._uid]:
+        del _analysis_cache[k]
     persist = {v.name for v in program.list_vars() if v.persistable}
     written = set()
     for b in program.blocks:
@@ -116,13 +118,20 @@ class Executor(object):
                               out_state_names) + (mesh_key,)
         fn = self._cache.get(key)
         if fn is None:
+            # evict compiled steps for older epochs of this program: a
+            # mutate-then-run loop would otherwise leak one XLA executable
+            # per mutation
+            stale = [k for k in self._cache
+                     if k[0] == program._uid and k[1] != program._build_epoch]
+            for k in stale:
+                del self._cache[k]
             fn = self._build(program, tuple(sorted(feed_vals)), tuple(fetch_names),
                              tuple(sorted(state)), out_state_names, mesh,
                              feed_vals)
             self._cache[key] = fn
 
-        step = self._step_counters.get(id(program), 0)
-        self._step_counters[id(program)] = step + 1
+        step = self._step_counters.get(program._uid, 0)
+        self._step_counters[program._uid] = step + 1
         seed = program.random_seed or 1234567
         with jax.default_device(self._device) if self._device is not None \
                 else _nullcontext():
@@ -162,7 +171,10 @@ class Executor(object):
         data = getattr(value, 'data', value)
         if callable(lod):  # reference-style LoDTensor API
             lod, data = value.lod(), np.asarray(value)
-        arr = jnp.asarray(np.asarray(data), dtype=jnp.dtype(dtype) if dtype else None)
+        with jax.default_device(self._device) if self._device is not None \
+                else _nullcontext():
+            arr = jnp.asarray(np.asarray(data),
+                              dtype=jnp.dtype(dtype) if dtype else None)
         if self._device is not None:
             arr = jax.device_put(arr, self._device)
         if lod:
@@ -176,7 +188,7 @@ class Executor(object):
         return (tuple(np.shape(v)), str(getattr(v, 'dtype', type(v).__name__)))
 
     def _cache_key(self, program, feed_vals, fetch_names, state, out_names):
-        return (id(program), program._build_epoch,
+        return (program._uid, program._build_epoch,
                 tuple((n, self._sig(v)) for n, v in sorted(feed_vals.items())),
                 tuple(fetch_names),
                 tuple((n, self._sig(v)) for n, v in sorted(state.items())),

@@ -228,6 +228,7 @@ class Block(object):
                   infer_shape=True):
         op = Operator(self, type, inputs, outputs, attrs)
         self.ops.append(op)
+        self.program._build_epoch += 1
         if infer_shape:
             from .core import registry
             registry.infer_shape(op, self)
@@ -237,6 +238,7 @@ class Block(object):
                    infer_shape=True):
         op = Operator(self, type, inputs, outputs, attrs)
         self.ops.insert(0, op)
+        self.program._build_epoch += 1
         if infer_shape:
             from .core import registry
             registry.infer_shape(op, self)
@@ -246,9 +248,15 @@ class Block(object):
                   infer_shape=True):
         op = Operator(self, type, inputs, outputs, attrs)
         self.ops.insert(index, op)
+        self.program._build_epoch += 1
         if infer_shape:
             from .core import registry
             registry.infer_shape(op, self)
+        return op
+
+    def remove_op(self, index):
+        op = self.ops.pop(index)
+        self.program._build_epoch += 1
         return op
 
     def __repr__(self):
@@ -261,14 +269,19 @@ class Block(object):
 class Program(object):
     """A list of blocks; block 0 is global (ref: fluid/framework.py:1510)."""
 
+    _uid_counter = [0]
+
     def __init__(self):
         self.blocks = [Block(self, 0)]
         self._current_block_idx = 0
         self._seed = 0
         self.random_seed = 0
         self._version = 1
-        # executor-side compile cache is keyed on this; bump on any mutation
-        # made after a first run (mutation normally only happens at build time)
+        # executor-side compile cache keys on (_uid, _build_epoch): the uid is
+        # monotonic (id() can be reused after GC), the epoch bumps on every op
+        # mutation so stale compiled step functions are never replayed.
+        Program._uid_counter[0] += 1
+        self._uid = Program._uid_counter[0]
         self._build_epoch = 0
 
     # -- block management -------------------------------------------------
@@ -328,6 +341,8 @@ class Program(object):
         p._seed = self._seed
         p.random_seed = self.random_seed
         p._version = self._version
+        Program._uid_counter[0] += 1
+        p._uid = Program._uid_counter[0]
         p._build_epoch = self._build_epoch
         for b in self.blocks:
             nb = Block(p, b.idx, b.parent_idx)
