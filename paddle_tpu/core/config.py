@@ -48,6 +48,8 @@ FLAGS = {
     'eager_delete_tensor_gb': float(
         os.environ.get('FLAGS_eager_delete_tensor_gb', '-1')),
     'deterministic': os.environ.get('FLAGS_cudnn_deterministic', '0') == '1',
+    'tensor_array_capacity': int(
+        os.environ.get('FLAGS_tensor_array_capacity', '128')),
 }
 
 

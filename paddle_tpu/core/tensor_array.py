@@ -1,0 +1,120 @@
+"""TensorArray runtime value (ref: LoDTensorArray, framework/lod_tensor_array.h
+and the array ops in operators/controlflow/tensor_array_read_write_op.cc,
+lod_rank_table_op.cc).
+
+The reference's LoDTensorArray is a host vector of LoDTensors that control
+flow ops push/pop; sizes are dynamic. TPU-native re-design: a TensorArray is
+a FIXED-CAPACITY device ring [capacity, *elem_shape] plus a traced length
+scalar, registered as a jax pytree so it can ride the carry of
+lax.while_loop/scan. Writes are lax.dynamic_update_slice at a traced index;
+reads are dynamic_index. Capacity is static structure: it comes from the
+static LoD (max sequence length) for lod_tensor_to_array, or from the
+`capacity` attr / first outside-loop write for user arrays.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+def _default_capacity():
+    """Capacity for arrays first written with no explicit capacity
+    (decode-style loops). FLAGS_tensor_array_capacity overrides."""
+    from .config import get_flag
+    return int(get_flag('tensor_array_capacity', 128))
+
+
+@jax.tree_util.register_pytree_node_class
+class TensorArrayVal(object):
+    """Fixed-capacity device buffer + traced length."""
+
+    __slots__ = ('data', 'length', 'capacity')
+
+    def __init__(self, data, length, capacity):
+        self.data = data          # jnp [capacity, *elem] or None (unallocated)
+        self.length = length      # traced int32 scalar
+        self.capacity = capacity  # static python int (0 = not yet known)
+
+    # -- pytree: capacity is structure ------------------------------------
+    def tree_flatten(self):
+        return (self.data, self.length), self.capacity
+
+    @classmethod
+    def tree_unflatten(cls, capacity, children):
+        obj = cls.__new__(cls)
+        obj.data, obj.length = children
+        obj.capacity = capacity
+        return obj
+
+    # -- ops ---------------------------------------------------------------
+    @staticmethod
+    def empty(capacity=0):
+        return TensorArrayVal(None, jnp.asarray(0, jnp.int32), capacity)
+
+    def write(self, i, x):
+        """Functional write at traced index i; returns a new array.
+
+        Writes past capacity clamp onto the last slot (XLA semantics); to
+        keep that LOUD instead of silently plausible, float elements written
+        out of range are poisoned to NaN and `length` still counts past
+        capacity so callers can assert length <= capacity on the host."""
+        x = jnp.asarray(x)
+        i = jnp.asarray(i, jnp.int32).reshape(())
+        if self.data is None:
+            cap = self.capacity or _default_capacity()
+            data = jnp.zeros((cap,) + x.shape, x.dtype)
+        else:
+            data = self.data
+            if x.shape != data.shape[1:]:
+                raise ValueError(
+                    "array_write element shape %r != array element shape %r"
+                    % (x.shape, data.shape[1:]))
+        cap = data.shape[0]
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            x = jnp.where(i < cap, x, jnp.full_like(x, jnp.nan))
+        data = jax.lax.dynamic_update_index_in_dim(data, x, i, 0)
+        length = jnp.maximum(self.length, i + 1)
+        return TensorArrayVal(data, length, cap)
+
+    def read(self, i):
+        if self.data is None:
+            raise ValueError(
+                "array_read from an empty TensorArray: write an element "
+                "before the loop (or pass capacity+shape to create_array) so "
+                "the buffer shape is known at trace time")
+        i = jnp.asarray(i, jnp.int32).reshape(())
+        return jax.lax.dynamic_index_in_dim(self.data, i, 0, keepdims=False)
+
+    def stack(self, upto=None):
+        """Dense [capacity or upto, *elem] view (tensor_array_to_tensor)."""
+        if self.data is None:
+            raise ValueError("stack of empty TensorArray")
+        return self.data if upto is None else self.data[:upto]
+
+    def __repr__(self):
+        return "TensorArrayVal(cap=%s, elem=%s)" % (
+            self.capacity,
+            None if self.data is None else self.data.shape[1:])
+
+
+class RankTable(object):
+    """Static host-side rank table (ref lod_rank_table_op.cc): sequences of a
+    LoD level sorted by length, descending, stable. Because our LoD offsets
+    are static trace-time structure, the whole table is static too."""
+
+    __slots__ = ('lengths', 'order', 'max_len')
+
+    def __init__(self, offsets):
+        off = np.asarray(offsets, dtype=np.int64)
+        lens = off[1:] - off[:-1]
+        # stable sort by descending length (reference uses stable_sort)
+        self.order = tuple(int(i) for i in
+                           np.argsort(-lens, kind='stable'))
+        self.lengths = tuple(int(lens[i]) for i in self.order)
+        self.max_len = int(lens.max()) if len(lens) else 0
+
+    def items(self):
+        return list(zip(self.order, self.lengths))
+
+    def __repr__(self):
+        return "RankTable(order=%s, lengths=%s)" % (self.order, self.lengths)

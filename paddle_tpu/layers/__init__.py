@@ -23,7 +23,12 @@ from .sequence import (sequence_pool, sequence_first_step,  # noqa: F401
                        lod_reset, im2sequence, row_conv, dynamic_lstm,
                        dynamic_lstmp, dynamic_gru, gru_unit, lstm_unit, lstm)
 from .control_flow import (increment, less_than, less_equal, greater_than,  # noqa
-                           greater_equal, equal, not_equal, is_empty, Print)
+                           greater_equal, equal, not_equal, is_empty, Print,
+                           While, StaticRNN, DynamicRNN, IfElse, Switch,
+                           BlockGuard, create_array, array_write, array_read,
+                           array_length, lod_rank_table, max_sequence_len,
+                           lod_tensor_to_array, array_to_lod_tensor,
+                           reorder_lod_tensor_by_rank, shrink_memory)
 from .metric_op import accuracy, auc  # noqa: F401
 from .learning_rate_scheduler import (noam_decay, exponential_decay,  # noqa
                                       natural_exp_decay, inverse_time_decay,

@@ -1,16 +1,28 @@
-"""Control-flow layers (ref: fluid/layers/control_flow.py —
-While:504, StaticRNN:278, DynamicRNN:1395, Switch:1139).
+"""Control-flow layers (ref: python/paddle/fluid/layers/control_flow.py —
+While:504, StaticRNN:278, DynamicRNN:1395, Switch:1139, IfElse, array ops).
 
-Round-1 surface: comparison helpers + increment + Print; the block-based
-While/StaticRNN/DynamicRNN lower onto lax.while_loop/scan in the sequence
-phase (they create sub-blocks that core/lowering executes with explicit
-carries).
+TPU-native design notes:
+- While / StaticRNN / DynamicRNN build a sub-block in the Program IR; the
+  tracer lowers the whole construct to ONE lax.while_loop / lax.scan
+  (ops/control_ops.py) instead of interpreting the block per iteration
+  against nested scopes (ref operators/controlflow/while_op.cc:50,
+  recurrent_op.cc).
+- IfElse and Switch lower densely: both branches compute, a select merges.
+  On TPU a diverged branch would stall the systolic array anyway; dense
+  compute + select is what XLA fuses best. Row-level IfElse semantics
+  (the reference splits rows by a [N,1] bool mask) are preserved exactly
+  because the merged ops are row-wise.
+- TensorArrays are fixed-capacity device buffers (core/tensor_array.py).
 """
 from __future__ import annotations
 
 from ..framework import Variable
 from ..layer_helper import LayerHelper
 
+
+# ---------------------------------------------------------------------------
+# small scalar helpers
+# ---------------------------------------------------------------------------
 
 def increment(x, value=1.0, in_place=True):
     helper = LayerHelper("increment")
@@ -63,4 +75,738 @@ def Print(input, first_n=-1, message=None, summarize=-1, print_tensor_name=True,
                      outputs={'Out': [out]},
                      attrs={'first_n': first_n, 'message': message or '',
                             'summarize': summarize})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# block guards + external read/write analysis
+# ---------------------------------------------------------------------------
+
+class BlockGuard(object):
+    """Enter a fresh sub-block of the program; rollback on exit."""
+
+    def __init__(self, main_program):
+        self.main_program = main_program
+
+    def __enter__(self):
+        self.main_program._create_block()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.main_program._rollback()
+        return exc_type is None
+
+
+def _external_io(program, block, skip=()):
+    """(reads, writes) of a block that refer to vars NOT defined locally in
+    it (transitively through nested sub-blocks). These become the inputs /
+    outputs of the structured op so dataflow analyses (backward relevance,
+    persistable-written) see through it."""
+    reads, writes = [], []
+    seen_r, seen_w = set(skip), set(skip)
+
+    def walk(b, local):
+        local = set(local) | set(b.vars)
+        for op in b.ops:
+            for n in op.input_arg_names():
+                if n and n not in local and n not in seen_r:
+                    seen_r.add(n)
+                    reads.append(n)
+            for n in op.output_arg_names():
+                if n and n not in local and n not in seen_w:
+                    seen_w.add(n)
+                    writes.append(n)
+            for key in ('sub_block', 'sub_block_false'):
+                idx = op.attrs.get(key)
+                if isinstance(idx, int):
+                    walk(program.block(idx), local)
+
+    walk(block, set())
+    return reads, writes
+
+
+# ---------------------------------------------------------------------------
+# While (ref control_flow.py While:504) → lax.while_loop
+# ---------------------------------------------------------------------------
+
+class While(object):
+    """with While(cond).block(): body ops. The body must update `cond`
+    (e.g. via less_than(..., cond=cond)); every var it writes that has a
+    pre-loop value becomes part of the loop carry."""
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.helper = LayerHelper("while", name=name)
+        if cond.dtype != 'bool':
+            raise TypeError("While condition must be a bool Variable, got %s"
+                            % cond.dtype)
+        self.cond_var = cond
+
+    def block(self):
+        return WhileGuard(self)
+
+
+class WhileGuard(BlockGuard):
+    def __init__(self, while_op):
+        super().__init__(while_op.helper.main_program)
+        self.while_op = while_op
+
+    def __enter__(self):
+        super().__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            self.main_program._rollback()
+            return False
+        program = self.main_program
+        sub = program.current_block()
+        program._rollback()
+        parent = program.current_block()
+        reads, writes = _external_io(program, sub)
+        parent.append_op(
+            type='while',
+            inputs={'Condition': [self.while_op.cond_var.name], 'X': reads},
+            outputs={'Out': writes},
+            attrs={'sub_block': sub.idx},
+            infer_shape=False)
+        return True
+
+
+# ---------------------------------------------------------------------------
+# StaticRNN (ref control_flow.py StaticRNN:278) → lax.scan, time-major
+# ---------------------------------------------------------------------------
+
+class StaticRNN(object):
+    """Fixed-length RNN over time-major inputs [T, B, ...]:
+
+        rnn = StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)          # x: [T, B, D] -> xt: [B, D]
+            h = rnn.memory(init=h0)         # or shape= + batch_ref=
+            nh = layers.fc([xt, h], size=H, act='tanh')
+            rnn.update_memory(h, nh)
+            rnn.output(nh)
+        out = rnn()                          # [T, B, H]
+    """
+
+    BEFORE_RNN_BLOCK = 0
+    IN_RNN_BLOCK = 1
+    AFTER_RNN_BLOCK = 2
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self.status = StaticRNN.BEFORE_RNN_BLOCK
+        self.step_inputs = []   # (outer Variable, inner Variable)
+        self.memories = []      # dict(init, pre, upd)
+        self.step_outputs = []  # (inner Variable, outer Variable)
+        self.seq_len = None
+
+    def step(self):
+        return _StaticRNNGuard(self)
+
+    def _assert_in_block(self):
+        if self.status != StaticRNN.IN_RNN_BLOCK:
+            raise RuntimeError(
+                "StaticRNN.memory/step_input/output must be called inside "
+                "`with rnn.step():`")
+
+    def _sub_block(self):
+        return self.helper.main_program.current_block()
+
+    def _parent_block(self):
+        return self.helper.main_program.block(self._sub_block().parent_idx)
+
+    def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
+               init_batch_dim_idx=0, ref_batch_dim_idx=1):
+        self._assert_in_block()
+        parent = self._parent_block()
+        if init is None:
+            if shape is None or batch_ref is None:
+                raise ValueError(
+                    "StaticRNN.memory needs either init= or shape= + "
+                    "batch_ref=")
+            shape = list(shape)
+            if not shape or shape[0] != -1:
+                shape = [-1] + shape
+            # batch_ref may be the INNER step-input var (the common fluid
+            # idiom); the init op lives in the parent block, so swap to the
+            # outer var. The inner var is [B, ...] (batch leading) while the
+            # outer is [T, B, ...]: idx 0 on the inner and the outer-style
+            # default of 1 both mean the batch axis, i.e. outer index 1.
+            for outer, inner in self.step_inputs:
+                if batch_ref.name == inner.name:
+                    batch_ref = outer
+                    ref_batch_dim_idx = (ref_batch_dim_idx + 1
+                                         if ref_batch_dim_idx == 0
+                                         else ref_batch_dim_idx)
+                    break
+            ref_dim = (batch_ref.shape[ref_batch_dim_idx]
+                       if batch_ref.shape is not None
+                       and len(batch_ref.shape) > ref_batch_dim_idx else -1)
+            if ref_dim not in (-1, None):
+                shape[init_batch_dim_idx] = int(ref_dim)
+            init = parent.create_var(
+                name=self.helper.name + '.mem_init%d' % len(self.memories),
+                shape=shape, dtype=batch_ref.dtype)
+            parent.append_op(
+                type='fill_constant_batch_size_like',
+                inputs={'Input': [batch_ref]}, outputs={'Out': [init]},
+                attrs={'shape': list(shape), 'value': float(init_value),
+                       'dtype': init.dtype,
+                       'input_dim_idx': ref_batch_dim_idx,
+                       'output_dim_idx': init_batch_dim_idx})
+        pre = self._sub_block().create_var(
+            name=self.helper.name + '.mem@%d' % len(self.memories),
+            shape=init.shape, dtype=init.dtype)
+        self.memories.append({'init': init, 'pre': pre, 'upd': None})
+        return pre
+
+    def step_input(self, x):
+        self._assert_in_block()
+        if self.seq_len is None:
+            self.seq_len = x.shape[0] if x.shape else -1
+        inner = self._sub_block().create_var(
+            name=self.helper.name + '.in@%d' % len(self.step_inputs),
+            shape=tuple(x.shape[1:]) if x.shape else None, dtype=x.dtype)
+        self.step_inputs.append((x, inner))
+        return inner
+
+    def step_output(self, o):
+        self._assert_in_block()
+        outer = self._parent_block().create_var(
+            name=self.helper.name + '.out@%d' % len(self.step_outputs),
+            shape=(self.seq_len if self.seq_len is not None else -1,)
+            + tuple(o.shape or ()),
+            dtype=o.dtype)
+        self.step_outputs.append((o, outer))
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def update_memory(self, mem, var):
+        self._assert_in_block()
+        for m in self.memories:
+            if m['pre'].name == mem.name:
+                m['upd'] = var
+                return
+        raise ValueError("update_memory: %r is not a StaticRNN memory"
+                         % mem.name)
+
+    def _complete(self, sub, parent):
+        program = self.helper.main_program
+        for m in self.memories:
+            if m['upd'] is None:
+                raise RuntimeError(
+                    "StaticRNN memory %r has no update_memory" %
+                    m['pre'].name)
+        x_names = [x.name for x, _ in self.step_inputs]
+        init_names = [m['init'].name for m in self.memories]
+        skip = set(x_names) | set(init_names)
+        reads, _ = _external_io(program, sub, skip=skip)
+        finals = [parent.create_var(
+            name=self.helper.name + '.final@%d' % i,
+            shape=m['init'].shape, dtype=m['init'].dtype)
+            for i, m in enumerate(self.memories)]
+        parent.append_op(
+            type='static_rnn',
+            inputs={'X': x_names, 'Init': init_names, 'Ex': reads},
+            outputs={'Out': [o.name for _, o in self.step_outputs],
+                     'Final': [f.name for f in finals]},
+            attrs={
+                'sub_block': sub.idx,
+                'rnn_step_inputs': [(x.name, i.name)
+                                    for x, i in self.step_inputs],
+                'rnn_memories': [(m['init'].name, m['pre'].name,
+                                  m['upd'].name) for m in self.memories],
+                'rnn_step_outputs': [(i.name, o.name)
+                                     for i, o in self.step_outputs],
+                'rnn_externals': list(reads),
+            },
+            infer_shape=False)
+
+    def __call__(self):
+        if self.status != StaticRNN.AFTER_RNN_BLOCK:
+            raise RuntimeError("StaticRNN outputs available after the step "
+                               "block closes")
+        outs = [o for _, o in self.step_outputs]
+        return outs[0] if len(outs) == 1 else outs
+
+
+class _StaticRNNGuard(BlockGuard):
+    def __init__(self, rnn):
+        super().__init__(rnn.helper.main_program)
+        self.rnn = rnn
+
+    def __enter__(self):
+        self.rnn.status = StaticRNN.IN_RNN_BLOCK
+        super().__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            self.main_program._rollback()
+            return False
+        program = self.main_program
+        sub = program.current_block()
+        program._rollback()
+        parent = program.current_block()
+        self.rnn._complete(sub, parent)
+        self.rnn.status = StaticRNN.AFTER_RNN_BLOCK
+        return True
+
+
+# ---------------------------------------------------------------------------
+# DynamicRNN (ref control_flow.py DynamicRNN:1395) → masked lax.scan
+# ---------------------------------------------------------------------------
+
+class DynamicRNN(object):
+    """Variable-length RNN over LoD inputs:
+
+        drnn = DynamicRNN()
+        with drnn.block():
+            word = drnn.step_input(emb)       # emb: LoD [sum, D]
+            prev = drnn.memory(shape=[H])     # or init= [nseq, H]
+            h = layers.fc([word, prev], size=H, act='relu')
+            drnn.update_memory(prev, h)
+            drnn.output(h)
+        out = drnn()                           # LoD [sum, H]
+
+    The reference sorts sequences by length and shrinks the batch per time
+    step (lod_tensor_to_array / shrink_memory); here the static LoD pads to
+    [nseq, max_len] and a mask freezes finished rows — same per-row math,
+    fully static shapes for XLA.
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("dynamic_rnn", name=name)
+        self.in_block = False
+        self.done = False
+        self.step_inputs = []    # (outer, inner)
+        self.static_inputs = []  # (outer, inner)
+        self.memories = []       # dict(init_name, pre, upd, shape, value, dtype)
+        self.step_outputs = []   # (inner, outer)
+        self._lod_source = None
+
+    def block(self):
+        return _DynamicRNNGuard(self)
+
+    def _assert_in_block(self):
+        if not self.in_block:
+            raise RuntimeError("DynamicRNN methods must be called inside "
+                               "`with drnn.block():`")
+
+    def _sub_block(self):
+        return self.helper.main_program.current_block()
+
+    def _parent_block(self):
+        return self.helper.main_program.block(self._sub_block().parent_idx)
+
+    def step_input(self, x, level=0):
+        self._assert_in_block()
+        if self._lod_source is None:
+            self._lod_source = x
+        inner = self._sub_block().create_var(
+            name=self.helper.name + '.in@%d' % len(self.step_inputs),
+            shape=(-1,) + tuple(x.shape[1:] if x.shape else ()),
+            dtype=x.dtype)
+        self.step_inputs.append((x, inner))
+        return inner
+
+    def static_input(self, x):
+        self._assert_in_block()
+        inner = self._sub_block().create_var(
+            name=self.helper.name + '.static@%d' % len(self.static_inputs),
+            shape=x.shape, dtype=x.dtype)
+        self.static_inputs.append((x, inner))
+        return inner
+
+    def memory(self, init=None, shape=None, value=0.0, need_reorder=False,
+               dtype='float32'):
+        self._assert_in_block()
+        i = len(self.memories)
+        if init is not None:
+            pre = self._sub_block().create_var(
+                name=self.helper.name + '.mem@%d' % i,
+                shape=init.shape, dtype=init.dtype)
+            self.memories.append({'init': init.name, 'pre': pre, 'upd': None,
+                                  'shape': None, 'value': 0.0,
+                                  'dtype': init.dtype})
+        else:
+            if shape is None:
+                raise ValueError("DynamicRNN.memory needs init= or shape=")
+            pre = self._sub_block().create_var(
+                name=self.helper.name + '.mem@%d' % i,
+                shape=(-1,) + tuple(shape), dtype=dtype)
+            self.memories.append({'init': '', 'pre': pre, 'upd': None,
+                                  'shape': tuple(int(s) for s in shape),
+                                  'value': float(value), 'dtype': dtype})
+        return pre
+
+    def update_memory(self, mem, new):
+        self._assert_in_block()
+        for m in self.memories:
+            if m['pre'].name == mem.name:
+                m['upd'] = new
+                return
+        raise ValueError("update_memory: %r is not a DynamicRNN memory"
+                         % mem.name)
+
+    def output(self, *outputs):
+        self._assert_in_block()
+        src = self._lod_source
+        for o in outputs:
+            outer = self._parent_block().create_var(
+                name=self.helper.name + '.out@%d' % len(self.step_outputs),
+                shape=(-1,) + tuple(o.shape[1:] if o.shape else ()),
+                dtype=o.dtype,
+                lod_level=max(src.lod_level, 1) if src is not None else 1)
+            self.step_outputs.append((o, outer))
+
+    def _complete(self, sub, parent):
+        program = self.helper.main_program
+        for m in self.memories:
+            if m['upd'] is None:
+                raise RuntimeError("DynamicRNN memory %r has no update_memory"
+                                   % m['pre'].name)
+        if not self.step_inputs:
+            raise RuntimeError("DynamicRNN needs at least one step_input")
+        x_names = [x.name for x, _ in self.step_inputs]
+        static_names = [x.name for x, _ in self.static_inputs]
+        init_names = [m['init'] for m in self.memories]
+        skip = (set(x_names) | set(static_names)
+                | set(n for n in init_names if n))
+        reads, _ = _external_io(program, sub, skip=skip)
+        parent.append_op(
+            type='dynamic_rnn',
+            inputs={'X': x_names, 'Static': static_names,
+                    'Init': init_names, 'Ex': reads},
+            outputs={'Out': [o.name for _, o in self.step_outputs]},
+            attrs={
+                'sub_block': sub.idx,
+                'rnn_step_inputs': [(x.name, i.name)
+                                    for x, i in self.step_inputs],
+                'rnn_static_inputs': [(x.name, i.name)
+                                      for x, i in self.static_inputs],
+                'rnn_memories': [(m['init'], m['pre'].name, m['upd'].name,
+                                  m['shape'], m['value'], m['dtype'])
+                                 for m in self.memories],
+                'rnn_step_outputs': [(i.name, o.name)
+                                     for i, o in self.step_outputs],
+                'rnn_externals': list(reads),
+            },
+            infer_shape=False)
+
+    def __call__(self):
+        if not self.done:
+            raise RuntimeError("DynamicRNN outputs available after the block "
+                               "closes")
+        outs = [o for _, o in self.step_outputs]
+        return outs[0] if len(outs) == 1 else outs
+
+
+class _DynamicRNNGuard(BlockGuard):
+    def __init__(self, rnn):
+        super().__init__(rnn.helper.main_program)
+        self.rnn = rnn
+
+    def __enter__(self):
+        self.rnn.in_block = True
+        super().__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            self.rnn.in_block = False
+            self.main_program._rollback()
+            return False
+        program = self.main_program
+        sub = program.current_block()
+        program._rollback()
+        parent = program.current_block()
+        self.rnn.in_block = False
+        self.rnn._complete(sub, parent)
+        self.rnn.done = True
+        return True
+
+
+# ---------------------------------------------------------------------------
+# IfElse (row-level cond; dense compute-both + rowwise select merge)
+# ---------------------------------------------------------------------------
+
+class IfElse(object):
+    """Row-conditional computation:
+
+        ie = IfElse(cond)            # cond: [N, 1] bool
+        with ie.true_block():
+            ie.output(f(ie.input(x)))
+        with ie.false_block():
+            ie.output(g(ie.input(x)))
+        merged, = ie()               # rowwise cond ? f(x) : g(x)
+
+    The reference physically splits rows into two sub-blocks and merges
+    (split_lod_tensor/merge_lod_tensor); computing both branches over the
+    full batch and selecting per row is numerically identical for the
+    row-wise ops that pattern requires, and keeps shapes static for XLA."""
+
+    OUT_IF_ELSE_BLOCKS = 2
+
+    def __init__(self, cond, name=None):
+        self.helper = LayerHelper('ifelse', name=name)
+        self.cond = cond
+        self._branch = None
+        self._outs = {True: [], False: []}
+
+    def input(self, x):
+        if self._branch is None:
+            raise RuntimeError("IfElse.input must be called inside a branch")
+        return x
+
+    def output(self, *outs):
+        if self._branch is None:
+            raise RuntimeError("IfElse.output must be called inside a branch")
+        self._outs[self._branch].extend(outs)
+
+    def true_block(self):
+        return _IfElseBranch(self, True)
+
+    def false_block(self):
+        return _IfElseBranch(self, False)
+
+    def __call__(self):
+        t, f = self._outs[True], self._outs[False]
+        if len(t) != len(f):
+            raise ValueError(
+                "IfElse branches produced different output counts: %d vs %d"
+                % (len(t), len(f)))
+        merged = []
+        for tv, fv in zip(t, f):
+            out = self.helper.create_variable_for_type_inference(tv.dtype)
+            self.helper.append_op(
+                type='select',
+                inputs={'Cond': [self.cond], 'X': [tv], 'Y': [fv]},
+                outputs={'Out': [out]})
+            merged.append(out)
+        return merged
+
+
+class _IfElseBranch(object):
+    def __init__(self, ie, branch):
+        self.ie = ie
+        self.branch = branch
+
+    def __enter__(self):
+        self.ie._branch = self.branch
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.ie._branch = None
+        return exc_type is None
+
+
+# ---------------------------------------------------------------------------
+# Switch (ref control_flow.py Switch:1139) — scalar-cond case chain
+# ---------------------------------------------------------------------------
+
+class Switch(object):
+    """Scalar-condition case chain (the LR-scheduler workhorse):
+
+        with switch.case(cond1): assign(a, lr)
+        with switch.case(cond2): assign(b, lr)
+        with switch.default():   assign(c, lr)
+
+    Each case's writes merge with the prior value under the effective
+    condition (cond_i AND no earlier case fired) — a where-chain instead of
+    the reference's conditional_block sub-graphs. Targets must have a value
+    before the switch (true for the LR pattern, which fills the var first)."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper('switch', name=name)
+        self._any_prev = None   # bool var: some earlier case matched
+
+    def case(self, condition):
+        return _SwitchCase(self, condition)
+
+    def default(self):
+        return _SwitchCase(self, None)
+
+
+class _SwitchCase(object):
+    def __init__(self, switch, condition):
+        self.switch = switch
+        self.condition = condition
+
+    def __enter__(self):
+        helper = self.switch.helper
+        block = helper.main_program.current_block()
+        prev = self.switch._any_prev
+        if self.condition is None:
+            if prev is None:
+                raise RuntimeError("Switch.default with no preceding case")
+            eff = _logical('logical_not', prev)
+        else:
+            cond = self.condition
+            eff = cond if prev is None else \
+                _logical('logical_and', cond, _logical('logical_not', prev))
+            self.switch._any_prev = cond if prev is None else \
+                _logical('logical_or', prev, cond)
+        self._eff = eff
+        self._start = len(block.ops)
+        self._block = block
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        block = self._block
+        helper = self.switch.helper
+        # merge only writes to vars that already had a value before this
+        # case (written by an earlier op, fed, or persistable); everything
+        # else is a case-local temporary that needs no select
+        prior = set()
+        for op in block.ops[:self._start]:
+            prior.update(op.output_arg_names())
+        written = []
+        for op in block.ops[self._start:]:
+            for n in op.output_arg_names():
+                if n in written:
+                    continue
+                v = block._find_var_recursive(n)
+                if (n in prior or (v is not None and
+                                   (v.persistable or v.is_data))):
+                    written.append(n)
+        # save pre-case values, then merge each write under the case cond
+        for k, name in enumerate(written):
+            saved = block.create_var(
+                name=helper.name + '.save.' + name,
+                shape=block.var(name).shape, dtype=block.var(name).dtype)
+            block.insert_op(self._start + k, type='assign',
+                            inputs={'X': [name]}, outputs={'Out': [saved]},
+                            infer_shape=False)
+        for name in written:
+            saved = helper.name + '.save.' + name
+            block.append_op(
+                type='select',
+                inputs={'Cond': [self._eff], 'X': [name], 'Y': [saved]},
+                outputs={'Out': [name]}, infer_shape=False)
+        return True
+
+
+def _logical(op_type, x, y=None):
+    helper = LayerHelper(op_type)
+    out = helper.create_variable_for_type_inference('bool')
+    out.stop_gradient = True
+    ins = {'X': [x]} if y is None else {'X': [x], 'Y': [y]}
+    helper.append_op(type=op_type, inputs=ins, outputs={'Out': [out]})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TensorArray layer functions (ref control_flow.py array_write:960,
+# array_read:1030, array_length, create_array; lod_rank_table:821,
+# max_sequence_len, lod_tensor_to_array, array_to_lod_tensor,
+# reorder_lod_tensor_by_rank, shrink_memory)
+# ---------------------------------------------------------------------------
+
+def create_array(dtype, capacity=0):
+    helper = LayerHelper('array')
+    out = helper.main_program.current_block().create_var(
+        name=helper.name, shape=None, dtype=dtype, type='tensor_array')
+    helper.append_op(type='create_array', inputs={}, outputs={'Out': [out]},
+                     attrs={'capacity': int(capacity)}, infer_shape=False)
+    return out
+
+
+def array_write(x, i, array=None):
+    helper = LayerHelper('array_write')
+    if array is None:
+        array = helper.main_program.current_block().create_var(
+            name=helper.name, shape=None, dtype=x.dtype, type='tensor_array')
+    if array.shape is None and x.shape is not None:
+        array.shape = tuple(x.shape)  # element shape, for array_read infer
+    helper.append_op(type='write_to_array',
+                     inputs={'X': [x], 'I': [i]},
+                     outputs={'Out': [array]}, infer_shape=False)
+    return array
+
+
+def array_read(array, i):
+    helper = LayerHelper('array_read')
+    out = helper.create_variable_for_type_inference(array.dtype)
+    if array.shape is not None:
+        out.shape = tuple(array.shape)
+    helper.append_op(type='read_from_array',
+                     inputs={'X': [array], 'I': [i]},
+                     outputs={'Out': [out]}, infer_shape=False)
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper('array_length')
+    out = helper.create_variable_for_type_inference('int64')
+    out.shape = (1,)
+    out.stop_gradient = True
+    helper.append_op(type='lod_array_length', inputs={'X': [array]},
+                     outputs={'Out': [out]}, infer_shape=False)
+    return out
+
+
+def lod_rank_table(x, level=0):
+    helper = LayerHelper('lod_rank_table')
+    table = helper.main_program.current_block().create_var(
+        name=helper.name, shape=None, dtype='int64', type='raw')
+    table.stop_gradient = True
+    helper.append_op(type='lod_rank_table', inputs={'X': [x]},
+                     outputs={'Out': [table]}, attrs={'level': int(level)},
+                     infer_shape=False)
+    return table
+
+
+def max_sequence_len(rank_table):
+    helper = LayerHelper('max_seqence_len')
+    out = helper.create_variable_for_type_inference('int32')
+    out.shape = (1,)
+    out.stop_gradient = True
+    helper.append_op(type='max_sequence_len',
+                     inputs={'RankTable': [rank_table]},
+                     outputs={'Out': [out]}, infer_shape=False)
+    return out
+
+
+def lod_tensor_to_array(x, table):
+    helper = LayerHelper('lod_tensor_to_array')
+    array = helper.main_program.current_block().create_var(
+        name=helper.name, shape=None, dtype=x.dtype, type='tensor_array')
+    helper.append_op(type='lod_tensor_to_array',
+                     inputs={'X': [x], 'RankTable': [table]},
+                     outputs={'Out': [array]}, infer_shape=False)
+    return array
+
+
+def array_to_lod_tensor(x, table):
+    helper = LayerHelper('array_to_lod_tensor')
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.lod_level = 1
+    helper.append_op(type='array_to_lod_tensor',
+                     inputs={'X': [x], 'RankTable': [table]},
+                     outputs={'Out': [out]}, infer_shape=False)
+    return out
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    helper = LayerHelper('reorder_lod_tensor_by_rank')
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.lod_level = x.lod_level
+    helper.append_op(type='reorder_lod_tensor_by_rank',
+                     inputs={'X': [x], 'RankTable': [rank_table]},
+                     outputs={'Out': [out]}, infer_shape=False)
+    return out
+
+
+def shrink_memory(x, i, table):
+    helper = LayerHelper('shrink_memory')
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='shrink_rnn_memory',
+                     inputs={'X': [x], 'I': [i], 'RankTable': [table]},
+                     outputs={'Out': [out]}, infer_shape=False)
     return out

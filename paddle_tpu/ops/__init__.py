@@ -10,5 +10,6 @@ from . import nn_ops          # noqa: F401
 from . import optimizer_ops   # noqa: F401
 from . import metric_ops      # noqa: F401
 from . import control_ops     # noqa: F401
+from . import array_ops       # noqa: F401
 from . import sequence_ops    # noqa: F401
 from . import rnn_ops         # noqa: F401

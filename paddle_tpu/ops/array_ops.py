@@ -1,0 +1,163 @@
+"""TensorArray / rank-table op lowerings
+(ref: operators/controlflow/tensor_array_read_write_op.cc,
+lod_rank_table_op.cc, lod_tensor_to_array_op.cc, array_to_lod_tensor_op.cc,
+reorder_lod_tensor_by_rank_op.cc, lod_array_length_op.cc,
+shrink_rnn_memory_op.cc, max_sequence_len_op.cc).
+
+TPU-native re-design: the reference mutates a host vector of LoDTensors with
+dynamic shapes; here a TensorArray is a fixed-capacity device buffer
+(core/tensor_array.py) so every op below is a static-shape XLA program, and
+the rank table is pure host metadata derived from the static LoD.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+from ..core.lod import LoDArray, unwrap, lengths_to_offsets
+from ..core.tensor_array import TensorArrayVal, RankTable
+
+
+def _scalar_i(v):
+    return jnp.asarray(unwrap(v), jnp.int32).reshape(())
+
+
+@register('create_array', no_grad=True, lod='aware')
+def _create_array(ctx, ins):
+    return {'Out': [TensorArrayVal.empty(int(ctx.attr('capacity', 0) or 0))]}
+
+
+@register('write_to_array', no_grad=True, lod='aware')
+def _write_to_array(ctx, ins):
+    x = unwrap(ins['X'][0])
+    i = _scalar_i(ins['I'][0])
+    out_name = ctx.op.outputs['Out'][0]
+    arr = ctx.tracer.env.get(out_name)
+    if not isinstance(arr, TensorArrayVal):
+        arr = TensorArrayVal.empty(int(ctx.attr('capacity', 0) or 0))
+    return {'Out': [arr.write(i, x)]}
+
+
+@register('read_from_array', no_grad=True, lod='aware')
+def _read_from_array(ctx, ins):
+    arr = ins['X'][0]
+    if not isinstance(arr, TensorArrayVal):
+        raise TypeError("array_read input is not a TensorArray: %r" % (arr,))
+    return {'Out': [arr.read(_scalar_i(ins['I'][0]))]}
+
+
+@register('lod_array_length', no_grad=True, lod='aware')
+def _lod_array_length(ctx, ins):
+    arr = ins['X'][0]
+    return {'Out': [jnp.asarray(arr.length, jnp.int64
+                                if jax.config.jax_enable_x64 else jnp.int32)
+                    .reshape(1)]}
+
+
+@register('lod_rank_table', no_grad=True, lod='aware')
+def _lod_rank_table(ctx, ins):
+    x = ins['X'][0]
+    if not (isinstance(x, LoDArray) and x.lod):
+        # dense input: every "sequence" is one row
+        n = unwrap(x).shape[0]
+        return {'Out': [RankTable(np.arange(n + 1))]}
+    level = int(ctx.attr('level', 0))
+    return {'Out': [RankTable(x.lod[level])]}
+
+
+@register('max_sequence_len', no_grad=True, lod='aware')
+def _max_sequence_len(ctx, ins):
+    table = ins['RankTable'][0]
+    return {'Out': [jnp.asarray(table.max_len, jnp.int32).reshape(1)]}
+
+
+@register('lod_tensor_to_array', no_grad=True, lod='aware')
+def _lod_tensor_to_array(ctx, ins):
+    """Element t = rows of every sequence at time step t, in rank order
+    (longest first), zero-padded for finished sequences. The reference
+    shrinks the batch as sequences end (dynamic shapes); static padding is
+    the XLA-friendly equivalent — masking keeps the math identical for the
+    rowwise step ops these arrays feed."""
+    x = ins['X'][0]
+    table = ins['RankTable'][0]
+    data = unwrap(x)
+    off = np.asarray(x.lod[0] if isinstance(x, LoDArray) and x.lod
+                     else np.arange(data.shape[0] + 1), np.int64)
+    order, lens = table.order, table.lengths
+    n, L = len(order), table.max_len
+    gather = np.zeros((L, n), np.int32)
+    for rank, (seq, ln) in enumerate(zip(order, lens)):
+        for t in range(ln):
+            gather[t, rank] = off[seq] + t
+    rows = jnp.take(data, jnp.asarray(gather.reshape(-1)), axis=0)
+    buf = rows.reshape((L, n) + data.shape[1:])
+    mask = np.zeros((L, n), bool)
+    for rank, ln in enumerate(lens):
+        mask[:ln, rank] = True
+    buf = buf * jnp.asarray(mask, buf.dtype).reshape((L, n) +
+                                                     (1,) * (buf.ndim - 2))
+    return {'Out': [TensorArrayVal(buf, jnp.asarray(L, jnp.int32), L)]}
+
+
+@register('array_to_lod_tensor', no_grad=True, lod='aware')
+def _array_to_lod_tensor(ctx, ins):
+    """Inverse of lod_tensor_to_array: scatter time-major rank-ordered array
+    elements back into packed LoD rows in the original sequence order."""
+    arr = ins['X'][0]
+    table = ins['RankTable'][0]
+    order, lens = table.order, table.lengths
+    n = len(order)
+    data = arr.stack()  # [L, n, ...]
+    total = int(sum(lens))
+    gather = np.zeros(total, np.int32)
+    out_lens = [0] * n
+    for rank, (seq, ln) in enumerate(zip(order, lens)):
+        out_lens[seq] = ln
+    off = lengths_to_offsets(out_lens)
+    for rank, (seq, ln) in enumerate(zip(order, lens)):
+        for t in range(ln):
+            gather[off[seq] + t] = t * n + rank
+    flat = data.reshape((-1,) + data.shape[2:])
+    rows = jnp.take(flat, jnp.asarray(gather), axis=0)
+    return {'Out': [LoDArray(rows, [off])]}
+
+
+@register('reorder_lod_tensor_by_rank', no_grad=True, lod='aware')
+def _reorder_lod_tensor_by_rank(ctx, ins):
+    x = ins['X'][0]
+    table = ins['RankTable'][0]
+    data = unwrap(x)
+    if isinstance(x, LoDArray) and x.lod:
+        off = np.asarray(x.lod[0], np.int64)
+        idx, new_lens = [], []
+        for seq in table.order:
+            idx.extend(range(int(off[seq]), int(off[seq + 1])))
+            new_lens.append(int(off[seq + 1] - off[seq]))
+        rows = jnp.take(data, jnp.asarray(idx, dtype=jnp.int32), axis=0)
+        return {'Out': [LoDArray(rows, [lengths_to_offsets(new_lens)])]}
+    rows = jnp.take(data, jnp.asarray(table.order, dtype=jnp.int32), axis=0)
+    return {'Out': [rows]}
+
+
+@register('shrink_rnn_memory', no_grad=True, lod='aware')
+def _shrink_rnn_memory(ctx, ins):
+    """The reference trims the memory batch to sequences still alive at step
+    I (dynamic shape). Static design keeps the full batch — finished rows are
+    masked by the consuming loop — so this is the identity."""
+    return {'Out': [ins['X'][0]]}
+
+
+@register('tensor_array_to_tensor', no_grad=True, lod='aware')
+def _tensor_array_to_tensor(ctx, ins):
+    arr = ins['X'][0]
+    axis = int(ctx.attr('axis', 0))
+    data = arr.stack()  # [cap, *elem]
+    if ctx.attr('use_stack', True):
+        out = jnp.moveaxis(data, 0, axis) if axis else data
+    else:
+        out = jnp.concatenate([data[i] for i in range(data.shape[0])],
+                              axis=axis)
+    return {'Out': [out],
+            'OutIndex': [jnp.asarray(arr.length, jnp.int32).reshape(1)]}

@@ -1,13 +1,238 @@
-"""Small control/scalar ops (ref: operators/controlflow/, increment_op.cc).
+"""Control-flow op lowerings (ref: operators/controlflow/while_op.cc:50,
+conditional_block_op.cc, recurrent_op.cc, increment_op.cc).
 
-The heavyweight control flow (while / conditional_block) lowers to
-lax.while_loop / lax.cond in sequence_ops/control_flow lowering."""
+TPU-native design: the reference interprets sub-blocks against nested scopes
+per iteration; here each structured op lowers to ONE XLA control-flow op —
+`while` → lax.while_loop with an explicit carry (the sub-block's writes that
+are visible outside), `static_rnn`/`dynamic_rnn` → lax.scan (differentiable,
+so the generic vjp grad path covers their backward with no per-op grad
+code), `conditional_block` → dense compute-both + select (scalar-predicate
+blocks stay fusible; no divergent branches on the MXU)."""
 from __future__ import annotations
 
+import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ..core.registry import register
+from ..core.lod import LoDArray, unwrap
+from ..core.tensor_array import TensorArrayVal
 from .math_ops import X
+
+
+def _written_names(program, block):
+    """All var names written by a block, transitively through nested
+    sub-blocks (control-flow ops store the child index in attrs)."""
+    out = set()
+    for op in block.ops:
+        out.update(n for n in op.output_arg_names() if n)
+        for key in ('sub_block', 'sub_block_false'):
+            idx = op.attrs.get(key)
+            if isinstance(idx, int):
+                out.update(_written_names(program, program.block(idx)))
+    return out
+
+
+def _select_val(pred, new, old):
+    """Scalar-predicate select over any runtime value kind."""
+    if isinstance(new, LoDArray) or isinstance(old, LoDArray):
+        nd, od = unwrap(new), unwrap(old)
+        lod = new.lod if isinstance(new, LoDArray) else old.lod
+        return LoDArray(jnp.where(pred, nd, od), lod)
+    if isinstance(new, TensorArrayVal):
+        return TensorArrayVal(jnp.where(pred, new.data, old.data),
+                              jnp.where(pred, new.length, old.length),
+                              new.capacity)
+    return jnp.where(pred, new, jnp.asarray(old, new.dtype)
+                     if hasattr(new, 'dtype') else old)
+
+
+@register('while', no_grad=True, lod='aware')
+def _while(ctx, ins):
+    """lax.while_loop over the sub-block. Carry = sub-block writes that have
+    a pre-loop value (everything else is a loop-local temporary recomputed
+    each iteration). Decode-style loops (beam search) are the target; grads
+    flow through scan-based RNN ops instead (reverse-mode while is
+    unbounded-memory by construction)."""
+    tracer = ctx.tracer
+    program = tracer.program
+    sub_idx = int(ctx.attr('sub_block'))
+    sub = program.block(sub_idx)
+    cond_name = ctx.op.inputs['Condition'][0]
+
+    written = _written_names(program, sub)
+    carry_names = sorted(n for n in written if n in tracer.env)
+    if cond_name not in carry_names:
+        raise RuntimeError(
+            "While loop condition %r is never updated inside the loop body "
+            "— the loop would not terminate" % cond_name)
+    init = {n: tracer.env[n] for n in carry_names}
+    for n, v in init.items():
+        if isinstance(v, TensorArrayVal) and v.data is None:
+            raise RuntimeError(
+                "TensorArray %r enters a While loop unallocated; write an "
+                "element before the loop or create it with capacity + an "
+                "initial write so its buffer shape is static" % n)
+
+    def cond_fn(carry):
+        return jnp.reshape(unwrap(carry[cond_name]), ())
+
+    def body_fn(carry):
+        benv = dict(tracer.env)
+        benv.update(carry)
+        tracer.run_block(sub, benv)
+        return {n: benv[n] for n in carry_names}
+
+    out = jax.lax.while_loop(cond_fn, body_fn, init)
+    for n, v in out.items():
+        tracer.write(n, v)
+    return {}
+
+
+@register('conditional_block', no_grad=True, lod='aware')
+def _conditional_block(ctx, ins):
+    """Dense lowering: the sub-block runs unconditionally and each write is
+    merged with its prior value under the scalar predicate. Identical math
+    for the side-effect-free ops the IR allows, and XLA fuses the selects."""
+    tracer = ctx.tracer
+    program = tracer.program
+    sub_idx = int(ctx.attr('sub_block'))
+    sub = program.block(sub_idx)
+    pred = jnp.reshape(unwrap(ins['Cond'][0]), ())
+
+    benv = dict(tracer.env)
+    tracer.run_block(sub, benv)
+    for n in sorted(_written_names(program, sub)):
+        if n not in benv:
+            continue
+        new = benv[n]
+        old = tracer.env.get(n)
+        if old is None:
+            tracer.write(n, new)
+        elif new is not old:
+            tracer.write(n, _select_val(pred, new, old))
+    return {}
+
+
+# ---------------------------------------------------------------------------
+# Recurrent sub-block ops: StaticRNN / DynamicRNN → lax.scan
+# (ref: operators/recurrent_op.cc, python/paddle/fluid/layers/
+# control_flow.py StaticRNN:278, DynamicRNN:1395).
+# ---------------------------------------------------------------------------
+
+def _pad_time_major(x):
+    """LoDArray [sum, D] -> (xs [L, B, D], mask [L, B]) via the static lod."""
+    from .rnn_ops import _pad_from_lod
+    off = np.asarray(x.lod[0], np.int64)
+    padded, mask = _pad_from_lod(unwrap(x), off)   # [B, L, D], [B, L]
+    return jnp.moveaxis(padded, 1, 0), jnp.moveaxis(mask, 1, 0)
+
+
+def _unpad_time_major(ys, lod):
+    """[L, B, D] -> packed LoD rows [sum, D]."""
+    from .rnn_ops import _unpad_to_lod
+    off = np.asarray(lod[0], np.int64)
+    return LoDArray(_unpad_to_lod(jnp.moveaxis(ys, 0, 1), off), lod)
+
+
+def _run_step_block(ctx, sub_idx, bindings):
+    env = dict(ctx.tracer.env)
+    env.update(bindings)
+    ctx.run_block(sub_idx, env)
+    return env
+
+
+@register('static_rnn', lod='aware')
+def _static_rnn(ctx, ins):
+    """Time-major scan: step inputs are [T, ...] tensors sliced per step.
+    Differentiable end-to-end (scan), so append_backward's generic grad op
+    covers the reference's RecurrentGradOp."""
+    a = ctx.attrs
+    sub_idx = int(a['sub_block'])
+    step_inputs = a['rnn_step_inputs']    # [(outer, inner)]
+    memories = a['rnn_memories']          # [(init_outer, pre_inner, upd_inner)]
+    step_outputs = a['rnn_step_outputs']  # [(inner, outer)]
+    ex_names = list(a.get('rnn_externals', ()))
+
+    xs = [unwrap(v) for v in ins.get('X', [])]
+    init = [unwrap(v) for v in ins.get('Init', [])]
+    exs = dict(zip(ex_names, ins.get('Ex', [])))
+
+    def body(carry, xts):
+        bind = dict(exs)
+        for (_, inner), xt in zip(step_inputs, xts):
+            bind[inner] = xt
+        for (_, pre, _), c in zip(memories, carry):
+            bind[pre] = c
+        env = _run_step_block(ctx, sub_idx, bind)
+        new_carry = [env[upd] for (_, _, upd) in memories]
+        ys = [env[inner] for (inner, _) in step_outputs]
+        return new_carry, ys
+
+    final, ys = jax.lax.scan(body, init, xs)
+    return {'Out': ys, 'Final': final}
+
+
+@register('dynamic_rnn', lod='aware')
+def _dynamic_rnn(ctx, ins):
+    """LoD-aware scan: variable-length sequences padded (static lod → static
+    max_len), memories masked frozen past each sequence's end, outputs packed
+    back to LoD rows. The reference instead sorts by length and shrinks the
+    batch per step (lod_tensor_to_array / shrink_memory) — dynamic shapes
+    XLA can't tile; masking is the TPU-native equivalent with the same
+    per-row math."""
+    a = ctx.attrs
+    sub_idx = int(a['sub_block'])
+    step_inputs = a['rnn_step_inputs']
+    static_inputs = a.get('rnn_static_inputs', ())  # [(outer, inner)]
+    memories = a['rnn_memories']
+    step_outputs = a['rnn_step_outputs']
+    ex_names = list(a.get('rnn_externals', ()))
+
+    x0 = ins['X'][0]
+    if not (isinstance(x0, LoDArray) and x0.lod):
+        raise TypeError("dynamic_rnn step_input must be a LoD tensor")
+    lod = x0.lod
+    xs_mask = [_pad_time_major(v) for v in ins['X']]
+    xs = [p for p, _ in xs_mask]
+    mask = xs_mask[0][1]                     # [L, B]
+    nseq = xs[0].shape[1]
+
+    init = []
+    for spec, v in zip(memories, ins.get('Init', [])):
+        if v is None:
+            shape, value, dtype = spec[3], spec[4], spec[5]
+            init.append(jnp.full((nseq,) + tuple(shape), value,
+                                 jnp.dtype(dtype)))
+        else:
+            init.append(unwrap(v))
+    exs = dict(zip(ex_names, ins.get('Ex', [])))
+    statics = {inner: unwrap(v)
+               for (_, inner), v in zip(static_inputs, ins.get('Static', []))}
+
+    def body(carry, scan_in):
+        xts, m_t = scan_in
+        bind = dict(exs)
+        bind.update(statics)
+        for (_, inner), xt in zip(step_inputs, xts):
+            bind[inner] = xt
+        for spec, c in zip(memories, carry):
+            bind[spec[1]] = c
+        env = _run_step_block(ctx, sub_idx, bind)
+        new_carry = []
+        for spec, c in zip(memories, carry):
+            new = env[spec[2]]
+            keep = m_t.reshape((-1,) + (1,) * (new.ndim - 1))
+            new_carry.append(jnp.where(keep, new, c))
+        ys = [env[inner] for (inner, _) in step_outputs]
+        return new_carry, ys
+
+    _, ys = jax.lax.scan(body, init, (xs, mask))
+    outs = []
+    for y in ys:
+        keep = mask.reshape(mask.shape + (1,) * (y.ndim - 2))
+        outs.append(_unpad_time_major(y * keep.astype(y.dtype), lod))
+    return {'Out': outs}
 
 
 @register('increment', no_grad=True, lod='none')
@@ -20,8 +245,13 @@ def _increment(ctx, ins):
 def _select(ctx, ins):
     cond = ins['Cond'][0]
     x, y = ins['X'][0], ins['Y'][0]
-    return {'Out': [jnp.where(cond.reshape([1] * x.ndim) if cond.ndim < x.ndim
-                              else cond, x, y)]}
+    # per-row semantics: align cond rank to x by dropping trailing 1-dims
+    # (e.g. [N,1] cond over [N] values) or adding broadcast dims
+    while cond.ndim > x.ndim and cond.shape[-1] == 1:
+        cond = cond.reshape(cond.shape[:-1])
+    if cond.ndim < x.ndim:
+        cond = cond.reshape(cond.shape + (1,) * (x.ndim - cond.ndim))
+    return {'Out': [jnp.where(cond, x, y)]}
 
 
 @register('is_empty', no_grad=True, lod='none')

@@ -1,0 +1,304 @@
+"""Control flow + tensor arrays: While → lax.while_loop, StaticRNN /
+DynamicRNN → lax.scan, IfElse/Switch dense selects, array ops.
+
+Reference coverage model: unittests/test_while_op.py,
+test_dynrnn_static_input.py, test_dyn_rnn.py, test_array_read_write_op.py,
+test_lod_rank_table.py, test_switch.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import create_lod_array
+
+
+def _run(fetch, feed=None, startup=True):
+    exe = fluid.Executor(fluid.CPUPlace())
+    if startup:
+        exe.run(fluid.default_startup_program())
+    return exe.run(feed=feed or {}, fetch_list=fetch)
+
+
+# ---------------------------------------------------------------------------
+# While
+# ---------------------------------------------------------------------------
+
+def test_while_counter_sum():
+    """sum 0..9 with a While loop over scalar carries."""
+    layers = fluid.layers
+    i = layers.fill_constant(shape=[1], dtype='int64', value=0)
+    limit = layers.fill_constant(shape=[1], dtype='int64', value=10)
+    total = layers.fill_constant(shape=[1], dtype='float32', value=0.0)
+    cond = layers.less_than(x=i, y=limit)
+    w = layers.While(cond=cond)
+    with w.block():
+        casted = layers.cast(i, 'float32')
+        layers.assign(layers.elementwise_add(total, casted), total)
+        layers.increment(i, value=1, in_place=True)
+        layers.less_than(x=i, y=limit, cond=cond)
+    out, = _run([total], startup=False)
+    assert out[0] == pytest.approx(45.0)
+
+
+def test_while_with_tensor_array():
+    """Decode-style loop: write i^2 vectors into a TensorArray, stack."""
+    layers = fluid.layers
+    i = layers.fill_constant(shape=[1], dtype='int64', value=0)
+    limit = layers.fill_constant(shape=[1], dtype='int64', value=5)
+    x = layers.fill_constant(shape=[3], dtype='float32', value=1.0)
+    arr = layers.array_write(x, i)  # initial write sizes the buffer
+    layers.increment(i, value=1, in_place=True)
+    cond = layers.less_than(x=i, y=limit)
+    w = layers.While(cond=cond)
+    with w.block():
+        prev = layers.array_read(arr, layers.elementwise_sub(
+            i, layers.fill_constant([1], 'int64', 1)))
+        nxt = layers.scale(prev, scale=2.0)
+        layers.array_write(nxt, i, array=arr)
+        layers.increment(i, value=1, in_place=True)
+        layers.less_than(x=i, y=limit, cond=cond)
+    length = layers.array_length(arr)
+    last = layers.array_read(arr, layers.elementwise_sub(
+        i, layers.fill_constant([1], 'int64', 1)))
+    ln, last_v = _run([length, last], startup=False)
+    assert ln[0] == 5
+    np.testing.assert_allclose(last_v, np.full(3, 16.0), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# StaticRNN
+# ---------------------------------------------------------------------------
+
+def test_static_rnn_matches_numpy():
+    """h_t = tanh(x_t W + h_{t-1} U + b) against a numpy loop."""
+    layers = fluid.layers
+    T, B, D, H = 4, 3, 5, 6
+    x = layers.data(name='x', shape=[T, B, D], dtype='float32',
+                    append_batch_size=False)
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        xt = rnn.step_input(x)
+        h = rnn.memory(shape=[H], batch_ref=xt, init_value=0.0,
+                       ref_batch_dim_idx=0)
+        nh = layers.fc(input=[xt, h], size=H, act='tanh',
+                       bias_attr=fluid.ParamAttr(
+                           initializer=fluid.initializer.Constant(0.1)))
+        rnn.update_memory(h, nh)
+        rnn.output(nh)
+    out = rnn()
+    assert out.shape[0] == T
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xs = np.random.RandomState(0).randn(T, B, D).astype(np.float32)
+    y, = exe.run(feed={'x': xs}, fetch_list=[out])
+    assert y.shape == (T, B, H)
+
+    # pull the fc weights to replay in numpy
+    scope = fluid.global_scope()
+    params = [n for n in scope.local_var_names() if 'w_' in n or '.b_' in n]
+    ws = sorted(n for n in params if 'w_' in n)
+    bs = [n for n in params if '.b_' in n]
+    w0 = np.asarray(scope.get(ws[0]))
+    w1 = np.asarray(scope.get(ws[1]))
+    b = np.asarray(scope.get(bs[0]))
+    h = np.zeros((B, H), np.float32)
+    for t in range(T):
+        h = np.tanh(xs[t] @ w0 + h @ w1 + b)
+    np.testing.assert_allclose(y[-1], h, rtol=1e-4, atol=1e-5)
+
+
+def test_static_rnn_trains():
+    """Gradients flow through the scan: loss decreases."""
+    layers = fluid.layers
+    T, B, D, H = 5, 8, 4, 8
+    x = layers.data(name='x', shape=[T, B, D], dtype='float32',
+                    append_batch_size=False)
+    target = layers.data(name='t', shape=[B, 1], dtype='float32',
+                         append_batch_size=False)
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        xt = rnn.step_input(x)
+        h = rnn.memory(shape=[H], batch_ref=xt, ref_batch_dim_idx=0)
+        nh = layers.fc(input=[xt, h], size=H, act='tanh')
+        rnn.update_memory(h, nh)
+        rnn.output(nh)
+    seq = rnn()
+    last = layers.slice(seq, axes=[0], starts=[T - 1], ends=[T])
+    last = layers.reshape(last, [B, H])
+    pred = layers.fc(input=last, size=1)
+    loss = layers.mean(layers.square_error_cost(input=pred, label=target))
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(1)
+    xs = rng.randn(T, B, D).astype(np.float32)
+    ts = rng.randn(B, 1).astype(np.float32)
+    losses = [float(exe.run(feed={'x': xs, 't': ts},
+                            fetch_list=[loss])[0][0]) for _ in range(30)]
+    assert losses[-1] < 0.3 * losses[0], losses[::6]
+
+
+# ---------------------------------------------------------------------------
+# DynamicRNN
+# ---------------------------------------------------------------------------
+
+def _lod_batch(rng, lens, dim):
+    data = rng.randn(sum(lens), dim).astype(np.float32)
+    return create_lod_array(data, recursive_seq_lens=[list(lens)])
+
+
+def test_dynamic_rnn_shapes_and_mask():
+    layers = fluid.layers
+    D, H = 4, 6
+    x = layers.data(name='x', shape=[D], dtype='float32', lod_level=1)
+    drnn = layers.DynamicRNN()
+    with drnn.block():
+        word = drnn.step_input(x)
+        prev = drnn.memory(shape=[H], value=0.0)
+        h = layers.fc(input=[word, prev], size=H, act='tanh')
+        drnn.update_memory(prev, h)
+        drnn.output(h)
+    out = drnn()
+    pooled = layers.sequence_last_step(out)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(2)
+    lens = [3, 1, 4]
+    feed_x = _lod_batch(rng, lens, D)
+    y, p = exe.run(feed={'x': feed_x}, fetch_list=[out, pooled])
+    assert y.shape == (sum(lens), H)
+    assert p.shape == (len(lens), H)
+
+
+def test_dynamic_rnn_trains_sequence_classifier():
+    """NMT-style milestone: DynamicRNN encoder trains end-to-end on LoD."""
+    layers = fluid.layers
+    V, E, H = 30, 8, 16
+    words = layers.data(name='w', shape=[1], dtype='int64', lod_level=1)
+    label = layers.data(name='y', shape=[1], dtype='int64')
+    emb = layers.embedding(input=words, size=[V, E])
+    drnn = layers.DynamicRNN()
+    with drnn.block():
+        wt = drnn.step_input(emb)
+        prev = drnn.memory(shape=[H], value=0.0)
+        h = layers.fc(input=[wt, prev], size=H, act='tanh')
+        drnn.update_memory(prev, h)
+        drnn.output(h)
+    enc = layers.sequence_last_step(drnn())
+    logits = layers.fc(input=enc, size=2)
+    loss = layers.mean(layers.softmax_with_cross_entropy(
+        logits=logits, label=label))
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(3)
+    lens = [3, 5, 2, 4]
+    # task: label = whether first word id is >= V//2 (learnable from data)
+    ids = rng.randint(0, V, (sum(lens), 1)).astype(np.int64)
+    firsts = np.add.accumulate([0] + lens[:-1])
+    ys = (ids[firsts, 0] >= V // 2).astype(np.int64).reshape(-1, 1)
+    feed_w = create_lod_array(ids, recursive_seq_lens=[lens])
+    losses = [float(exe.run(feed={'w': feed_w, 'y': ys},
+                            fetch_list=[loss])[0][0]) for _ in range(40)]
+    assert losses[-1] < 0.5 * losses[0], losses[::8]
+
+
+def test_dynamic_rnn_static_input():
+    layers = fluid.layers
+    D, H = 3, 4
+    x = layers.data(name='x', shape=[D], dtype='float32', lod_level=1)
+    ctx_in = layers.data(name='c', shape=[H], dtype='float32')
+    drnn = layers.DynamicRNN()
+    with drnn.block():
+        wt = drnn.step_input(x)
+        cs = drnn.static_input(ctx_in)
+        prev = drnn.memory(shape=[H], value=0.0)
+        h = layers.fc(input=[wt, prev, cs], size=H, act='tanh')
+        drnn.update_memory(prev, h)
+        drnn.output(h)
+    out = drnn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(4)
+    lens = [2, 3]
+    y, = exe.run(feed={'x': _lod_batch(rng, lens, D),
+                       'c': rng.randn(len(lens), H).astype(np.float32)},
+                 fetch_list=[out])
+    assert y.shape == (sum(lens), H)
+
+
+# ---------------------------------------------------------------------------
+# IfElse / Switch / conditional_block
+# ---------------------------------------------------------------------------
+
+def test_ifelse_rowwise():
+    layers = fluid.layers
+    x = layers.data(name='x', shape=[2], dtype='float32')
+    zero = layers.fill_constant_batch_size_like(x, [-1, 1], 'float32', 0.0)
+    first = layers.slice(x, axes=[1], starts=[0], ends=[1])
+    cond = layers.less_than(x=first, y=zero)  # [N,1] bool: x[:,0] < 0
+    ie = layers.IfElse(cond)
+    with ie.true_block():
+        ie.output(layers.scale(ie.input(x), scale=-1.0))
+    with ie.false_block():
+        ie.output(layers.scale(ie.input(x), scale=2.0))
+    merged, = ie()
+    xs = np.array([[-1.0, 3.0], [2.0, -5.0]], np.float32)
+    out, = _run([merged], feed={'x': xs}, startup=False)
+    np.testing.assert_allclose(out, np.array([[1.0, -3.0], [4.0, -10.0]]),
+                               rtol=1e-6)
+
+
+def test_switch_piecewise():
+    layers = fluid.layers
+    step = layers.fill_constant(shape=[1], dtype='float32', value=7.0)
+    lr = layers.fill_constant(shape=[1], dtype='float32', value=0.0)
+    b1 = layers.fill_constant(shape=[1], dtype='float32', value=5.0)
+    b2 = layers.fill_constant(shape=[1], dtype='float32', value=10.0)
+    sw = layers.Switch()
+    with sw.case(layers.less_than(step, b1)):
+        layers.assign(layers.fill_constant([1], 'float32', 0.1), lr)
+    with sw.case(layers.less_than(step, b2)):
+        layers.assign(layers.fill_constant([1], 'float32', 0.01), lr)
+    with sw.default():
+        layers.assign(layers.fill_constant([1], 'float32', 0.001), lr)
+    out, = _run([lr], startup=False)
+    assert out[0] == pytest.approx(0.01)
+
+
+# ---------------------------------------------------------------------------
+# rank table + array conversion round trip
+# ---------------------------------------------------------------------------
+
+def test_lod_tensor_array_round_trip():
+    layers = fluid.layers
+    D = 3
+    x = layers.data(name='x', shape=[D], dtype='float32', lod_level=1)
+    table = layers.lod_rank_table(x)
+    arr = layers.lod_tensor_to_array(x, table)
+    back = layers.array_to_lod_tensor(arr, table)
+    ml = layers.max_sequence_len(table)
+    rng = np.random.RandomState(5)
+    lens = [2, 4, 1]
+    feed_x = _lod_batch(rng, lens, D)
+    y, m = _run([back, ml], feed={'x': feed_x}, startup=False)
+    np.testing.assert_allclose(y, np.asarray(feed_x.data), rtol=1e-6)
+    assert m[0] == 4
+
+
+def test_reorder_by_rank():
+    layers = fluid.layers
+    x = layers.data(name='x', shape=[1], dtype='float32', lod_level=1)
+    table = layers.lod_rank_table(x)
+    reordered = layers.reorder_lod_tensor_by_rank(x, table)
+    lens = [1, 3, 2]
+    data = np.arange(6, dtype=np.float32).reshape(6, 1)
+    feed_x = create_lod_array(data, recursive_seq_lens=[lens])
+    y, = _run([reordered], feed={'x': feed_x}, startup=False)
+    # rank order: seq1 (len 3) rows 1..3, seq2 (len 2) rows 4..5, seq0 row 0
+    np.testing.assert_allclose(
+        y.reshape(-1), np.array([1, 2, 3, 4, 5, 0], np.float32))
