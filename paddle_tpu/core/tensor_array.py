@@ -72,6 +72,8 @@ class TensorArrayVal(object):
         cap = data.shape[0]
         if jnp.issubdtype(x.dtype, jnp.floating):
             x = jnp.where(i < cap, x, jnp.full_like(x, jnp.nan))
+        elif jnp.issubdtype(x.dtype, jnp.integer):
+            x = jnp.where(i < cap, x, jnp.full_like(x, -1))
         data = jax.lax.dynamic_update_index_in_dim(data, x, i, 0)
         length = jnp.maximum(self.length, i + 1)
         return TensorArrayVal(data, length, cap)

@@ -104,6 +104,14 @@ class LayerHelper(object):
             attr.initializer(sv, sb)
         return param
 
+    def get_parameter(self, name):
+        """Look up an existing parameter by name (e.g. a CRF transition
+        shared between linear_chain_crf and crf_decoding)."""
+        p = self.main_program.global_block()._find_var_recursive(name)
+        if p is None:
+            raise ValueError("parameter %r not found" % name)
+        return p
+
     def create_variable_for_type_inference(self, dtype, stop_gradient=False):
         return self.block.create_var(
             name=unique_name.generate(".".join([self.name, 'tmp'])),

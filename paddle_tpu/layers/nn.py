@@ -1186,3 +1186,145 @@ def hash(input, hash_size, num_hash=1, name=None):
 
 def grid_sample(*a, **k):
     return grid_sampler(*a, **k)
+
+
+# ---------------------------------------------------------------------------
+# sequence decode / structured prediction layers
+# (ref: layers/nn.py warpctc, ctc_greedy_decoder, edit_distance,
+# linear_chain_crf, crf_decoding, chunk_eval, beam_search,
+# beam_search_decode; op semantics in paddle_tpu/ops/decode_ops.py)
+# ---------------------------------------------------------------------------
+
+def warpctc(input, label, blank=0, norm_by_times=False, use_cudnn=False):
+    helper = LayerHelper('warpctc')
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    grad = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type='warpctc', inputs={'Logits': input, 'Label': label},
+        outputs={'Loss': loss, 'WarpCTCGrad': grad},
+        attrs={'blank': blank, 'norm_by_times': norm_by_times})
+    return loss
+
+
+def ctc_greedy_decoder(input, blank, name=None):
+    helper = LayerHelper('ctc_greedy_decoder', name=name)
+    out = helper.create_variable_for_type_inference('int64')
+    out.lod_level = 1
+    helper.append_op(type='ctc_greedy_decoder', inputs={'Input': input},
+                     outputs={'Output': out}, attrs={'blank': blank})
+    out.stop_gradient = True
+    return out
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None):
+    helper = LayerHelper('edit_distance')
+    out = helper.create_variable_for_type_inference('float32')
+    seq_num = helper.create_variable_for_type_inference('int64')
+    helper.append_op(type='edit_distance',
+                     inputs={'Hyps': input, 'Refs': label},
+                     outputs={'Out': out, 'SequenceNum': seq_num},
+                     attrs={'normalized': normalized,
+                            'ignored_tokens': tuple(ignored_tokens or ())})
+    out.stop_gradient = True
+    seq_num.stop_gradient = True
+    return out, seq_num
+
+
+def linear_chain_crf(input, label, param_attr=None):
+    helper = LayerHelper('linear_chain_crf', param_attr=param_attr)
+    size = input.shape[1]
+    transition = helper.create_parameter(
+        attr=helper.param_attr, shape=[size + 2, size], dtype=input.dtype)
+    ll = helper.create_variable_for_type_inference(input.dtype)
+    alpha = helper.create_variable_for_type_inference(input.dtype)
+    em_exps = helper.create_variable_for_type_inference(input.dtype)
+    tr_exps = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type='linear_chain_crf',
+        inputs={'Emission': input, 'Transition': transition, 'Label': label},
+        outputs={'LogLikelihood': ll, 'Alpha': alpha,
+                 'EmissionExps': em_exps, 'TransitionExps': tr_exps})
+    return ll
+
+
+def crf_decoding(input, param_attr, label=None):
+    helper = LayerHelper('crf_decoding', param_attr=param_attr)
+    transition = helper.get_parameter(helper.param_attr.name)
+    path = helper.create_variable_for_type_inference('int64')
+    path.lod_level = 1
+    inputs = {'Emission': input, 'Transition': transition}
+    if label is not None:
+        inputs['Label'] = label
+    helper.append_op(type='crf_decoding', inputs=inputs,
+                     outputs={'ViterbiPath': path})
+    path.stop_gradient = True
+    return path
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None):
+    helper = LayerHelper('chunk_eval')
+    precision = helper.create_variable_for_type_inference('float32')
+    recall = helper.create_variable_for_type_inference('float32')
+    f1 = helper.create_variable_for_type_inference('float32')
+    n_inf = helper.create_variable_for_type_inference('int64')
+    n_lab = helper.create_variable_for_type_inference('int64')
+    n_cor = helper.create_variable_for_type_inference('int64')
+    for v in (precision, recall, f1, n_inf, n_lab, n_cor):
+        v.stop_gradient = True
+    helper.append_op(
+        type='chunk_eval', inputs={'Inference': input, 'Label': label},
+        outputs={'Precision': precision, 'Recall': recall, 'F1-Score': f1,
+                 'NumInferChunks': n_inf, 'NumLabelChunks': n_lab,
+                 'NumCorrectChunks': n_cor},
+        attrs={'chunk_scheme': chunk_scheme,
+               'num_chunk_types': num_chunk_types,
+               'excluded_chunk_types': tuple(excluded_chunk_types or ())})
+    return precision, recall, f1, n_inf, n_lab, n_cor
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id, level=0,
+                name=None, return_parent_idx=False):
+    """Fixed-width beam step: rows are [batch*beam_size]; finished beams
+    (pre_id == end_id) propagate frozen. parent_idx (absolute parent row of
+    each selected beam) is what the reference encodes in the output LoD —
+    feed it to beam_search_decode."""
+    helper = LayerHelper('beam_search', name=name)
+    sel_ids = helper.create_variable_for_type_inference('int64')
+    sel_scores = helper.create_variable_for_type_inference(pre_scores.dtype)
+    parent_idx = helper.create_variable_for_type_inference('int32')
+    inputs = {'pre_ids': pre_ids, 'pre_scores': pre_scores, 'scores': scores}
+    if ids is not None:
+        inputs['ids'] = ids
+    helper.append_op(
+        type='beam_search', inputs=inputs,
+        outputs={'selected_ids': sel_ids, 'selected_scores': sel_scores,
+                 'parent_idx': parent_idx},
+        attrs={'level': level, 'beam_size': beam_size, 'end_id': end_id})
+    for v in (sel_ids, sel_scores, parent_idx):
+        v.stop_gradient = True
+    if return_parent_idx:
+        return sel_ids, sel_scores, parent_idx
+    return sel_ids, sel_scores
+
+
+def beam_search_decode(ids, scores, beam_size, end_id, name=None,
+                       parents=None):
+    """Backtrace per-step TensorArrays (ids, scores [, parents]) into full
+    hypotheses. Output rows are padded with end_id after each hypothesis
+    finishes (static shapes; the reference emits a data-dependent LoD)."""
+    helper = LayerHelper('beam_search_decode', name=name)
+    sent_ids = helper.create_variable_for_type_inference('int64')
+    sent_scores = helper.create_variable_for_type_inference('float32')
+    sent_ids.lod_level = 1
+    sent_scores.lod_level = 1
+    inputs = {'Ids': ids, 'Scores': scores}
+    if parents is not None:
+        inputs['Parents'] = parents
+    helper.append_op(
+        type='beam_search_decode', inputs=inputs,
+        outputs={'SentenceIds': sent_ids, 'SentenceScores': sent_scores},
+        attrs={'beam_size': beam_size, 'end_id': end_id})
+    sent_ids.stop_gradient = True
+    sent_scores.stop_gradient = True
+    return sent_ids, sent_scores

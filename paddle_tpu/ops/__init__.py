@@ -11,5 +11,6 @@ from . import optimizer_ops   # noqa: F401
 from . import metric_ops      # noqa: F401
 from . import control_ops     # noqa: F401
 from . import array_ops       # noqa: F401
+from . import decode_ops      # noqa: F401
 from . import sequence_ops    # noqa: F401
 from . import rnn_ops         # noqa: F401

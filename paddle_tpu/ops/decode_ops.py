@@ -1,0 +1,476 @@
+"""Sequence decode / structured prediction ops: CTC, CRF, edit distance,
+chunk evaluation, beam search
+(ref: operators/warpctc_op.cc, ctc_align_op.cc, edit_distance_op.cc,
+linear_chain_crf_op.cc/.h, crf_decoding_op.cc, chunk_eval_op.cc,
+beam_search_op.cc, beam_search_decode_op.cc).
+
+TPU-native designs:
+- warpctc → the standard log-space CTC recursion (optax.ctc_loss) over
+  lod-padded [B, T, C]; fully differentiable, so backward needs no
+  WarpCTCGrad plumbing.
+- CRF forward/viterbi → one lax.scan per direction over padded time with
+  masks; transition layout follows the reference exactly (row 0 = start,
+  row 1 = end, rows 2.. = D x D — linear_chain_crf_op.h:150-151), output is
+  the negative log-likelihood (linear_chain_crf_op.h:192 `return -ll`).
+- Decoders (ctc_greedy, viterbi path, beam search) keep STATIC shapes: a
+  decoded sequence is left-aligned in its original-lod row span, padded
+  with -1 (greedy) / end_id (beam). The reference emits data-dependent
+  LoDs — dynamic shapes XLA cannot compile; -1/end padding carries the
+  same information and edit_distance/chunk_eval below understand it.
+- beam_search uses a FIXED beam width K: finished beams propagate end_id
+  with frozen scores instead of shrinking the beam (the reference prunes
+  via LoD). This is the standard TPU beam search formulation.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+from ..core.lod import LoDArray, unwrap, lengths_to_offsets
+from .rnn_ops import _pad_from_lod
+
+
+def _lod_offsets(x, what):
+    if not (isinstance(x, LoDArray) and x.lod):
+        raise TypeError("%s requires a LoD input" % what)
+    return np.asarray(x.lod[-1], np.int64)
+
+
+def _pad_batch(x, what):
+    """LoDArray -> (padded [B, T, ...], mask [B, T], offsets)."""
+    off = _lod_offsets(x, what)
+    padded, mask = _pad_from_lod(unwrap(x), off)
+    return padded, mask, off
+
+
+# ---------------------------------------------------------------------------
+# CTC
+# ---------------------------------------------------------------------------
+
+@register('warpctc', lod='aware')
+def _warpctc(ctx, ins):
+    import optax
+    logits = ins['Logits'][0]
+    label = ins['Label'][0]
+    blank = int(ctx.attr('blank', 0))
+    norm_by_times = bool(ctx.attr('norm_by_times', False))
+
+    lg, lg_mask, lg_off = _pad_batch(logits, 'warpctc Logits')
+    lb, lb_mask, _ = _pad_batch(label, 'warpctc Label')
+    lb = lb.reshape(lb.shape[0], -1).astype(jnp.int32)
+
+    # optax paddings: 1.0 where padded
+    logit_pad = 1.0 - lg_mask.astype(lg.dtype)
+    label_pad = 1.0 - lb_mask.astype(lg.dtype)
+    if blank != 0:
+        # optax fixes blank_id=0: rotate classes so `blank` sits at 0
+        perm = [blank] + [c for c in range(lg.shape[-1]) if c != blank]
+        lg = lg[..., jnp.asarray(perm)]
+        inv = np.argsort(perm)
+        lb = jnp.asarray(inv)[lb]
+    loss = optax.ctc_loss(lg, logit_pad, lb, label_pad)  # [B]
+    if norm_by_times:
+        lens = (lg_off[1:] - lg_off[:-1]).astype(np.float32)
+        loss = loss / jnp.asarray(lens)
+    return {'Loss': [loss.reshape(-1, 1)], 'WarpCTCGrad': None}
+
+
+@register('ctc_greedy_decoder', no_grad=True, lod='aware')
+def _ctc_greedy_decoder(ctx, ins):
+    """Best-path decode: argmax per frame, merge repeats, drop blanks.
+    Output keeps the input lod; decoded tokens are left-aligned per row
+    span, -1 elsewhere (see module docstring on static shapes)."""
+    x = ins['Input'][0]
+    blank = int(ctx.attr('blank', 0))
+    off = _lod_offsets(x, 'ctc_greedy_decoder')
+    best = jnp.argmax(unwrap(x), axis=-1).astype(jnp.int64)  # [sum]
+    outs = []
+    for i in range(len(off) - 1):
+        seg = best[int(off[i]):int(off[i + 1])]
+        prev = jnp.concatenate([jnp.full((1,), -1, seg.dtype), seg[:-1]])
+        keep = (seg != prev) & (seg != blank)
+        pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+        L = seg.shape[0]
+        tgt = jnp.where(keep, pos, L)  # L is out of bounds -> write dropped
+        row = jnp.full((L,), -1, seg.dtype).at[tgt].set(seg, mode='drop')
+        outs.append(row.reshape(-1, 1))
+    return {'Output': [LoDArray(jnp.concatenate(outs, 0), x.lod)]}
+
+
+@register('edit_distance', no_grad=True, lod='aware')
+def _edit_distance(ctx, ins):
+    """Levenshtein distance per sequence pair. Accepts LoD rows, optionally
+    -1-padded (ctc_greedy_decoder output): -1 entries don't count as
+    tokens. DP over the padded grid via nested lax.scan; the answer is
+    gathered at the (possibly traced) true lengths."""
+    hyps, refs = ins['Hyps'][0], ins['Refs'][0]
+    normalized = bool(ctx.attr('normalized', True))
+    ignored = tuple(ctx.attr('ignored_tokens', ()) or ())
+    h_off = _lod_offsets(hyps, 'edit_distance Hyps')
+    r_off = _lod_offsets(refs, 'edit_distance Refs')
+    h = unwrap(hyps).reshape(-1).astype(jnp.int64)
+    r = unwrap(refs).reshape(-1).astype(jnp.int64)
+    n = len(h_off) - 1
+
+    def compact(seq):
+        """Left-align valid tokens (drop -1 pads and ignored tokens), -1
+        padding after — interior holes would otherwise count in the DP."""
+        keep = seq >= 0
+        for tok in ignored:
+            keep &= seq != tok
+        pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+        L = seq.shape[0]
+        tgt = jnp.where(keep, pos, L)
+        return jnp.full((L,), -1, seq.dtype).at[tgt].set(seq, mode='drop')
+
+    def one_pair(hseq, rseq):
+        """hseq [maxH], rseq [maxR]; -1 = pad. Returns distance."""
+        hlen = jnp.sum(hseq >= 0).astype(jnp.int32)
+        rlen = jnp.sum(rseq >= 0).astype(jnp.int32)
+        max_r = rseq.shape[0]
+        row0 = jnp.arange(max_r + 1, dtype=jnp.int32)
+
+        def row_step(prev_row, hi):
+            first = prev_row[0] + 1
+
+            def col_step(left, inp):
+                up, diag, rj = inp
+                cost = jnp.where(hi == rj, 0, 1).astype(jnp.int32)
+                new = jnp.minimum(jnp.minimum(up + 1, left + 1), diag + cost)
+                return new, new
+
+            _, rest = jax.lax.scan(
+                col_step, first, (prev_row[1:], prev_row[:-1], rseq))
+            new_row = jnp.concatenate([first[None], rest])
+            return new_row, new_row
+
+        _, rows = jax.lax.scan(row_step, row0, hseq)
+        all_rows = jnp.concatenate([row0[None], rows], axis=0)
+        return all_rows[hlen, rlen].astype(jnp.float32)
+
+    dists = []
+    for i in range(n):
+        hseq = compact(h[int(h_off[i]):int(h_off[i + 1])])
+        rseq = compact(r[int(r_off[i]):int(r_off[i + 1])])
+        d = one_pair(hseq, rseq)
+        if normalized:
+            rlen = jnp.maximum(jnp.sum(rseq >= 0), 1)
+            d = d / rlen.astype(jnp.float32)
+        dists.append(d)
+    return {'Out': [jnp.stack(dists).reshape(-1, 1)],
+            'SequenceNum': [jnp.asarray(n, jnp.int64
+                            if jax.config.jax_enable_x64 else jnp.int32)
+                            .reshape(1)]}
+
+
+# ---------------------------------------------------------------------------
+# CRF
+# ---------------------------------------------------------------------------
+
+def _split_transition(w):
+    """Reference layout (linear_chain_crf_op.h:150): row0 start, row1 end,
+    rows 2.. the D x D transition matrix."""
+    return w[0], w[1], w[2:]
+
+
+@register('linear_chain_crf', lod='aware')
+def _linear_chain_crf(ctx, ins):
+    em = ins['Emission'][0]
+    w = unwrap(ins['Transition'][0])
+    label = ins['Label'][0]
+    start, end, trans = _split_transition(w)
+
+    E, mask, off = _pad_batch(em, 'linear_chain_crf Emission')   # [B,T,D]
+    y = _pad_batch(label, 'linear_chain_crf Label')[0]
+    y = y.reshape(y.shape[0], -1).astype(jnp.int32)              # [B,T]
+    B, T, D = E.shape
+    lens = jnp.asarray((off[1:] - off[:-1]).astype(np.int32))
+
+    Et = jnp.moveaxis(E, 1, 0)       # [T,B,D]
+    mt = jnp.moveaxis(mask, 1, 0)    # [T,B]
+    yt = jnp.moveaxis(y, 1, 0)       # [T,B]
+
+    # ---- log partition: masked forward recursion --------------------------
+    alpha0 = start[None, :] + Et[0]                              # [B,D]
+
+    def fwd(alpha, inp):
+        e_t, m_t = inp
+        nxt = jax.nn.logsumexp(alpha[:, :, None] + trans[None], axis=1) + e_t
+        alpha = jnp.where(m_t[:, None], nxt, alpha)
+        return alpha, None
+
+    alphaT, _ = jax.lax.scan(fwd, alpha0, (Et[1:], mt[1:]))
+    logZ = jax.nn.logsumexp(alphaT + end[None, :], axis=1)       # [B]
+
+    # ---- gold path score --------------------------------------------------
+    brange = jnp.arange(B)
+    gold = start[yt[0]] + Et[0][brange, yt[0]]
+
+    def gstep(g, inp):
+        e_t, m_t, y_prev, y_t = inp
+        step = trans[y_prev, y_t] + e_t[brange, y_t]
+        return g + jnp.where(m_t, step, 0.0), None
+
+    gold, _ = jax.lax.scan(gstep, gold, (Et[1:], mt[1:], yt[:-1], yt[1:]))
+    y_last = y[brange, lens - 1]
+    gold = gold + end[y_last]
+
+    nll = (logZ - gold).reshape(-1, 1)   # reference returns -loglik
+    zeros = jnp.zeros(unwrap(em).shape, unwrap(em).dtype)
+    return {'LogLikelihood': [nll],
+            'Alpha': [zeros], 'EmissionExps': [zeros],
+            'TransitionExps': [jnp.zeros_like(w)]}
+
+
+@register('crf_decoding', no_grad=True, lod='aware')
+def _crf_decoding(ctx, ins):
+    em = ins['Emission'][0]
+    w = unwrap(ins['Transition'][0])
+    label = ins['Label'][0] if ins.get('Label') and ins['Label'][0] is not None \
+        else None
+    start, end, trans = _split_transition(w)
+
+    E, mask, off = _pad_batch(em, 'crf_decoding Emission')
+    B, T, D = E.shape
+    lens = np.asarray(off[1:] - off[:-1], np.int64)
+    Et = jnp.moveaxis(E, 1, 0)
+    mt = jnp.moveaxis(mask, 1, 0)
+
+    # viterbi forward with backpointers; freeze finished rows via mask
+    d0 = start[None, :] + Et[0]
+
+    def vstep(delta, inp):
+        e_t, m_t = inp
+        cand = delta[:, :, None] + trans[None]          # [B,D,D]
+        best = jnp.max(cand, axis=1) + e_t
+        bp = jnp.argmax(cand, axis=1).astype(jnp.int32)
+        new = jnp.where(m_t[:, None], best, delta)
+        bp = jnp.where(m_t[:, None], bp,
+                       jnp.arange(D, dtype=jnp.int32)[None, :])
+        return new, (bp, new)
+
+    _, (bps, deltas) = jax.lax.scan(vstep, d0, (Et[1:], mt[1:]))
+    deltas = jnp.concatenate([d0[None], deltas], axis=0)      # [T,B,D]
+
+    # each sequence ends at its static length: read delta there
+    brange = jnp.arange(B)
+    last_idx = jnp.asarray(lens - 1, jnp.int32)
+    final = deltas[last_idx, brange] + end[None, :]
+    tags_last = jnp.argmax(final, axis=1).astype(jnp.int32)   # [B]
+
+    # backtrace (reverse scan over backpointers, frozen past seq end);
+    # bps[t] connects steps t and t+1, valid where mask[t+1]
+    def back(tag, inp):
+        bp, m_t = inp
+        prev = bp[brange, tag]
+        prev = jnp.where(m_t, prev, tag)
+        return prev, tag
+
+    tag0, tail_rev = jax.lax.scan(back, tags_last,
+                                  (bps[::-1], mt[1:][::-1]))
+    # tail_rev holds tags at steps T-1..1; prepend the step-0 carry
+    path = jnp.concatenate([tag0[None], tail_rev[::-1]], axis=0)  # [T,B]
+    path = jnp.moveaxis(path, 1, 0).astype(jnp.int64)             # [B,T]
+
+    rows = []
+    for i in range(B):
+        rows.append(path[i, :int(lens[i])])
+    flat = jnp.concatenate(rows).reshape(-1, 1)
+    if label is not None:
+        lab = unwrap(label).reshape(-1, 1).astype(jnp.int64)
+        flat = (flat == lab).astype(jnp.int64)
+    return {'ViterbiPath': [LoDArray(flat, em.lod)]}
+
+
+# ---------------------------------------------------------------------------
+# chunk_eval (ref operators/chunk_eval_op.cc): precision/recall/F1 of chunk
+# labeling. Tag encoding for scheme IOB: tag = chunk_type * num_tag_types +
+# tag_type, tag_type 0 = B, 1 = I. 'plain': every tag is its own chunk type.
+# ---------------------------------------------------------------------------
+
+def _chunk_bounds(tags, scheme, num_chunk_types, excluded):
+    """tags [L] int; returns (is_start [L], is_end [L], ctype [L], valid)."""
+    L = tags.shape[0]
+    if scheme == 'plain':
+        ctype = tags
+        valid = tags >= 0
+        for e in excluded:
+            valid &= tags != e
+        prev = jnp.concatenate([jnp.full((1,), -2, tags.dtype), tags[:-1]])
+        nxt = jnp.concatenate([tags[1:], jnp.full((1,), -2, tags.dtype)])
+        is_start = valid & (prev != tags)
+        is_end = valid & (nxt != tags)
+        return is_start, is_end, ctype, valid
+    if scheme != 'IOB':
+        raise NotImplementedError("chunk_eval scheme %r (supported: plain, "
+                                  "IOB)" % scheme)
+    ttype = tags % 2          # 0 = B, 1 = I
+    ctype = tags // 2
+    valid = tags >= 0
+    for e in excluded:
+        valid &= ctype != e
+    prev_ct = jnp.concatenate([jnp.full((1,), -2, ctype.dtype), ctype[:-1]])
+    prev_tt = jnp.concatenate([jnp.full((1,), -2, ttype.dtype), ttype[:-1]])
+    prev_valid = jnp.concatenate([jnp.zeros((1,), bool), valid[:-1]])
+    nxt_ct = jnp.concatenate([ctype[1:], jnp.full((1,), -2, ctype.dtype)])
+    nxt_tt = jnp.concatenate([ttype[1:], jnp.full((1,), -2, ttype.dtype)])
+    nxt_valid = jnp.concatenate([valid[1:], jnp.zeros((1,), bool)])
+    is_start = valid & ((ttype == 0) | ~prev_valid | (prev_ct != ctype))
+    is_end = valid & (~nxt_valid | (nxt_tt == 0) | (nxt_ct != ctype))
+    return is_start, is_end, ctype, valid
+
+
+@register('chunk_eval', no_grad=True, lod='aware')
+def _chunk_eval(ctx, ins):
+    inf = ins['Inference'][0]
+    lab = ins['Label'][0]
+    scheme = ctx.attr('chunk_scheme', 'IOB')
+    num_chunk_types = int(ctx.attr('num_chunk_types', 1))
+    excluded = tuple(ctx.attr('excluded_chunk_types', ()) or ())
+    off = _lod_offsets(lab, 'chunk_eval Label')
+
+    iv = unwrap(inf).reshape(-1).astype(jnp.int32)
+    lv = unwrap(lab).reshape(-1).astype(jnp.int32)
+
+    n_inf = jnp.zeros((), jnp.int32)
+    n_lab = jnp.zeros((), jnp.int32)
+    n_cor = jnp.zeros((), jnp.int32)
+    for s in range(len(off) - 1):
+        i_seg = iv[int(off[s]):int(off[s + 1])]
+        l_seg = lv[int(off[s]):int(off[s + 1])]
+        i_st, i_en, i_ct, _ = _chunk_bounds(i_seg, scheme, num_chunk_types,
+                                            excluded)
+        l_st, l_en, l_ct, _ = _chunk_bounds(l_seg, scheme, num_chunk_types,
+                                            excluded)
+        n_inf += jnp.sum(i_st)
+        n_lab += jnp.sum(l_st)
+        # a chunk is correct if start/end/type AND the span agree; spans
+        # agree iff the end positions for the start both coincide — check:
+        # both start at p, same type, and for the region until the shared
+        # end, ends match. Count starts where (start match & type match &
+        # the next end matches): next-end index via running min of end pos.
+        L = i_seg.shape[0]
+        idx = jnp.arange(L)
+        big = L + 1
+
+        def next_end(is_end):
+            pos = jnp.where(is_end, idx, big)
+            return jax.lax.associative_scan(jnp.minimum, pos[::-1])[::-1]
+
+        both_start = i_st & l_st & (i_ct == l_ct)
+        n_cor += jnp.sum(both_start & (next_end(i_en) == next_end(l_en)))
+
+    n_inf_f = n_inf.astype(jnp.float32)
+    n_lab_f = n_lab.astype(jnp.float32)
+    n_cor_f = n_cor.astype(jnp.float32)
+    prec = jnp.where(n_inf > 0, n_cor_f / n_inf_f, 0.0).reshape(1)
+    rec = jnp.where(n_lab > 0, n_cor_f / n_lab_f, 0.0).reshape(1)
+    f1 = jnp.where(n_cor > 0, 2 * prec * rec / (prec + rec),
+                   jnp.zeros(1)).reshape(1)
+    i64 = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    return {'Precision': [prec], 'Recall': [rec], 'F1-Score': [f1],
+            'NumInferChunks': [n_inf.astype(i64).reshape(1)],
+            'NumLabelChunks': [n_lab.astype(i64).reshape(1)],
+            'NumCorrectChunks': [n_cor.astype(i64).reshape(1)]}
+
+
+# ---------------------------------------------------------------------------
+# beam search (fixed-width; see module docstring)
+# ---------------------------------------------------------------------------
+
+@register('beam_search', no_grad=True, lod='aware')
+def _beam_search(ctx, ins):
+    """One decode step. Rows are [B*K]: K beams per source. Candidate ids /
+    accumulated scores are [B*K, C] (C candidates per beam, usually a
+    pre-topk). Selects the top K of the K*C candidates per source.
+    Finished beams (pre_id == end_id) contribute a single frozen candidate.
+    Outputs parent_idx (absolute row of each selected beam's parent) for
+    beam_search_decode's backtrace — the information the reference encodes
+    in the output LoD."""
+    pre_ids = unwrap(ins['pre_ids'][0]).reshape(-1)         # [B*K]
+    pre_scores = unwrap(ins['pre_scores'][0]).reshape(-1)   # [B*K]
+    ids = unwrap(ins['ids'][0]) if ins.get('ids') and ins['ids'][0] is not None else None
+    scores = unwrap(ins['scores'][0])                       # [B*K, C]
+    K = int(ctx.attr('beam_size'))
+    end_id = int(ctx.attr('end_id'))
+    if ids is None:
+        ids = jnp.broadcast_to(jnp.arange(scores.shape[1], dtype=jnp.int64),
+                               scores.shape)
+    ids = ids.astype(jnp.int64)
+    BK, C = scores.shape
+    B = BK // K
+    neg_inf = jnp.asarray(-1e9, scores.dtype)
+
+    finished = pre_ids == end_id                            # [B*K]
+    # frozen candidate 0 for finished beams; others -inf
+    cand_scores = jnp.where(finished[:, None],
+                            jnp.concatenate(
+                                [pre_scores[:, None],
+                                 jnp.full((BK, C - 1), neg_inf, scores.dtype)],
+                                axis=1) if C > 1 else pre_scores[:, None],
+                            scores)
+    cand_ids = jnp.where(finished[:, None],
+                         jnp.full((BK, C), end_id, jnp.int64), ids)
+
+    g_scores = cand_scores.reshape(B, K * C)
+    g_ids = cand_ids.reshape(B, K * C)
+    top_s, top_i = jax.lax.top_k(g_scores, K)               # [B, K]
+    sel_ids = jnp.take_along_axis(g_ids, top_i, axis=1)     # [B, K]
+    parent = top_i // C + (jnp.arange(B, dtype=jnp.int32)[:, None] * K)
+    return {'selected_ids': [sel_ids.reshape(-1, 1)],
+            'selected_scores': [top_s.reshape(-1, 1)],
+            'parent_idx': [parent.reshape(-1).astype(jnp.int32)]}
+
+
+@register('beam_search_decode', no_grad=True, lod='aware')
+def _beam_search_decode(ctx, ins):
+    """Backtrace TensorArrays of per-step (ids, scores, parents) into full
+    hypotheses [B*K rows x T tokens]; rows padded with end_id after each
+    hypothesis ends (static shapes; the reference emits a dynamic LoD)."""
+    from ..core.tensor_array import TensorArrayVal
+    ids_arr = ins['Ids'][0]
+    scores_arr = ins['Scores'][0]
+    parents_arr = ins['Parents'][0] if ins.get('Parents') and \
+        ins['Parents'][0] is not None else None
+    end_id = int(ctx.attr('end_id'))
+    if not isinstance(ids_arr, TensorArrayVal) or ids_arr.data is None:
+        raise TypeError("beam_search_decode needs written TensorArrays")
+    ids = ids_arr.data.reshape(ids_arr.capacity, -1)        # [T, BK]
+    scores = scores_arr.data.reshape(scores_arr.capacity, -1)
+    T, BK = ids.shape
+    rows = jnp.arange(BK, dtype=jnp.int32)
+    if parents_arr is not None and parents_arr.data is not None:
+        parents = parents_arr.data.reshape(T, BK).astype(jnp.int32)
+    else:
+        parents = jnp.broadcast_to(rows, (T, BK))
+
+    # walk backwards from the WRITTEN length, not capacity: unwritten slots
+    # (t >= length) are identity links emitting end_id so they neither
+    # corrupt the parent chain nor the tokens
+    length = ids_arr.length
+    valid = jnp.arange(T, dtype=jnp.int32) < length         # [T]
+
+    def back(beam, inp):
+        ids_t, par_t, v_t = inp
+        tok = jnp.where(v_t, ids_t[beam], end_id)
+        prev = jnp.where(v_t, par_t[beam], beam)
+        return prev, tok
+
+    _, toks_rev = jax.lax.scan(
+        back, rows,
+        (ids[::-1].astype(jnp.int64), parents[::-1], valid[::-1]))
+    sent = toks_rev[::-1]                                   # [T, BK]
+    sent = jnp.moveaxis(sent, 1, 0)                         # [BK, T]
+    # freeze everything after the first end_id to end_id
+    seen_end = jnp.cumsum((sent == end_id).astype(jnp.int32), axis=1) > 0
+    shifted = jnp.concatenate(
+        [jnp.zeros((BK, 1), bool), seen_end[:, :-1]], axis=1)
+    sent = jnp.where(shifted, end_id, sent)
+    final_scores = jax.lax.dynamic_index_in_dim(
+        scores, jnp.maximum(length - 1, 0), 0, keepdims=False).reshape(-1, 1)
+    lod = [lengths_to_offsets([T] * BK)]
+    return {'SentenceIds': [LoDArray(sent.reshape(-1, 1), lod)],
+            'SentenceScores': [LoDArray(
+                jnp.broadcast_to(final_scores, (BK, T)).reshape(-1, 1), lod)]}

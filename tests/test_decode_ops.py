@@ -1,0 +1,336 @@
+"""CTC / CRF / edit distance / chunk eval / beam search
+(reference coverage model: test_warpctc_op.py, test_edit_distance_op.py,
+test_linear_chain_crf_op.py, test_crf_decoding_op.py, test_chunk_eval_op.py,
+test_beam_search_op.py, book test_machine_translation.py decode path,
+CRNN-CTC OCR model).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import create_lod_array
+
+
+def _lod(data, lens):
+    return create_lod_array(np.asarray(data), recursive_seq_lens=[list(lens)])
+
+
+def _run(fetch, feed=None, startup=True):
+    exe = fluid.Executor(fluid.CPUPlace())
+    if startup:
+        exe.run(fluid.default_startup_program())
+    return exe.run(feed=feed or {}, fetch_list=fetch)
+
+
+# ---------------------------------------------------------------------------
+# CTC
+# ---------------------------------------------------------------------------
+
+def test_warpctc_loss_positive_and_differentiable():
+    layers = fluid.layers
+    C = 6  # classes incl. blank 0
+    logits = fluid.layers.data(name='lg', shape=[C], dtype='float32',
+                               lod_level=1)
+    label = fluid.layers.data(name='lb', shape=[1], dtype='int64', lod_level=1)
+    loss = layers.warpctc(input=logits, label=label, blank=0)
+    avg = layers.mean(loss)
+    fluid.backward.append_backward(avg)
+
+    rng = np.random.RandomState(0)
+    t_lens, l_lens = [5, 7], [2, 3]
+    lg = _lod(rng.randn(sum(t_lens), C).astype(np.float32), t_lens)
+    lb = _lod(rng.randint(1, C, (sum(l_lens), 1)).astype(np.int64), l_lens)
+    out, = _run([loss], feed={'lg': lg, 'lb': lb}, startup=False)
+    assert out.shape == (2, 1)
+    assert (out > 0).all()
+
+
+def test_ctc_pipeline_trains_ocr_style():
+    """OCR CRNN+CTC milestone: conv features → gru → ctc loss decreases,
+    greedy decode + edit distance run end-to-end."""
+    layers = fluid.layers
+    C = 5   # 4 symbols + blank
+    T = 8
+    feat = layers.data(name='f', shape=[16], dtype='float32', lod_level=1)
+    label = layers.data(name='y', shape=[1], dtype='int64', lod_level=1)
+    h = layers.fc(input=feat, size=32, act='relu')
+    logits = layers.fc(input=h, size=C)
+    loss = layers.mean(layers.warpctc(input=logits, label=label, blank=0))
+    fluid.optimizer.Adam(learning_rate=0.02).minimize(loss)
+
+    decoded = layers.ctc_greedy_decoder(layers.softmax(logits), blank=0)
+    dist, seq_num = layers.edit_distance(decoded, label, normalized=False)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(1)
+    t_lens = [T, T]
+    l_lens = [3, 2]
+    feats = rng.randn(sum(t_lens), 16).astype(np.float32)
+    labs = rng.randint(1, C, (sum(l_lens), 1)).astype(np.int64)
+    feed = {'f': _lod(feats, t_lens), 'y': _lod(labs, l_lens)}
+    losses = [float(exe.run(feed=feed, fetch_list=[loss])[0][0])
+              for _ in range(60)]
+    assert losses[-1] < 0.5 * losses[0], losses[::12]
+    d, n = exe.run(feed=feed, fetch_list=[dist, seq_num])
+    assert n[0] == 2
+    # after fitting two fixed sequences the greedy decode should be close
+    assert d.sum() <= 2.0, d
+
+
+def test_edit_distance_known_values():
+    layers = fluid.layers
+    hyp = layers.data(name='h', shape=[1], dtype='int64', lod_level=1)
+    ref = layers.data(name='r', shape=[1], dtype='int64', lod_level=1)
+    dist, _ = layers.edit_distance(hyp, ref, normalized=False)
+    # "kitten"->"sitting" famous distance 3 (mapped to ints), plus equal pair
+    k = [1, 2, 3, 3, 4, 5]          # kitten
+    s = [6, 2, 3, 3, 2, 5, 7]       # sitting
+    h_data = np.array(k + [1, 2], np.int64).reshape(-1, 1)
+    r_data = np.array(s + [1, 2], np.int64).reshape(-1, 1)
+    out, = _run([dist], feed={'h': _lod(h_data, [6, 2]),
+                              'r': _lod(r_data, [7, 2])}, startup=False)
+    np.testing.assert_allclose(out.reshape(-1), [3.0, 0.0])
+
+
+def test_edit_distance_with_neg_padding():
+    """-1 padding (greedy decoder convention) is ignored."""
+    layers = fluid.layers
+    hyp = layers.data(name='h', shape=[1], dtype='int64', lod_level=1)
+    ref = layers.data(name='r', shape=[1], dtype='int64', lod_level=1)
+    dist, _ = layers.edit_distance(hyp, ref, normalized=False)
+    h_data = np.array([1, 2, -1, -1], np.int64).reshape(-1, 1)
+    r_data = np.array([1, 2, 3], np.int64).reshape(-1, 1)
+    out, = _run([dist], feed={'h': _lod(h_data, [4]),
+                              'r': _lod(r_data, [3])}, startup=False)
+    assert out[0, 0] == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# CRF
+# ---------------------------------------------------------------------------
+
+def _brute_force_crf_nll(E, w, y):
+    """Enumerate all paths for one sequence: -log p(y)."""
+    import itertools
+    start, end, A = w[0], w[1], w[2:]
+    T, D = E.shape
+
+    def score(path):
+        s = start[path[0]] + E[0, path[0]]
+        for t in range(1, T):
+            s += A[path[t - 1], path[t]] + E[t, path[t]]
+        return s + end[path[-1]]
+
+    logZ = np.logaddexp.reduce(
+        [score(p) for p in itertools.product(range(D), repeat=T)])
+    return logZ - score(y)
+
+
+def test_linear_chain_crf_matches_brute_force():
+    layers = fluid.layers
+    D = 3
+    em = layers.data(name='e', shape=[D], dtype='float32', lod_level=1)
+    lb = layers.data(name='l', shape=[1], dtype='int64', lod_level=1)
+    nll = layers.linear_chain_crf(
+        input=em, label=lb,
+        param_attr=fluid.ParamAttr(name='crfw_test'))
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(2)
+    lens = [4, 2]
+    E = rng.randn(sum(lens), D).astype(np.float32)
+    y = rng.randint(0, D, (sum(lens), 1)).astype(np.int64)
+    out, = exe.run(feed={'e': _lod(E, lens), 'l': _lod(y, lens)},
+                   fetch_list=[nll])
+    w = np.asarray(fluid.global_scope().get('crfw_test'))
+    exp0 = _brute_force_crf_nll(E[:4], w, y[:4, 0])
+    exp1 = _brute_force_crf_nll(E[4:], w, y[4:, 0])
+    np.testing.assert_allclose(out.reshape(-1), [exp0, exp1], rtol=1e-4)
+
+
+def test_crf_train_and_decode():
+    """label_semantic_roles-style slice: crf loss decreases; decoding with
+    label yields the 0/1 correctness vector feeding chunk_eval."""
+    layers = fluid.layers
+    D = 4
+    feat = layers.data(name='x', shape=[8], dtype='float32', lod_level=1)
+    lb = layers.data(name='l', shape=[1], dtype='int64', lod_level=1)
+    em = layers.fc(input=feat, size=D)
+    nll = layers.linear_chain_crf(input=em, label=lb,
+                                  param_attr=fluid.ParamAttr(name='crfw'))
+    loss = layers.mean(nll)
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    path = layers.crf_decoding(input=em,
+                               param_attr=fluid.ParamAttr(name='crfw'))
+    correct = layers.crf_decoding(input=em, label=lb,
+                                  param_attr=fluid.ParamAttr(name='crfw'))
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(3)
+    lens = [5, 3]
+    X = rng.randn(sum(lens), 8).astype(np.float32)
+    y = rng.randint(0, D, (sum(lens), 1)).astype(np.int64)
+    feed = {'x': _lod(X, lens), 'l': _lod(y, lens)}
+    losses = [float(exe.run(feed=feed, fetch_list=[loss])[0][0])
+              for _ in range(60)]
+    assert losses[-1] < losses[0]
+    p, c = exe.run(feed=feed, fetch_list=[path, correct])
+    assert p.shape == (sum(lens), 1)
+    assert set(np.unique(c)) <= {0, 1}
+    # after fitting, viterbi should recover the training labels
+    assert c.mean() > 0.8
+
+
+def test_chunk_eval_iob():
+    layers = fluid.layers
+    inf = layers.data(name='i', shape=[1], dtype='int64', lod_level=1)
+    lab = layers.data(name='l', shape=[1], dtype='int64', lod_level=1)
+    prec, rec, f1, n_inf, n_lab, n_cor = layers.chunk_eval(
+        input=inf, label=lab, chunk_scheme='IOB', num_chunk_types=2)
+    # tags: B-0=0 I-0=1 B-1=2 I-1=3; seq: [B0 I0 B1 I1 B0]
+    gold = np.array([0, 1, 2, 3, 0], np.int64).reshape(-1, 1)
+    # prediction: first chunk right, second wrong type, third right
+    pred = np.array([0, 1, 0, 1, 0], np.int64).reshape(-1, 1)
+    outs = _run([prec, rec, f1, n_inf, n_lab, n_cor],
+                feed={'i': _lod(pred, [5]), 'l': _lod(gold, [5])},
+                startup=False)
+    assert outs[3][0] == 3 and outs[4][0] == 3
+    assert outs[5][0] == 2
+    assert outs[0][0] == pytest.approx(2 / 3)
+    assert outs[1][0] == pytest.approx(2 / 3)
+
+
+# ---------------------------------------------------------------------------
+# beam search
+# ---------------------------------------------------------------------------
+
+def test_beam_search_step_selects_topk():
+    layers = fluid.layers
+    K, C = 2, 3   # beam 2, 3 candidates/beam; one source sentence
+    pre_ids = layers.data(name='pi', shape=[K, 1], dtype='int64',
+                          append_batch_size=False)
+    pre_scores = layers.data(name='ps', shape=[K, 1], dtype='float32',
+                             append_batch_size=False)
+    ids = layers.data(name='ids', shape=[K, C], dtype='int64',
+                      append_batch_size=False)
+    scores = layers.data(name='sc', shape=[K, C], dtype='float32',
+                         append_batch_size=False)
+    sel_ids, sel_scores, parent = layers.beam_search(
+        pre_ids, pre_scores, ids, scores, beam_size=K, end_id=0,
+        return_parent_idx=True)
+    feed = {
+        'pi': np.array([[5], [6]], np.int64),
+        'ps': np.array([[0.1], [0.2]], np.float32),
+        'ids': np.array([[11, 12, 13], [21, 22, 23]], np.int64),
+        'sc': np.array([[0.9, 0.5, 0.1], [0.8, 0.7, 0.2]], np.float32),
+    }
+    si, ss, pa = _run([sel_ids, sel_scores, parent], feed=feed, startup=False)
+    np.testing.assert_array_equal(si.reshape(-1), [11, 21])
+    np.testing.assert_allclose(ss.reshape(-1), [0.9, 0.8])
+    np.testing.assert_array_equal(pa.reshape(-1), [0, 1])
+
+
+def test_beam_search_frozen_finished_beam():
+    layers = fluid.layers
+    K, C = 2, 2
+    pre_ids = layers.data(name='pi', shape=[K, 1], dtype='int64',
+                          append_batch_size=False)
+    pre_scores = layers.data(name='ps', shape=[K, 1], dtype='float32',
+                             append_batch_size=False)
+    ids = layers.data(name='ids', shape=[K, C], dtype='int64',
+                      append_batch_size=False)
+    scores = layers.data(name='sc', shape=[K, C], dtype='float32',
+                         append_batch_size=False)
+    sel_ids, sel_scores = layers.beam_search(
+        pre_ids, pre_scores, ids, scores, beam_size=K, end_id=0)
+    feed = {
+        'pi': np.array([[0], [6]], np.int64),      # beam 0 finished
+        'ps': np.array([[2.0], [0.2]], np.float32),
+        'ids': np.array([[11, 12], [21, 22]], np.int64),
+        'sc': np.array([[9.0, 8.0], [1.0, 0.5]], np.float32),
+    }
+    si, ss = _run([sel_ids, sel_scores], feed=feed, startup=False)
+    # finished beam contributes ONLY (end_id, 2.0); its 9.0/8.0 are ignored
+    assert 0 in si.reshape(-1)
+    assert 2.0 in ss.reshape(-1)
+    assert 9.0 not in ss.reshape(-1)
+
+
+def test_beam_search_decode_backtrace():
+    """While-loop greedy-beam NMT decode: 2 beams over a toy 4-token vocab,
+    decode 3 steps, backtrace must follow parent pointers."""
+    layers = fluid.layers
+    K, V, T = 2, 4, 3
+    # logits per step are fed as data for determinism: [T, K, V]
+    step_scores = layers.data(name='sc', shape=[T, K, V], dtype='float32',
+                              append_batch_size=False)
+
+    i = layers.fill_constant([1], 'int64', 0)
+    limit = layers.fill_constant([1], 'int64', T)
+    init_ids = layers.fill_constant([K, 1], 'int64', 1)     # <s>
+    init_scores = layers.fill_constant([K, 1], 'float32', 0.0)
+    ids_arr = layers.array_write(init_ids, i)
+    scores_arr = layers.array_write(init_scores, i)
+    parents_arr = layers.array_write(
+        layers.fill_constant([K], 'int32', 0), i)
+    layers.increment(i, 1)
+    cond = layers.less_than(i, limit)
+    w = layers.While(cond)
+    with w.block():
+        t = layers.elementwise_sub(i, layers.fill_constant([1], 'int64', 1))
+        pre_ids = layers.array_read(ids_arr, t)
+        pre_scores = layers.array_read(scores_arr, t)
+        # this step's scores [K, V], accumulated onto the beam scores
+        acc = layers.elementwise_add(
+            layers.reshape(layers.gather(step_scores, t), [K, V]),
+            pre_scores)
+        sel_ids, sel_scores, parent = layers.beam_search(
+            pre_ids, pre_scores, None, acc, beam_size=K, end_id=0,
+            return_parent_idx=True)
+        layers.array_write(sel_ids, i, array=ids_arr)
+        layers.array_write(sel_scores, i, array=scores_arr)
+        layers.array_write(parent, i, array=parents_arr)
+        layers.increment(i, 1)
+        layers.less_than(i, limit, cond=cond)
+    sent_ids, sent_scores = layers.beam_search_decode(
+        ids_arr, scores_arr, beam_size=K, end_id=0, parents=parents_arr)
+
+    rng = np.random.RandomState(4)
+    sc = rng.randn(T, K, V).astype(np.float32)
+    out_ids, out_scores = _run([sent_ids, sent_scores],
+                               feed={'sc': sc}, startup=False)
+    ids_mat = out_ids.reshape(K, -1)
+    scores_mat = out_scores.reshape(K, -1)
+    assert ids_mat.shape[1] >= T
+    assert ((ids_mat >= 0) & (ids_mat < V)).all()
+
+    # numpy reference: fixed-K beam over the same scores. Loop iteration i
+    # gathers sc[i-1], so step slots 1..T-1 consume sc[0..T-2].
+    rows_hist = [[(1, 0)] * K]  # (token, parent) per step
+    cur_scores = np.zeros(K)
+    cur_ids = np.full(K, 1)
+    for t in range(1, T):
+        cand = cur_scores[:, None] + sc[t - 1]            # [K, V]
+        for k in range(K):                                 # freeze finished
+            if cur_ids[k] == 0:
+                cand[k] = -1e9
+                cand[k, 0] = cur_scores[k]
+        flat = cand.reshape(-1)
+        top = np.argsort(-flat, kind='stable')[:K]
+        rows_hist.append([(int(i % V), int(i // V)) for i in top])
+        cur_scores = flat[top]
+        cur_ids = np.array([i % V for i in top])
+    # backtrace numpy
+    want = np.zeros((K, T), np.int64)
+    for k in range(K):
+        beam = k
+        for t in range(T - 1, -1, -1):
+            tok, par = rows_hist[t][beam]
+            want[k, t] = tok
+            beam = par
+    # apply end-id freezing as the op does
+    np.testing.assert_array_equal(ids_mat[:, :T], want)
+    np.testing.assert_allclose(scores_mat[:, 0], cur_scores, rtol=1e-5)
