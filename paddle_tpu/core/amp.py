@@ -1,0 +1,94 @@
+"""bf16 mixed-precision compute (TPU-native AMP).
+
+The reference era had a float16 type (platform/float16.h) but no AMP
+training surface; on TPU bf16 is the MXU-native input format and shares
+float32's exponent range, so mixed precision needs NO loss scaling: params,
+reductions and elementwise math stay float32, while matmul/conv operands
+are cast to bf16 and accumulate to float32. The backward pass mirrors this
+via a custom vjp: cotangents are cast to bf16 so the gradient matmuls/convs
+also hit the MXU at full rate.
+
+Activated per-program (`program._amp_bf16 = True`, set by
+contrib.mixed_precision.decorate) and scoped around the trace by the
+Executor, so the same lowering code serves both precisions.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+
+_state = {'bf16': False}
+
+
+def enabled():
+    return _state['bf16']
+
+
+@contextlib.contextmanager
+def scope(on):
+    prev = _state['bf16']
+    _state['bf16'] = bool(on)
+    try:
+        yield
+    finally:
+        _state['bf16'] = prev
+
+
+def _is_f32(x):
+    return getattr(x, 'dtype', None) == jnp.float32
+
+
+def matmul(x, y, preferred_element_type=None):
+    """jnp.matmul that computes in bf16 (fwd AND bwd) under the amp scope."""
+    if not (enabled() and _is_f32(x) and _is_f32(y)):
+        if preferred_element_type is not None:
+            return jnp.matmul(x, y,
+                              preferred_element_type=preferred_element_type)
+        return jnp.matmul(x, y)
+
+    @jax.custom_vjp
+    def f(a, b):
+        return jnp.matmul(a.astype(jnp.bfloat16),
+                          b.astype(jnp.bfloat16)).astype(jnp.float32)
+
+    def f_fwd(a, b):
+        ab, bb = a.astype(jnp.bfloat16), b.astype(jnp.bfloat16)
+        return jnp.matmul(ab, bb).astype(jnp.float32), (ab, bb)
+
+    def f_bwd(res, g):
+        ab, bb = res
+        _, vjp = jax.vjp(jnp.matmul, ab, bb)
+        da, db = vjp(g.astype(jnp.bfloat16))
+        return da.astype(jnp.float32), db.astype(jnp.float32)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(x, y)
+
+
+def conv_general_dilated(x, w, **params):
+    """lax.conv_general_dilated in bf16 (fwd and bwd) under the amp scope."""
+    if not (enabled() and _is_f32(x) and _is_f32(w)):
+        return jax.lax.conv_general_dilated(x, w, **params)
+
+    def conv(a, b):
+        return jax.lax.conv_general_dilated(a, b, **params)
+
+    @jax.custom_vjp
+    def f(a, b):
+        return conv(a.astype(jnp.bfloat16),
+                    b.astype(jnp.bfloat16)).astype(jnp.float32)
+
+    def f_fwd(a, b):
+        ab, bb = a.astype(jnp.bfloat16), b.astype(jnp.bfloat16)
+        return conv(ab, bb).astype(jnp.float32), (ab, bb)
+
+    def f_bwd(res, g):
+        ab, bb = res
+        _, vjp = jax.vjp(conv, ab, bb)
+        da, db = vjp(g.astype(jnp.bfloat16))
+        return da.astype(jnp.float32), db.astype(jnp.float32)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(x, w)
