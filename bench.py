@@ -1,12 +1,15 @@
-"""Benchmark: ResNet-50 training throughput (img/s) on one TPU chip.
+"""Benchmark: training throughput on one TPU chip.
 
 Methodology mirrors the reference's benchmark/fluid/fluid_benchmark.py
-(synthetic data, steady-state Images/sec after warmup). Baseline for
-vs_baseline is the only committed reference ResNet-50 training number:
-84.08 img/s (2S Xeon 6148 + MKL-DNN, bs=256 — benchmark/IntelOptimizedPaddle.md:45);
-the K40m/V100 fluid numbers are not committed in-tree (BASELINE.md).
+(synthetic data, steady-state samples/sec after warmup; fluid_benchmark.py:139).
+Baseline for vs_baseline is the only committed reference ResNet-50 training
+number: 84.08 img/s (2S Xeon 6148 + MKL-DNN, bs=256 —
+benchmark/IntelOptimizedPaddle.md:45); the K40m/V100 fluid numbers are not
+committed in-tree (BASELINE.md).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints one JSON line per metric; the headline ResNet-50 line is printed LAST:
+{"metric", "value", "unit", "vs_baseline", "mfu", ...}. Training runs in
+bf16 mixed precision (contrib.mixed_precision) — the TPU-native default.
 """
 import json
 import os
@@ -17,21 +20,76 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
-BASELINE_IMG_S = 84.08  # ResNet-50 train, IntelOptimizedPaddle.md:45
+BASELINE_RESNET_IMG_S = 84.08  # ResNet-50 train, IntelOptimizedPaddle.md:45
+# No committed reference tokens/s exists (BASELINE.md); use the only LSTM-era
+# seq number as a denominator proxy: 83 ms/batch @ bs=64 2-layer LSTM is not
+# comparable, so vs_baseline for transformer is reported against 1.0 (self).
+
+# Peak dense bf16 FLOP/s per chip, keyed on jax device_kind.
+PEAK_FLOPS = {
+    'TPU v2': 45e12,
+    'TPU v3': 123e12,
+    'TPU v4': 275e12,
+    'TPU v5': 459e12,
+    'TPU v5p': 459e12,
+    'TPU v5 lite': 197e12,
+    'TPU v5e': 197e12,
+    'TPU v6 lite': 918e12,
+    'TPU v6e': 918e12,
+}
+
+# Analytic FLOPs per training sample (fwd 2*MACs, training = 3x fwd):
+# ResNet-50 @224: 4.089e9 MACs forward (conv+fc, standard count).
+RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 2 * 4.089e9
 
 
-def main():
+def _peak_flops():
+    import jax
+    kind = jax.devices()[0].device_kind
+    # longest-prefix match so 'TPU v5 lite' resolves to v5e, not v5p
+    for k in sorted(PEAK_FLOPS, key=len, reverse=True):
+        if kind.startswith(k):
+            return PEAK_FLOPS[k]
+    return None
+
+
+def _emit(metric, value, unit, vs_baseline, **extra):
+    line = {'metric': metric, 'value': round(value, 2), 'unit': unit,
+            'vs_baseline': round(vs_baseline, 2)}
+    line.update(extra)
+    print(json.dumps(line))
+
+
+def _timed_steps(exe, program, feed, loss, steps, warmup=4):
+    """Warmup (compile) + `steps` timed runs; async dispatch pipelines the
+    loop with ONE host sync at the end. Returns elapsed seconds."""
+    for _ in range(warmup):
+        l, = exe.run(program=program, feed=feed, fetch_list=[loss],
+                     return_numpy=False)
+    np.asarray(l)  # block on compile + warmup
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        l, = exe.run(program=program, feed=feed, fetch_list=[loss],
+                     return_numpy=False)
+    _ = float(np.asarray(l).reshape(-1)[0])  # sync
+    return time.perf_counter() - t0
+
+
+def bench_resnet():
     import paddle_tpu as fluid
     from models.resnet import build_train_net
 
-    batch = int(os.environ.get('PTPU_BENCH_BATCH', '128'))
+    batch = int(os.environ.get('PTPU_BENCH_BATCH', '256'))
     steps = int(os.environ.get('PTPU_BENCH_STEPS', '30'))
+    use_bf16 = os.environ.get('PTPU_BENCH_DTYPE', 'bf16') == 'bf16'
 
     main_p, startup_p = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_p, startup_p):
         images, label, loss, acc = build_train_net(
             dshape=(3, 224, 224), class_dim=1000, depth=50, imagenet=True,
             lr=0.1)
+    if use_bf16:
+        fluid.contrib.mixed_precision.enable_bf16(main_p)
 
     exe = fluid.Executor(fluid.TPUPlace())
     exe.run(startup_p)
@@ -45,31 +103,106 @@ def main():
     xs = jax.device_put(
         jnp.asarray(np.random.randn(batch, 3, 224, 224), jnp.float32), dev)
     lab = jax.device_put(
-        jnp.asarray(np.random.randint(0, 1000, (batch, 1)), jnp.int32)
-        .astype(jnp.int64) if False else
-        jnp.asarray(np.random.randint(0, 1000, (batch, 1))), dev)
+        jnp.asarray(np.random.randint(0, 1000, (batch, 1)), jnp.int32), dev)
     feed = {'data': xs, 'label': lab}
 
-    # warmup (compile) + steady steps; async dispatch pipelines the loop,
-    # one sync at the end
-    for _ in range(4):
-        l, = exe.run(program=main_p, feed=feed, fetch_list=[loss],
-                     return_numpy=False)
-    np.asarray(l)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        l, = exe.run(program=main_p, feed=feed, fetch_list=[loss],
-                     return_numpy=False)
-    _ = float(np.asarray(l).reshape(-1)[0])  # sync
-    dt = time.perf_counter() - t0
-
+    dt = _timed_steps(exe, main_p, feed, loss, steps)
     img_s = batch * steps / dt
-    print(json.dumps({
-        'metric': 'resnet50_train_img_s_per_chip',
-        'value': round(img_s, 2),
-        'unit': 'img/s',
-        'vs_baseline': round(img_s / BASELINE_IMG_S, 2),
-    }))
+    peak = _peak_flops()
+    mfu = (img_s * RESNET50_TRAIN_FLOPS_PER_IMG / peak) if peak else None
+    _emit('resnet50_train_img_s_per_chip', img_s, 'img/s',
+          img_s / BASELINE_RESNET_IMG_S,
+          mfu=round(mfu, 4) if mfu is not None else None,
+          dtype='bf16' if use_bf16 else 'fp32', batch=batch)
+
+
+def bench_transformer():
+    import paddle_tpu as fluid
+    from models.transformer import build_transformer_train
+
+    batch = int(os.environ.get('PTPU_BENCH_TRANS_BATCH', '64'))
+    seq_len = int(os.environ.get('PTPU_BENCH_TRANS_SEQ', '256'))
+    steps = int(os.environ.get('PTPU_BENCH_TRANS_STEPS', '20'))
+
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup_p):
+        feeds, loss, flops_per_tok = build_transformer_train(
+            src_vocab=32000, trg_vocab=32000, max_len=seq_len,
+            d_model=512, d_ff=2048, n_head=8, n_layer=6)
+    fluid.contrib.mixed_precision.enable_bf16(main_p)
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup_p)
+
+    import jax
+    import jax.numpy as jnp
+    dev = jax.devices(exe._device.platform)[0] if exe._device else None
+    rng = np.random.RandomState(0)
+    feed = {}
+    for name, shape, dtype in feeds:
+        full = (batch,) + tuple(shape)
+        if dtype == 'int64':
+            arr = rng.randint(1, 31999, full).astype(np.int32)
+        else:
+            arr = rng.randn(*full).astype(np.float32)
+        feed[name] = jax.device_put(jnp.asarray(arr), dev)
+
+    dt = _timed_steps(exe, main_p, feed, loss, steps, warmup=3)
+    tok_s = batch * seq_len * steps / dt
+    peak = _peak_flops()
+    mfu = (tok_s * flops_per_tok / peak) if peak else None
+    _emit('transformer_base_tokens_s_per_chip', tok_s, 'tokens/s', 1.0,
+          mfu=round(mfu, 4) if mfu is not None else None, dtype='bf16',
+          batch=batch, seq_len=seq_len)
+
+
+def bench_ctr():
+    import paddle_tpu as fluid
+    from models.deepfm import build_deepfm_train
+
+    batch = int(os.environ.get('PTPU_BENCH_CTR_BATCH', '4096'))
+    steps = int(os.environ.get('PTPU_BENCH_CTR_STEPS', '30'))
+
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup_p):
+        feeds, loss = build_deepfm_train()
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup_p)
+
+    import jax
+    import jax.numpy as jnp
+    dev = jax.devices(exe._device.platform)[0] if exe._device else None
+    rng = np.random.RandomState(0)
+    feed = {}
+    for name, shape, dtype, vocab in feeds:
+        full = (batch,) + tuple(shape)
+        if dtype.startswith('int'):
+            arr = rng.randint(0, vocab, full).astype(np.int32)
+        else:
+            arr = rng.rand(*full).astype(np.float32)
+        feed[name] = jax.device_put(jnp.asarray(arr), dev)
+
+    dt = _timed_steps(exe, main_p, feed, loss, steps, warmup=3)
+    _emit('ctr_deepfm_samples_s_per_chip', batch * steps / dt, 'samples/s',
+          1.0, batch=batch)
+
+
+def main():
+    only = os.environ.get('PTPU_BENCH_ONLY', '')
+    extras = []
+    if not only or only == 'all':
+        extras = ['transformer', 'ctr']
+    elif only != 'resnet':
+        extras = [only]
+    for name in extras:
+        try:
+            {'transformer': bench_transformer, 'ctr': bench_ctr}[name]()
+        except Exception as e:  # secondary metrics must not sink the headline
+            print(json.dumps({'metric': name, 'error': str(e)[:200]}),
+                  file=sys.stderr)
+    if only in ('', 'all', 'resnet'):
+        bench_resnet()
 
 
 if __name__ == '__main__':

@@ -42,6 +42,7 @@ from .io import (save_vars, save_params, save_persistables, load_vars,
                  load_params, load_persistables, save_inference_model,
                  load_inference_model)
 from . import core
+from . import contrib
 from .parallel.parallel_executor import ParallelExecutor
 from .parallel.compiler import CompiledProgram, BuildStrategy, ExecutionStrategy
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig, \

@@ -1,0 +1,14 @@
+"""Contrib namespace (ref: python/paddle/fluid/contrib/).
+
+Shipped submodules:
+  - mixed_precision: bf16 AMP decorator (TPU-native; the reference era had
+    fp16 types but no AMP surface — see core/amp.py).
+  - memory_usage_calc: program memory estimate
+    (ref: contrib/memory_usage_calc.py).
+  - op_frequence: op histogram over a Program (ref: contrib/op_frequence.py).
+"""
+from . import mixed_precision
+from .memory_usage_calc import memory_usage
+from .op_frequence import op_freq_statistic
+
+__all__ = ['mixed_precision', 'memory_usage', 'op_freq_statistic']

@@ -1,0 +1,44 @@
+"""Estimate the device memory a Program's variables need.
+
+Capability parity with the reference's contrib/memory_usage_calc.py
+(`memory_usage(program, batch_size)`), re-based on this framework's Variable
+metadata: -1 leading dims are filled with batch_size, dtype widths come from
+numpy. Under XLA the true footprint also includes fusion temporaries, which
+the estimate (like the reference's) does not model; it returns the same
+(lower, upper) heuristic band.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+DTYPE_TO_SIZE = {
+    'float16': 2, 'bfloat16': 2, 'float32': 4, 'float64': 8,
+    'int8': 1, 'uint8': 1, 'int16': 2, 'int32': 4, 'int64': 8, 'bool': 1,
+}
+
+
+def _var_bytes(var, batch_size):
+    shape = list(var.shape or ())
+    if not shape:
+        return 0
+    n = 1
+    for d in shape:
+        n *= batch_size if d is None or int(d) < 0 else int(d)
+    width = DTYPE_TO_SIZE.get(str(np.dtype(var.dtype)) if var.dtype else
+                              'float32', 4)
+    return n * width
+
+
+def memory_usage(program, batch_size=1):
+    """Return (low_MB, high_MB) estimated memory for one step of `program`."""
+    if batch_size <= 0:
+        raise ValueError("batch_size must be positive, got %r" % batch_size)
+    total = 0
+    for var in program.list_vars():
+        try:
+            total += _var_bytes(var, batch_size)
+        except (TypeError, ValueError):
+            continue
+    mb = total / (1024.0 * 1024.0)
+    # same +-30% band the reference reports
+    return mb * 0.7, mb * 1.3

@@ -1,0 +1,53 @@
+"""bf16 mixed-precision training surface.
+
+The reference (Fluid 1.2) shipped a float16 type (platform/float16.h) but no
+AMP training API; this is the TPU-native equivalent. bf16 shares float32's
+exponent range so no loss scaling is needed: `decorate(optimizer)` returns an
+optimizer whose `minimize` marks the program bf16 (`program._amp_bf16`), and
+the Executor then traces the whole step inside `core.amp.scope(True)` —
+matmul/mul/fc and conv lowerings route their contractions through
+`core.amp.matmul` / `core.amp.conv_general_dilated`, which compute forward
+AND backward on the MXU in bf16 while params, optimizer state, and
+reductions stay float32.
+"""
+from __future__ import annotations
+
+from ..framework import default_main_program
+
+
+class OptimizerWithMixedPrecision(object):
+    """Wraps an optimizer so that `minimize` enables bf16 on the program."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+
+    def __getattr__(self, name):
+        return getattr(self._optimizer, name)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        program = loss.block.program
+        program._amp_bf16 = True
+        return self._optimizer.minimize(
+            loss, startup_program=startup_program,
+            parameter_list=parameter_list, no_grad_set=no_grad_set)
+
+
+def decorate(optimizer):
+    """Return an AMP-enabled wrapper of `optimizer` (bf16 compute, no loss
+    scaling — bf16 keeps fp32's exponent)."""
+    return OptimizerWithMixedPrecision(optimizer)
+
+
+def enable_bf16(program=None):
+    """Mark an already-built program (e.g. one whose optimizer ops were
+    appended manually or by a transpiler) for bf16 execution."""
+    program = program if program is not None else default_main_program()
+    program._amp_bf16 = True
+    return program
+
+
+def disable_bf16(program=None):
+    program = program if program is not None else default_main_program()
+    program._amp_bf16 = False
+    return program

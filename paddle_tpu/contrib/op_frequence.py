@@ -1,0 +1,27 @@
+"""Op-type histogram over a Program (ref: contrib/op_frequence.py).
+
+Useful when deciding which lowerings deserve Pallas attention: run it on a
+real model's program and read off the hot op families.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+
+def op_freq_statistic(program):
+    """Return (uni_op_freq, adj_op_freq): single-op counts and counts of
+    adjacent op pairs ("a->b"), both most-frequent-first."""
+    uni, adj = {}, {}
+    for block in program.blocks:
+        prev = None
+        for op in block.ops:
+            uni[op.type] = uni.get(op.type, 0) + 1
+            if prev is not None:
+                key = prev + '->' + op.type
+                adj[key] = adj.get(key, 0) + 1
+            prev = op.type
+    uni_sorted = OrderedDict(
+        sorted(uni.items(), key=lambda kv: kv[1], reverse=True))
+    adj_sorted = OrderedDict(
+        sorted(adj.items(), key=lambda kv: kv[1], reverse=True))
+    return uni_sorted, adj_sorted

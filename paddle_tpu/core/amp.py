@@ -2,15 +2,22 @@
 
 The reference era had a float16 type (platform/float16.h) but no AMP
 training surface; on TPU bf16 is the MXU-native input format and shares
-float32's exponent range, so mixed precision needs NO loss scaling: params,
-reductions and elementwise math stay float32, while matmul/conv operands
-are cast to bf16 and accumulate to float32. The backward pass mirrors this
-via a custom vjp: cotangents are cast to bf16 so the gradient matmuls/convs
-also hit the MXU at full rate.
+float32's exponent range, so mixed precision needs NO loss scaling.
+
+Design ("value-mode" bf16, the jmp/flax policy): under the amp scope,
+matmul/conv lowerings cast operands to bf16 and KEEP the result bf16, so
+activations flow through the network at half the HBM traffic — this, not the
+MXU rate, is what bounds BN-heavy models like ResNet on TPU. Params stay
+float32 in the state dict; they are cast to bf16 at each use inside the
+traced step, and the transpose of that cast makes every parameter gradient
+arrive float32 for the optimizer with no explicit plumbing. Numerically
+sensitive ops opt out via `promote_f32`: norm statistics, softmax, and
+losses compute in float32 (nn_ops/math_ops call it regardless of amp —
+bf16 inputs are upcast wherever stats/log-exp live).
 
 Activated per-program (`program._amp_bf16 = True`, set by
-contrib.mixed_precision.decorate) and scoped around the trace by the
-Executor, so the same lowering code serves both precisions.
+contrib.mixed_precision.decorate / enable_bf16) and scoped around the trace
+by the Executor, so the same lowering code serves both precisions.
 """
 from __future__ import annotations
 
@@ -36,59 +43,56 @@ def scope(on):
         _state['bf16'] = prev
 
 
-def _is_f32(x):
-    return getattr(x, 'dtype', None) == jnp.float32
+def _is_amp_float(x):
+    return getattr(x, 'dtype', None) in (jnp.float32, jnp.bfloat16)
+
+
+def promote_f32(x):
+    """Upcast bf16 to f32 for numerically sensitive math (norm stats,
+    softmax, log/exp losses). Identity for every other dtype."""
+    if getattr(x, 'dtype', None) == jnp.bfloat16:
+        return x.astype(jnp.float32)
+    return x
+
+
+def restore(y, like):
+    """Cast y back to `like`'s compute dtype (bf16 stays bf16)."""
+    dt = getattr(like, 'dtype', None)
+    if dt == jnp.bfloat16 and y.dtype == jnp.float32:
+        return y.astype(jnp.bfloat16)
+    return y
+
+
+def unify(x, y):
+    """Under the amp scope, resolve a bf16/f32 operand mix to bf16 — a
+    value-mode program otherwise silently re-promotes to f32 at every
+    param + activation elementwise (e.g. the fc bias add), defeating the
+    halved-HBM-traffic design. Identity outside the scope or for any other
+    dtype pairing."""
+    if (enabled()
+            and getattr(x, 'dtype', None) in (jnp.float32, jnp.bfloat16)
+            and getattr(y, 'dtype', None) in (jnp.float32, jnp.bfloat16)
+            and x.dtype != y.dtype):
+        return x.astype(jnp.bfloat16), y.astype(jnp.bfloat16)
+    return x, y
 
 
 def matmul(x, y, preferred_element_type=None):
-    """jnp.matmul that computes in bf16 (fwd AND bwd) under the amp scope."""
-    if not (enabled() and _is_f32(x) and _is_f32(y)):
-        if preferred_element_type is not None:
-            return jnp.matmul(x, y,
-                              preferred_element_type=preferred_element_type)
-        return jnp.matmul(x, y)
+    """jnp.matmul that runs operands and result in bf16 under the amp scope.
 
-    @jax.custom_vjp
-    def f(a, b):
-        return jnp.matmul(a.astype(jnp.bfloat16),
-                          b.astype(jnp.bfloat16)).astype(jnp.float32)
-
-    def f_fwd(a, b):
-        ab, bb = a.astype(jnp.bfloat16), b.astype(jnp.bfloat16)
-        return jnp.matmul(ab, bb).astype(jnp.float32), (ab, bb)
-
-    def f_bwd(res, g):
-        ab, bb = res
-        _, vjp = jax.vjp(jnp.matmul, ab, bb)
-        da, db = vjp(g.astype(jnp.bfloat16))
-        return da.astype(jnp.float32), db.astype(jnp.float32)
-
-    f.defvjp(f_fwd, f_bwd)
-    return f(x, y)
+    The result stays bf16 (MXU accumulates f32 internally); the backward
+    matmuls are bf16 automatically since jax.vjp of a bf16 matmul is bf16.
+    """
+    if enabled() and _is_amp_float(x) and _is_amp_float(y):
+        return jnp.matmul(x.astype(jnp.bfloat16), y.astype(jnp.bfloat16))
+    if preferred_element_type is not None:
+        return jnp.matmul(x, y, preferred_element_type=preferred_element_type)
+    return jnp.matmul(x, y)
 
 
 def conv_general_dilated(x, w, **params):
-    """lax.conv_general_dilated in bf16 (fwd and bwd) under the amp scope."""
-    if not (enabled() and _is_f32(x) and _is_f32(w)):
-        return jax.lax.conv_general_dilated(x, w, **params)
-
-    def conv(a, b):
-        return jax.lax.conv_general_dilated(a, b, **params)
-
-    @jax.custom_vjp
-    def f(a, b):
-        return conv(a.astype(jnp.bfloat16),
-                    b.astype(jnp.bfloat16)).astype(jnp.float32)
-
-    def f_fwd(a, b):
-        ab, bb = a.astype(jnp.bfloat16), b.astype(jnp.bfloat16)
-        return conv(ab, bb).astype(jnp.float32), (ab, bb)
-
-    def f_bwd(res, g):
-        ab, bb = res
-        _, vjp = jax.vjp(conv, ab, bb)
-        da, db = vjp(g.astype(jnp.bfloat16))
-        return da.astype(jnp.float32), db.astype(jnp.float32)
-
-    f.defvjp(f_fwd, f_bwd)
-    return f(x, w)
+    """lax.conv_general_dilated in bf16 (result stays bf16) under amp."""
+    if enabled() and _is_amp_float(x) and _is_amp_float(w):
+        return jax.lax.conv_general_dilated(
+            x.astype(jnp.bfloat16), w.astype(jnp.bfloat16), **params)
+    return jax.lax.conv_general_dilated(x, w, **params)

@@ -20,6 +20,7 @@ from .framework import Program, Variable, default_main_program, _place_backend
 from .core.scope import Scope, global_scope, scope_guard  # re-export
 from .core.lowering import Tracer
 from .core.lod import LoDArray, unwrap
+from .core import amp
 
 
 import contextlib
@@ -192,18 +193,24 @@ class Executor(object):
                 tuple((n, self._sig(v)) for n, v in sorted(feed_vals.items())),
                 tuple(fetch_names),
                 tuple((n, self._sig(v)) for n, v in sorted(state.items())),
-                out_names)
+                out_names, bool(getattr(program, '_amp_bf16', False)))
 
     def _build(self, program, feed_names, fetch_names, state_names,
                out_state_names, mesh=None, feed_vals=None):
+        amp_on = bool(getattr(program, '_amp_bf16', False))
+
         def step(state, feed, rng):
-            tracer = Tracer(program, rng)
-            tracer.env.update(state)
-            tracer.env.update(feed)
-            tracer.run_block(program.global_block())
-            fetches = [tracer.env[n] for n in fetch_names]
-            new_state = {n: tracer.env[n] for n in out_state_names
-                         if n in tracer.env}
+            # amp scope is a trace-time flag: the body below runs exactly
+            # once per compile, so the context governs which lowering the
+            # matmul/conv ops pick (core/amp.py), not per-step state
+            with amp.scope(amp_on):
+                tracer = Tracer(program, rng)
+                tracer.env.update(state)
+                tracer.env.update(feed)
+                tracer.run_block(program.global_block())
+                fetches = [tracer.env[n] for n in fetch_names]
+                new_state = {n: tracer.env[n] for n in out_state_names
+                             if n in tracer.env}
             return fetches, new_state
 
         if mesh is None:

@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.registry import register
+from ..core import amp
 
 
 def X(ins, slot='X'):
@@ -38,6 +39,7 @@ def _elementwise(name, fn):
     def _lower(ctx, ins, _fn=fn):
         x, y = ins['X'][0], ins['Y'][0]
         y = _bcast_y(x, y, ctx.attr('axis', -1))
+        x, y = amp.unify(x, y)
         out = _fn(x, y)
         scale = ctx.attr('scale', None)  # fused scale (rare attr)
         if scale not in (None, 1.0):
@@ -218,7 +220,7 @@ def _mul(ctx, ins):
     yn = ctx.attr('y_num_col_dims', 1)
     x2 = _flatten2(x, xn)
     y2 = y.reshape(int(np.prod(y.shape[:yn])), -1)
-    out = jnp.matmul(x2, y2, preferred_element_type=x2.dtype)
+    out = amp.matmul(x2, y2, preferred_element_type=x2.dtype)
     out_shape = x.shape[:xn] + y.shape[yn:]
     return {'Out': [out.reshape(out_shape)]}
 
@@ -239,7 +241,7 @@ def _matmul(ctx, ins):
         x = jnp.swapaxes(x, -1, -2)
     if ty:
         y = jnp.swapaxes(y, -1, -2)
-    out = jnp.matmul(x, y)
+    out = amp.matmul(x, y)
     if alpha != 1.0:
         out = out * alpha
     if squeeze_out:
@@ -284,8 +286,9 @@ _reduce('reduce_prod', jnp.prod)
 
 @register('mean')
 def _mean(ctx, ins):
-    # reference mean_op emits a {1}-shaped tensor (mean_op.cc InferShape)
-    return {'Out': [jnp.mean(X(ins)).reshape(1)]}
+    # reference mean_op emits a {1}-shaped tensor (mean_op.cc InferShape);
+    # loss reductions accumulate in f32 even when activations flow bf16
+    return {'Out': [jnp.mean(amp.promote_f32(X(ins))).reshape(1)]}
 
 
 @register('scale')
@@ -322,18 +325,23 @@ def _cast(ctx, ins):
 # ---------------------------------------------------------------------------
 @register('softmax')
 def _softmax(ctx, ins):
+    x = X(ins)
     axis = ctx.attr('axis', -1)
-    return {'Out': [jax.nn.softmax(X(ins), axis=axis)]}
+    # exp/sum in f32 for bf16 activations, back to the compute dtype after
+    return {'Out': [amp.restore(jax.nn.softmax(amp.promote_f32(x),
+                                               axis=axis), x)]}
 
 
 @register('log_softmax')
 def _log_softmax(ctx, ins):
-    return {'Out': [jax.nn.log_softmax(X(ins), axis=ctx.attr('axis', -1))]}
+    x = X(ins)
+    out = jax.nn.log_softmax(amp.promote_f32(x), axis=ctx.attr('axis', -1))
+    return {'Out': [amp.restore(out, x)]}
 
 
 @register('cross_entropy')
 def _cross_entropy(ctx, ins):
-    x = X(ins)  # probabilities [N, C] (or [..., C])
+    x = amp.promote_f32(X(ins))  # probabilities [N, C] (or [..., C])
     label = ins['Label'][0]
     logp = jnp.log(jnp.clip(x, 1e-20))
     if ctx.attr('soft_label', False):
@@ -350,7 +358,7 @@ def _cross_entropy(ctx, ins):
 
 @register('softmax_with_cross_entropy')
 def _softmax_with_cross_entropy(ctx, ins):
-    logits = ins['Logits'][0]
+    logits = amp.promote_f32(ins['Logits'][0])  # loss math stays f32
     label = ins['Label'][0]
     logp = jax.nn.log_softmax(logits, axis=-1)
     if ctx.attr('soft_label', False):
@@ -361,7 +369,8 @@ def _softmax_with_cross_entropy(ctx, ins):
         picked = jnp.take_along_axis(logp, lab[..., None].astype(jnp.int32),
                                      axis=-1)
         loss = jnp.where((lab == ignore)[..., None], 0.0, -picked)
-    return {'Softmax': [jnp.exp(logp)], 'Loss': [loss]}
+    return {'Softmax': [amp.restore(jnp.exp(logp), ins['Logits'][0])],
+            'Loss': [loss]}
 
 
 @register('square_error_cost')

@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.registry import register
+from ..core import amp
 from .math_ops import X
 
 
@@ -32,7 +33,7 @@ def _conv2d(ctx, ins):
     pads = _pair(ctx.attr('paddings', [0, 0]))
     dils = _pair(ctx.attr('dilations', [1, 1]))
     groups = ctx.attr('groups', 1) or 1
-    out = jax.lax.conv_general_dilated(
+    out = amp.conv_general_dilated(
         x, w, window_strides=strides,
         padding=[(pads[0], pads[0]), (pads[1], pads[1])],
         rhs_dilation=dils, feature_group_count=groups,
@@ -52,7 +53,7 @@ def _conv3d(ctx, ins):
     pads = _pair(ctx.attr('paddings', [0, 0, 0]), 3)
     dils = _pair(ctx.attr('dilations', [1, 1, 1]), 3)
     groups = ctx.attr('groups', 1) or 1
-    out = jax.lax.conv_general_dilated(
+    out = amp.conv_general_dilated(
         x, w, window_strides=strides,
         padding=[(p, p) for p in pads], rhs_dilation=dils,
         feature_group_count=groups,
@@ -75,7 +76,7 @@ def _conv_transpose(x, w, strides, pads, dils, groups, nd):
     padding = [(dki - 1 - p, dki - 1 - p) for dki, p in zip(dk, pads)]
     dims = (('NCHW', 'OIHW', 'NCHW') if nd == 2
             else ('NCDHW', 'OIDHW', 'NCDHW'))
-    return jax.lax.conv_general_dilated(
+    return amp.conv_general_dilated(
         x, wt, window_strides=[1] * nd, padding=padding,
         lhs_dilation=strides, rhs_dilation=dils, dimension_numbers=dims)
 
@@ -181,7 +182,8 @@ def _pool3d(ctx, ins):
 # ---------------------------------------------------------------------------
 @register('batch_norm')
 def _batch_norm(ctx, ins):
-    x = X(ins)
+    x_in = X(ins)
+    x = amp.promote_f32(x_in)  # batch stats accumulate in f32
     scale, bias = ins['Scale'][0], ins['Bias'][0]
     mean, var = ins['Mean'][0], ins['Variance'][0]
     eps = ctx.attr('epsilon', 1e-5)
@@ -207,13 +209,15 @@ def _batch_norm(ctx, ins):
         saved_mean, saved_var = m, v
     inv = jax.lax.rsqrt(v.reshape(bshape) + eps)
     y = (x - m.reshape(bshape)) * inv * scale.reshape(bshape) + bias.reshape(bshape)
-    return {'Y': [y], 'MeanOut': [mean_out], 'VarianceOut': [var_out],
+    return {'Y': [amp.restore(y, x_in)], 'MeanOut': [mean_out],
+            'VarianceOut': [var_out],
             'SavedMean': [saved_mean], 'SavedVariance': [inv.reshape(v.shape)]}
 
 
 @register('layer_norm')
 def _layer_norm(ctx, ins):
-    x = X(ins)
+    x_in = X(ins)
+    x = amp.promote_f32(x_in)
     eps = ctx.attr('epsilon', 1e-5)
     axis = ctx.attr('begin_norm_axis', 1)
     red = tuple(range(axis, x.ndim))
@@ -226,12 +230,14 @@ def _layer_norm(ctx, ins):
     if ins.get('Bias') and ins['Bias'][0] is not None:
         y = y + ins['Bias'][0].reshape(norm_shape)
     lead = int(np.prod(x.shape[:axis]))
-    return {'Y': [y], 'Mean': [m.reshape(lead)], 'Variance': [v.reshape(lead)]}
+    return {'Y': [amp.restore(y, x_in)], 'Mean': [m.reshape(lead)],
+            'Variance': [v.reshape(lead)]}
 
 
 @register('group_norm')
 def _group_norm(ctx, ins):
-    x = X(ins)  # NCHW
+    x_in = X(ins)  # NCHW
+    x = amp.promote_f32(x_in)
     g = ctx.attr('groups')
     eps = ctx.attr('epsilon', 1e-5)
     n, c = x.shape[0], x.shape[1]
@@ -245,7 +251,8 @@ def _group_norm(ctx, ins):
         y = y * ins['Scale'][0].reshape(bshape)
     if ins.get('Bias') and ins['Bias'][0] is not None:
         y = y + ins['Bias'][0].reshape(bshape)
-    return {'Y': [y], 'Mean': [m.reshape(n, g)], 'Variance': [v.reshape(n, g)]}
+    return {'Y': [amp.restore(y, x_in)], 'Mean': [m.reshape(n, g)],
+            'Variance': [v.reshape(n, g)]}
 
 
 @register('data_norm')
