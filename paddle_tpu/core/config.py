@@ -42,12 +42,21 @@ def accel_devices():
 
 
 # -- FLAGS registry (reference gflags equivalents) ---------------------------
+# check_nan_inf -> jax.debug_nans around every Executor step (the moral
+#   equivalent of the reference's per-op output scan, operator.cc:896-905).
+# deterministic -> when a program has no random_seed, the Executor still
+#   derives per-step rng from a fixed root (reproducible across processes);
+#   with the flag off it folds in process entropy like the reference's
+#   unseeded generators. Deterministic-by-default is the TPU-first choice.
 FLAGS = {
     'check_nan_inf': os.environ.get('FLAGS_check_nan_inf', '0') == '1',
     'benchmark': os.environ.get('FLAGS_benchmark', '0') == '1',
     'eager_delete_tensor_gb': float(
         os.environ.get('FLAGS_eager_delete_tensor_gb', '-1')),
-    'deterministic': os.environ.get('FLAGS_cudnn_deterministic', '0') == '1',
+    # FLAGS_deterministic is our own flag (deterministic by default); the
+    # reference's FLAGS_cudnn_deterministic keeps its narrow meaning and is
+    # subsumed (XLA TPU kernels are deterministic), so it is NOT overloaded
+    'deterministic': os.environ.get('FLAGS_deterministic', '1') == '1',
     'tensor_array_capacity': int(
         os.environ.get('FLAGS_tensor_array_capacity', '128')),
 }

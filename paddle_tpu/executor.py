@@ -39,6 +39,17 @@ def _fetch_name(f):
 
 
 _analysis_cache = {}
+_entropy_seed = None
+
+
+def _process_entropy():
+    """Per-process random seed root, drawn once (used when a program has no
+    random_seed and FLAGS deterministic is off)."""
+    global _entropy_seed
+    if _entropy_seed is None:
+        import os as _os
+        _entropy_seed = int.from_bytes(_os.urandom(4), 'little') or 1
+    return _entropy_seed
 
 
 def _program_analysis(program):
@@ -133,12 +144,23 @@ class Executor(object):
 
         step = self._step_counters.get(program._uid, 0)
         self._step_counters[program._uid] = step + 1
-        seed = program.random_seed or 1234567
+        from .core import config as _config
+        seed = program.random_seed
+        if not seed:
+            seed = 1234567 if _config.get_flag('deterministic') \
+                else _process_entropy()
         with jax.default_device(self._device) if self._device is not None \
                 else _nullcontext():
             rng = jax.random.fold_in(jax.random.key(seed), step)
 
-        fetches, new_state = fn(state, feed_vals, rng)
+        if _config.get_flag('check_nan_inf'):
+            # reference FLAGS_check_nan_inf scans every op output
+            # (operator.cc:896-905); jax.debug_nans re-runs the step
+            # un-jitted on a nan/inf and pinpoints the producing op
+            with jax.debug_nans(True):
+                fetches, new_state = fn(state, feed_vals, rng)
+        else:
+            fetches, new_state = fn(state, feed_vals, rng)
         for name, val in new_state.items():
             scope.set(name, val)
 
@@ -174,8 +196,10 @@ class Executor(object):
             lod, data = value.lod(), np.asarray(value)
         with jax.default_device(self._device) if self._device is not None \
                 else _nullcontext():
+            # runtime_dtype canonicalizes declared int64/float64 to the
+            # 32-bit carrier up front instead of warning per feed
             arr = jnp.asarray(np.asarray(data),
-                              dtype=jnp.dtype(dtype) if dtype else None)
+                              dtype=framework.runtime_dtype(dtype))
         if self._device is not None:
             arr = jax.device_put(arr, self._device)
         if lod:

@@ -53,6 +53,21 @@ def is_float_dtype(dtype):
     return convert_dtype(dtype) in ('float16', 'bfloat16', 'float32', 'float64')
 
 
+def runtime_dtype(dtype):
+    """The dtype a declared var dtype actually carries on device: jax
+    without x64 stores int64/float64 as 32-bit. Canonicalizing HERE keeps
+    declared dtypes ('int64' per reference op protos) separate from carrier
+    dtypes, instead of warning on every truncating astype."""
+    import jax
+    if dtype is None:
+        return None
+    s = convert_dtype(dtype)
+    if s == 'bfloat16':
+        import jax.numpy as jnp
+        return jnp.bfloat16
+    return jax.dtypes.canonicalize_dtype(np.dtype(s))
+
+
 class Variable(object):
     """A named tensor slot in a Block (ref: fluid/framework.py:232).
 

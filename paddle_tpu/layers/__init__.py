@@ -13,7 +13,7 @@ from .tensor import (create_tensor, create_parameter, create_global_var,  # noqa
                      ones, zeros, zeros_like, reverse, has_inf, has_nan,
                      isfinite, tensor_array_to_tensor)
 from .io import (data, read_file, load, py_reader,  # noqa: F401
-                 create_py_reader_by_data, double_buffer)
+                 create_py_reader_by_data, double_buffer, batch, shuffle)
 from .sequence import (sequence_pool, sequence_first_step,  # noqa: F401
                        sequence_last_step, sequence_softmax, sequence_conv,
                        sequence_expand, sequence_expand_as, sequence_concat,

@@ -1,55 +1,89 @@
-"""Host-side metric accumulators (ref: python/paddle/fluid/metrics.py:57-566)."""
+"""Host-side metric accumulators.
+
+Capability parity with the reference's python/paddle/fluid/metrics.py
+(MetricBase:57, CompositeMetric, Precision, Recall, Accuracy,
+ChunkEvaluator, EditDistance, Auc) — same public API, reimplemented
+TPU-side-friendly: every `update` is vectorized numpy over whole fetched
+batches (the fetched arrays come off-device once per step; per-sample
+Python loops would dominate at TPU batch sizes).
+"""
 from __future__ import annotations
+
+import copy
 
 import numpy as np
 
+__all__ = ['MetricBase', 'CompositeMetric', 'Precision', 'Recall',
+           'Accuracy', 'ChunkEvaluator', 'EditDistance', 'Auc']
 
-def _is_numpy_(var):
-    return isinstance(var, (np.ndarray, np.generic))
+
+def _flat(x):
+    return np.asarray(x).reshape(-1)
+
+
+def _scalar(x):
+    return float(_flat(x)[0])
+
+
+def _pred_label_pair(preds, labels, who):
+    p = np.rint(_flat(preds)).astype(np.int64)
+    l = _flat(labels).astype(np.int64)
+    if p.shape != l.shape:
+        raise ValueError("%s: preds and labels length mismatch: %d vs %d"
+                         % (who, p.size, l.size))
+    return p, l
 
 
 class MetricBase(object):
-    def __init__(self, name):
-        self._name = str(name) if name is not None else self.__class__.__name__
+    """Base accumulator. Numeric public attributes are the state; `reset`
+    zeroes them by dtype, `get_config` snapshots them."""
+
+    def __init__(self, name=None):
+        self._name = name if name is not None else type(self).__name__
 
     def __str__(self):
         return self._name
 
+    def _state_items(self):
+        return [(k, v) for k, v in vars(self).items() if not k.startswith('_')]
+
     def reset(self):
-        states = {attr: value for attr, value in self.__dict__.items()
-                  if not attr.startswith("_")}
-        for attr, value in states.items():
-            if isinstance(value, int):
-                setattr(self, attr, 0)
-            elif isinstance(value, float):
-                setattr(self, attr, .0)
-            elif isinstance(value, (np.ndarray, np.generic)):
-                setattr(self, attr, np.zeros_like(value))
+        for k, v in self._state_items():
+            if isinstance(v, float):
+                setattr(self, k, 0.0)
+            elif isinstance(v, int):
+                setattr(self, k, 0)
+            elif isinstance(v, np.ndarray):
+                setattr(self, k, np.zeros_like(v))
+            elif isinstance(v, list):
+                setattr(self, k, [0] * len(v))
             else:
-                setattr(self, attr, None)
+                setattr(self, k, None)
 
     def get_config(self):
-        states = {attr: value for attr, value in self.__dict__.items()
-                  if not attr.startswith("_")}
-        config = {}
-        config.update({"name": self._name, "states": copy.deepcopy(states)})
-        return config
+        return {'name': self._name,
+                'states': copy.deepcopy(dict(self._state_items()))}
 
-    def update(self, preds, labels):
-        raise NotImplementedError()
+    def update(self, *args, **kwargs):
+        raise NotImplementedError(
+            "%s must implement update()" % type(self).__name__)
 
     def eval(self):
-        raise NotImplementedError()
+        raise NotImplementedError(
+            "%s must implement eval()" % type(self).__name__)
 
 
 class CompositeMetric(MetricBase):
+    """Fans one (pred, label) stream out to several metrics."""
+
     def __init__(self, name=None):
         super().__init__(name)
         self._metrics = []
 
     def add_metric(self, metric):
         if not isinstance(metric, MetricBase):
-            raise ValueError("SubMetric should be inherit from MetricBase.")
+            raise TypeError("add_metric expects a MetricBase, got %r"
+                            % type(metric).__name__)
         self._metrics.append(metric)
 
     def update(self, preds, labels):
@@ -61,85 +95,78 @@ class CompositeMetric(MetricBase):
 
 
 class Precision(MetricBase):
+    """Binary precision: TP / (TP + FP), accumulated over batches."""
+
     def __init__(self, name=None):
         super().__init__(name)
         self.tp = 0
         self.fp = 0
 
     def update(self, preds, labels):
-        preds = np.asarray(preds)
-        labels = np.asarray(labels)
-        sample_num = labels.shape[0]
-        preds = np.rint(preds).astype("int32")
-        for i in range(sample_num):
-            pred = preds[i].item() if hasattr(preds[i], 'item') else preds[i]
-            label = labels[i]
-            if pred == 1:
-                if pred == label:
-                    self.tp += 1
-                else:
-                    self.fp += 1
+        p, l = _pred_label_pair(preds, labels, 'Precision')
+        pos = p == 1
+        self.tp += int(np.count_nonzero(pos & (l == 1)))
+        self.fp += int(np.count_nonzero(pos & (l != 1)))
 
     def eval(self):
-        ap = self.tp + self.fp
-        return float(self.tp) / ap if ap != 0 else .0
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
 
 
 class Recall(MetricBase):
+    """Binary recall: TP / (TP + FN), accumulated over batches."""
+
     def __init__(self, name=None):
         super().__init__(name)
         self.tp = 0
         self.fn = 0
 
     def update(self, preds, labels):
-        preds = np.rint(np.asarray(preds)).astype("int32")
-        labels = np.asarray(labels)
-        sample_num = labels.shape[0]
-        for i in range(sample_num):
-            pred = preds[i]
-            label = labels[i]
-            if label == 1:
-                if pred == label:
-                    self.tp += 1
-                else:
-                    self.fn += 1
+        p, l = _pred_label_pair(preds, labels, 'Recall')
+        truth = l == 1
+        self.tp += int(np.count_nonzero(truth & (p == 1)))
+        self.fn += int(np.count_nonzero(truth & (p != 1)))
 
     def eval(self):
-        recall = self.tp + self.fn
-        return float(self.tp) / recall if recall != 0 else .0
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
 
 
 class Accuracy(MetricBase):
+    """Weighted running mean of per-batch accuracy values (pair with the
+    in-graph `layers.accuracy` op output)."""
+
     def __init__(self, name=None):
         super().__init__(name)
-        self.value = .0
-        self.weight = .0
+        self.value = 0.0
+        self.weight = 0.0
 
     def update(self, value, weight):
-        if not _is_number_or_matrix_(value):
+        try:
+            v = _scalar(value)
+            w = float(weight) if isinstance(weight, (int, float)) \
+                else _scalar(weight)
+        except (TypeError, ValueError, IndexError):
             raise ValueError(
-                "The 'value' must be a number(int, float) or a numpy ndarray.")
-        if not isinstance(weight, (int, float)):
-            weight = float(np.asarray(weight).reshape(-1)[0])
-        self.value += float(np.asarray(value).reshape(-1)[0]) * weight
-        self.weight += weight
+                "Accuracy.update expects numeric value/weight, got %r / %r"
+                % (type(value).__name__, type(weight).__name__))
+        if w < 0:
+            raise ValueError("Accuracy weight must be non-negative")
+        self.value += v * w
+        self.weight += w
 
     def eval(self):
         if self.weight == 0:
-            raise ValueError("There is no data in Accuracy Metrics. "
-                             "Please check layers.accuracy output has added to Accuracy.")
+            raise ValueError(
+                "Accuracy has accumulated no data; feed it layers.accuracy "
+                "outputs via update() before eval().")
         return self.value / self.weight
 
 
-def _is_number_(var):
-    return isinstance(var, (int, float)) or (_is_numpy_(var) and var.shape == (1,))
-
-
-def _is_number_or_matrix_(var):
-    return _is_number_(var) or _is_numpy_(var)
-
-
 class ChunkEvaluator(MetricBase):
+    """Accumulates the three counters emitted by the chunk_eval op into
+    corpus-level precision/recall/F1."""
+
     def __init__(self, name=None):
         super().__init__(name)
         self.num_infer_chunks = 0
@@ -147,82 +174,82 @@ class ChunkEvaluator(MetricBase):
         self.num_correct_chunks = 0
 
     def update(self, num_infer_chunks, num_label_chunks, num_correct_chunks):
-        self.num_infer_chunks += int(np.asarray(num_infer_chunks).sum())
-        self.num_label_chunks += int(np.asarray(num_label_chunks).sum())
-        self.num_correct_chunks += int(np.asarray(num_correct_chunks).sum())
+        self.num_infer_chunks += int(_flat(num_infer_chunks).sum())
+        self.num_label_chunks += int(_flat(num_label_chunks).sum())
+        self.num_correct_chunks += int(_flat(num_correct_chunks).sum())
 
     def eval(self):
-        precision = float(self.num_correct_chunks) / self.num_infer_chunks \
-            if self.num_infer_chunks else 0
-        recall = float(self.num_correct_chunks) / self.num_label_chunks \
-            if self.num_label_chunks else 0
-        f1_score = float(2 * precision * recall) / (precision + recall) \
-            if self.num_correct_chunks else 0
-        return precision, recall, f1_score
+        c, i, l = (self.num_correct_chunks, self.num_infer_chunks,
+                   self.num_label_chunks)
+        precision = c / i if i else 0.0
+        recall = c / l if l else 0.0
+        f1 = 2 * precision * recall / (precision + recall) if c else 0.0
+        return precision, recall, f1
 
 
 class EditDistance(MetricBase):
-    def __init__(self, name):
+    """Average edit distance + instance error rate over sequences (pair
+    with the edit_distance op's (Out, SequenceNum) outputs)."""
+
+    def __init__(self, name=None):
         super().__init__(name)
-        self.total_distance = .0
+        self.total_distance = 0.0
         self.seq_num = 0
         self.instance_error = 0
 
     def update(self, distances, seq_num):
-        seq_num = int(np.asarray(seq_num).sum())
-        distances = np.asarray(distances)
-        self.seq_num += seq_num
-        self.instance_error += int(np.sum(distances > 0))
-        self.total_distance += float(np.sum(distances))
+        d = _flat(distances).astype(np.float64)
+        self.total_distance += float(d.sum())
+        self.instance_error += int(np.count_nonzero(d > 0))
+        self.seq_num += int(_flat(seq_num).sum())
 
     def eval(self):
         if self.seq_num == 0:
-            raise ValueError("There is no data in EditDistance Metric.")
-        avg_distance = self.total_distance / self.seq_num
-        avg_instance_error = self.instance_error / float(self.seq_num)
-        return avg_distance, avg_instance_error
+            raise ValueError(
+                "EditDistance has accumulated no sequences; call update() "
+                "with the edit_distance op outputs first.")
+        return (self.total_distance / self.seq_num,
+                self.instance_error / self.seq_num)
 
 
 class Auc(MetricBase):
-    def __init__(self, name, curve='ROC', num_thresholds=4095):
+    """Streaming ROC-AUC via fixed-width score histograms (one pos, one
+    neg), integrated with the trapezoid rule at eval() — bucketized the
+    same way the reference and its auc op are, but accumulated as numpy
+    vector ops."""
+
+    def __init__(self, name=None, curve='ROC', num_thresholds=4095):
         super().__init__(name)
+        if curve != 'ROC':
+            raise ValueError("only curve='ROC' is supported, got %r" % curve)
         self._curve = curve
-        self._num_thresholds = num_thresholds
-        _num_pred_buckets = num_thresholds + 1
-        self._stat_pos = [0] * _num_pred_buckets
-        self._stat_neg = [0] * _num_pred_buckets
+        self._num_thresholds = int(num_thresholds)
+        nbuckets = self._num_thresholds + 1
+        self.stat_pos = np.zeros(nbuckets, np.float64)
+        self.stat_neg = np.zeros(nbuckets, np.float64)
 
     def update(self, preds, labels):
         preds = np.asarray(preds)
-        labels = np.asarray(labels)
-        for i, lbl in enumerate(labels):
-            value = preds[i, 1]
-            bin_idx = int(value * self._num_thresholds)
-            assert bin_idx <= self._num_thresholds
-            if lbl:
-                self._stat_pos[bin_idx] += 1.0
-            else:
-                self._stat_neg[bin_idx] += 1.0
-
-    @staticmethod
-    def trapezoid_area(x1, x2, y1, y2):
-        return abs(x1 - x2) * (y1 + y2) / 2.0
+        if preds.ndim == 2 and preds.shape[1] >= 2:
+            scores = preds[:, 1]       # [N, 2] softmax: P(class 1)
+        else:
+            scores = _flat(preds)      # [N] or [N, 1] sigmoid scores
+        labels = _flat(labels).astype(bool)
+        idx = np.clip((scores * self._num_thresholds).astype(np.int64),
+                      0, self._num_thresholds)
+        nb = self._num_thresholds + 1
+        self.stat_pos += np.bincount(idx[labels], minlength=nb)[:nb]
+        self.stat_neg += np.bincount(idx[~labels], minlength=nb)[:nb]
 
     def eval(self):
-        tot_pos = 0.0
-        tot_neg = 0.0
-        auc = 0.0
-        idx = self._num_thresholds
-        while idx >= 0:
-            tot_pos_prev = tot_pos
-            tot_neg_prev = tot_neg
-            tot_pos += self._stat_pos[idx]
-            tot_neg += self._stat_neg[idx]
-            auc += self.trapezoid_area(tot_neg, tot_neg_prev, tot_pos,
-                                       tot_pos_prev)
-            idx -= 1
-        return auc / tot_pos / tot_neg if tot_pos > 0.0 and tot_neg > 0.0 \
-            else 0.0
-
-
-import copy  # noqa: E402  (used by MetricBase.get_config)
+        # cumulative counts walking the threshold down from 1.0 to 0.0
+        pos = np.cumsum(self.stat_pos[::-1])
+        neg = np.cumsum(self.stat_neg[::-1])
+        tot_pos, tot_neg = pos[-1], neg[-1]
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        # trapezoid integration of TPR over FPR, unnormalized then scaled
+        prev_pos = np.concatenate([[0.0], pos[:-1]])
+        prev_neg = np.concatenate([[0.0], neg[:-1]])
+        area = float(np.sum((neg - prev_neg) * (pos + prev_pos) / 2.0))
+        return area / (tot_pos * tot_neg)

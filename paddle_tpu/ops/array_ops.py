@@ -50,10 +50,10 @@ def _read_from_array(ctx, ins):
 
 @register('lod_array_length', no_grad=True, lod='aware')
 def _lod_array_length(ctx, ins):
+    from ..framework import runtime_dtype
     arr = ins['X'][0]
-    return {'Out': [jnp.asarray(arr.length, jnp.int64
-                                if jax.config.jax_enable_x64 else jnp.int32)
-                    .reshape(1)]}
+    return {'Out': [jnp.asarray(arr.length,
+                                runtime_dtype('int64')).reshape(1)]}
 
 
 @register('lod_rank_table', no_grad=True, lod='aware')
@@ -151,13 +151,19 @@ def _shrink_rnn_memory(ctx, ins):
 
 @register('tensor_array_to_tensor', no_grad=True, lod='aware')
 def _tensor_array_to_tensor(ctx, ins):
+    """Concat (default, matching the reference layer) or stack the array's
+    elements. XLA shapes are static, so the full capacity participates;
+    slots never written hold the zero fill. OutIndex is the reference's
+    per-element size vector along `axis` (equal here — fixed element shape)."""
     arr = ins['X'][0]
     axis = int(ctx.attr('axis', 0))
     data = arr.stack()  # [cap, *elem]
-    if ctx.attr('use_stack', True):
+    cap = data.shape[0]
+    if ctx.attr('use_stack', False):
         out = jnp.moveaxis(data, 0, axis) if axis else data
+        sizes = jnp.ones((cap,), jnp.int32)
     else:
-        out = jnp.concatenate([data[i] for i in range(data.shape[0])],
-                              axis=axis)
-    return {'Out': [out],
-            'OutIndex': [jnp.asarray(arr.length, jnp.int32).reshape(1)]}
+        elem_axis_size = data.shape[1:][axis]
+        out = jnp.concatenate([data[i] for i in range(cap)], axis=axis)
+        sizes = jnp.full((cap,), elem_axis_size, jnp.int32)
+    return {'Out': [out], 'OutIndex': [sizes]}

@@ -40,8 +40,15 @@ def _select_val(pred, new, old):
         lod = new.lod if isinstance(new, LoDArray) else old.lod
         return LoDArray(jnp.where(pred, nd, od), lod)
     if isinstance(new, TensorArrayVal):
-        return TensorArrayVal(jnp.where(pred, new.data, old.data),
-                              jnp.where(pred, new.length, old.length),
+        old_data = old.data if isinstance(old, TensorArrayVal) else None
+        old_len = old.length if isinstance(old, TensorArrayVal) \
+            else jnp.asarray(0, jnp.int32)
+        if old_data is None:
+            # first array_write happened inside the conditional branch: the
+            # not-taken side is the zero-filled buffer of the same shape
+            old_data = jnp.zeros_like(new.data)
+        return TensorArrayVal(jnp.where(pred, new.data, old_data),
+                              jnp.where(pred, new.length, old_len),
                               new.capacity)
     return jnp.where(pred, new, jnp.asarray(old, new.dtype)
                      if hasattr(new, 'dtype') else old)

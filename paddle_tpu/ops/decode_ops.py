@@ -28,6 +28,13 @@ import jax
 import jax.numpy as jnp
 
 from ..core.registry import register
+from ..framework import runtime_dtype
+
+
+def INT_T():
+    # declared int64; resolved per call so a jax x64 toggle
+    # after import is honored (32-bit carrier otherwise)
+    return runtime_dtype('int64')
 from ..core.lod import LoDArray, unwrap, lengths_to_offsets
 from .rnn_ops import _pad_from_lod
 
@@ -72,8 +79,12 @@ def _warpctc(ctx, ins):
         lb = jnp.asarray(inv)[lb]
     loss = optax.ctc_loss(lg, logit_pad, lb, label_pad)  # [B]
     if norm_by_times:
-        lens = (lg_off[1:] - lg_off[:-1]).astype(np.float32)
-        loss = loss / jnp.asarray(lens)
+        # reference normalizes only the GRADIENT by sequence length
+        # (WarpCTCGradKernel / UnpaddingLoDTensorFunctor) while reporting
+        # the unnormalized loss value; value-preserving stop_gradient trick
+        lens = jnp.asarray((lg_off[1:] - lg_off[:-1]).astype(np.float32))
+        scaled = loss / lens
+        loss = scaled + jax.lax.stop_gradient(loss - scaled)
     return {'Loss': [loss.reshape(-1, 1)], 'WarpCTCGrad': None}
 
 
@@ -85,7 +96,7 @@ def _ctc_greedy_decoder(ctx, ins):
     x = ins['Input'][0]
     blank = int(ctx.attr('blank', 0))
     off = _lod_offsets(x, 'ctc_greedy_decoder')
-    best = jnp.argmax(unwrap(x), axis=-1).astype(jnp.int64)  # [sum]
+    best = jnp.argmax(unwrap(x), axis=-1).astype(INT_T())  # [sum]
     outs = []
     for i in range(len(off) - 1):
         seg = best[int(off[i]):int(off[i + 1])]
@@ -110,8 +121,8 @@ def _edit_distance(ctx, ins):
     ignored = tuple(ctx.attr('ignored_tokens', ()) or ())
     h_off = _lod_offsets(hyps, 'edit_distance Hyps')
     r_off = _lod_offsets(refs, 'edit_distance Refs')
-    h = unwrap(hyps).reshape(-1).astype(jnp.int64)
-    r = unwrap(refs).reshape(-1).astype(jnp.int64)
+    h = unwrap(hyps).reshape(-1).astype(INT_T())
+    r = unwrap(refs).reshape(-1).astype(INT_T())
     n = len(h_off) - 1
 
     def compact(seq):
@@ -160,9 +171,7 @@ def _edit_distance(ctx, ins):
             d = d / rlen.astype(jnp.float32)
         dists.append(d)
     return {'Out': [jnp.stack(dists).reshape(-1, 1)],
-            'SequenceNum': [jnp.asarray(n, jnp.int64
-                            if jax.config.jax_enable_x64 else jnp.int32)
-                            .reshape(1)]}
+            'SequenceNum': [jnp.asarray(n, INT_T()).reshape(1)]}
 
 
 # ---------------------------------------------------------------------------
@@ -272,15 +281,15 @@ def _crf_decoding(ctx, ins):
                                   (bps[::-1], mt[1:][::-1]))
     # tail_rev holds tags at steps T-1..1; prepend the step-0 carry
     path = jnp.concatenate([tag0[None], tail_rev[::-1]], axis=0)  # [T,B]
-    path = jnp.moveaxis(path, 1, 0).astype(jnp.int64)             # [B,T]
+    path = jnp.moveaxis(path, 1, 0).astype(INT_T())             # [B,T]
 
     rows = []
     for i in range(B):
         rows.append(path[i, :int(lens[i])])
     flat = jnp.concatenate(rows).reshape(-1, 1)
     if label is not None:
-        lab = unwrap(label).reshape(-1, 1).astype(jnp.int64)
-        flat = (flat == lab).astype(jnp.int64)
+        lab = unwrap(label).reshape(-1, 1).astype(INT_T())
+        flat = (flat == lab).astype(INT_T())
     return {'ViterbiPath': [LoDArray(flat, em.lod)]}
 
 
@@ -295,7 +304,9 @@ def _chunk_bounds(tags, scheme, num_chunk_types, excluded):
     L = tags.shape[0]
     if scheme == 'plain':
         ctype = tags
-        valid = tags >= 0
+        # the 'Other' tag decodes to type == num_chunk_types and is never a
+        # chunk (ref chunk_eval_op.h:145 other_chunk_type)
+        valid = (tags >= 0) & (tags != num_chunk_types)
         for e in excluded:
             valid &= tags != e
         prev = jnp.concatenate([jnp.full((1,), -2, tags.dtype), tags[:-1]])
@@ -308,7 +319,9 @@ def _chunk_bounds(tags, scheme, num_chunk_types, excluded):
                                   "IOB)" % scheme)
     ttype = tags % 2          # 0 = B, 1 = I
     ctype = tags // 2
-    valid = tags >= 0
+    # O tags (value num_chunk_types * num_tag_types) decode to
+    # ctype == num_chunk_types: not part of any chunk (ref chunk_eval_op.h:145)
+    valid = (tags >= 0) & (ctype != num_chunk_types)
     for e in excluded:
         valid &= ctype != e
     prev_ct = jnp.concatenate([jnp.full((1,), -2, ctype.dtype), ctype[:-1]])
@@ -369,7 +382,7 @@ def _chunk_eval(ctx, ins):
     rec = jnp.where(n_lab > 0, n_cor_f / n_lab_f, 0.0).reshape(1)
     f1 = jnp.where(n_cor > 0, 2 * prec * rec / (prec + rec),
                    jnp.zeros(1)).reshape(1)
-    i64 = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    i64 = INT_T()
     return {'Precision': [prec], 'Recall': [rec], 'F1-Score': [f1],
             'NumInferChunks': [n_inf.astype(i64).reshape(1)],
             'NumLabelChunks': [n_lab.astype(i64).reshape(1)],
@@ -396,9 +409,9 @@ def _beam_search(ctx, ins):
     K = int(ctx.attr('beam_size'))
     end_id = int(ctx.attr('end_id'))
     if ids is None:
-        ids = jnp.broadcast_to(jnp.arange(scores.shape[1], dtype=jnp.int64),
+        ids = jnp.broadcast_to(jnp.arange(scores.shape[1], dtype=INT_T()),
                                scores.shape)
-    ids = ids.astype(jnp.int64)
+    ids = ids.astype(INT_T())
     BK, C = scores.shape
     B = BK // K
     neg_inf = jnp.asarray(-1e9, scores.dtype)
@@ -412,7 +425,7 @@ def _beam_search(ctx, ins):
                                 axis=1) if C > 1 else pre_scores[:, None],
                             scores)
     cand_ids = jnp.where(finished[:, None],
-                         jnp.full((BK, C), end_id, jnp.int64), ids)
+                         jnp.full((BK, C), end_id, INT_T()), ids)
 
     g_scores = cand_scores.reshape(B, K * C)
     g_ids = cand_ids.reshape(B, K * C)
@@ -460,7 +473,7 @@ def _beam_search_decode(ctx, ins):
 
     _, toks_rev = jax.lax.scan(
         back, rows,
-        (ids[::-1].astype(jnp.int64), parents[::-1], valid[::-1]))
+        (ids[::-1].astype(INT_T()), parents[::-1], valid[::-1]))
     sent = toks_rev[::-1]                                   # [T, BK]
     sent = jnp.moveaxis(sent, 1, 0)                         # [BK, T]
     # freeze everything after the first end_id to end_id

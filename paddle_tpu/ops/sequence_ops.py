@@ -15,6 +15,13 @@ import jax
 import jax.numpy as jnp
 
 from ..core.registry import register
+from ..framework import runtime_dtype
+
+
+def INT_T():
+    # declared int64; resolved per call so a jax x64 toggle
+    # after import is honored (32-bit carrier otherwise)
+    return runtime_dtype('int64')
 from ..core.lod import LoDArray, unwrap, segment_ids_from_offsets
 
 
@@ -257,7 +264,7 @@ def _sequence_pad(ctx, ins):
         (1, 1) + pad_value.shape if pad_value.ndim else (1, 1) + (1,) * len(feat)))
     ctx.tracer.static_lengths[ctx.op.outputs['Length'][0]] = tuple(
         int(v) for v in lens)
-    return {'Out': [out], 'Length': [jnp.asarray(lens, dtype=jnp.int64)]}
+    return {'Out': [out], 'Length': [jnp.asarray(lens, dtype=INT_T())]}
 
 
 @register('sequence_unpad', lod='aware')
@@ -290,7 +297,7 @@ def _sequence_mask(ctx, ins):
     from ..framework import convert_dtype
     dt = convert_dtype(ctx.attr('out_dtype', 'int64'))
     rng = jnp.arange(maxlen, dtype=x.dtype if jnp.issubdtype(
-        x.dtype, jnp.integer) else jnp.int64)
+        x.dtype, jnp.integer) else INT_T())
     out = (rng[None, :] < x.reshape(-1)[:, None]).astype(jnp.dtype(dt))
     return {'Y': [out.reshape(tuple(x.shape) + (maxlen,))]}
 

@@ -12,13 +12,22 @@ import jax
 import jax.numpy as jnp
 
 from ..core.registry import register
+from ..framework import runtime_dtype
+
+
+def INT_T():
+    # declared int64; resolved per call so a jax x64 toggle
+    # after import is honored (32-bit carrier otherwise)
+    return runtime_dtype('int64')
 from ..framework import convert_dtype
 from .math_ops import X
 
 
 def _np_dtype(attr_dtype, default='float32'):
-    return jnp.dtype(convert_dtype(attr_dtype) if attr_dtype is not None
-                     else default)
+    # runtime_dtype canonicalizes declared 64-bit dtypes to the 32-bit
+    # carrier (no jax x64) without a per-call truncation warning
+    return runtime_dtype(convert_dtype(attr_dtype)
+                         if attr_dtype is not None else default)
 
 
 # -- creation ---------------------------------------------------------------
@@ -136,7 +145,7 @@ def _randperm(ctx, ins):
 def _sampling_id(ctx, ins):
     x = X(ins)  # [batch, C] probabilities
     out = jax.random.categorical(ctx.rng(), jnp.log(jnp.clip(x, 1e-20)), axis=1)
-    return {'Out': [out.astype(jnp.int64)]}
+    return {'Out': [out.astype(INT_T())]}
 
 
 @register('random_crop', no_grad=True)
@@ -455,17 +464,17 @@ def _top_k(ctx, ins):
     x = X(ins)
     k = ctx.attr('k', 1)
     vals, idx = jax.lax.top_k(x, k)
-    return {'Out': [vals], 'Indices': [idx.astype(jnp.int64)]}
+    return {'Out': [vals], 'Indices': [idx.astype(INT_T())]}
 
 
 @register('arg_max', no_grad=True)
 def _arg_max(ctx, ins):
-    return {'Out': [jnp.argmax(X(ins), axis=ctx.attr('axis', -1)).astype(jnp.int64)]}
+    return {'Out': [jnp.argmax(X(ins), axis=ctx.attr('axis', -1)).astype(INT_T())]}
 
 
 @register('arg_min', no_grad=True)
 def _arg_min(ctx, ins):
-    return {'Out': [jnp.argmin(X(ins), axis=ctx.attr('axis', -1)).astype(jnp.int64)]}
+    return {'Out': [jnp.argmin(X(ins), axis=ctx.attr('axis', -1)).astype(INT_T())]}
 
 
 @register('argsort')
@@ -473,7 +482,7 @@ def _argsort(ctx, ins):
     x = X(ins)
     axis = ctx.attr('axis', -1)
     idx = jnp.argsort(x, axis=axis)
-    return {'Out': [jnp.sort(x, axis=axis)], 'Indices': [idx.astype(jnp.int64)]}
+    return {'Out': [jnp.sort(x, axis=axis)], 'Indices': [idx.astype(INT_T())]}
 
 
 @register('multiplex')
@@ -487,7 +496,7 @@ def _multiplex(ctx, ins):
 @register('where', no_grad=True)
 def _where(ctx, ins):
     cond = ins['Condition'][0]
-    return {'Out': [jnp.stack(jnp.nonzero(cond), axis=-1).astype(jnp.int64)]}
+    return {'Out': [jnp.stack(jnp.nonzero(cond), axis=-1).astype(INT_T())]}
 
 
 @register('maxout')
@@ -559,7 +568,7 @@ def _hash_op(ctx, ins):
         acc = h[:, 0]
         for c in range(1, h.shape[1]):
             acc = acc * jnp.uint32(31) + h[:, c]
-        outs.append((acc % jnp.uint32(mod_by)).astype(jnp.int64))
+        outs.append((acc % jnp.uint32(mod_by)).astype(INT_T()))
     return {'Out': [jnp.stack(outs, axis=1)[:, :, None]]}
 
 

@@ -18,36 +18,33 @@ __all__ = ['map_readers', 'buffered', 'compose', 'chain', 'shuffle',
 
 
 def map_readers(func, *readers):
-    def reader():
-        rs = [r() for r in readers]
-        for vals in zip(*rs):
-            yield func(*vals)
-    return reader
+    """Zip several readers and map `func` over the tuples of samples."""
+    def mapped():
+        yield from itertools.starmap(func, zip(*(r() for r in readers)))
+    return mapped
 
 
 def shuffle(reader, buf_size):
-    def data_reader():
-        buf = []
-        for e in reader():
-            buf.append(e)
-            if len(buf) >= buf_size:
-                random.shuffle(buf)
-                for b in buf:
-                    yield b
-                buf = []
-        if len(buf) > 0:
-            random.shuffle(buf)
-            for b in buf:
-                yield b
-    return data_reader
+    """Block shuffle: fill a window of `buf_size` samples, emit it in random
+    order, repeat. Same locality/memory trade-off as the reference's
+    decorator; implemented via islice windows."""
+    def shuffled():
+        it = iter(reader())
+        while True:
+            window = list(itertools.islice(it, buf_size))
+            if not window:
+                return
+            random.shuffle(window)
+            yield from window
+    return shuffled
 
 
 def chain(*readers):
-    def reader():
-        rs = [r() for r in readers]
-        for e in itertools.chain(*rs):
-            yield e
-    return reader
+    """Concatenate readers end to end."""
+    def chained():
+        for r in readers:
+            yield from r()
+    return chained
 
 
 class ComposeNotAligned(ValueError):
@@ -55,26 +52,38 @@ class ComposeNotAligned(ValueError):
 
 
 def compose(*readers, **kwargs):
+    """Zip readers into flattened tuples: (a, (b0, b1)) -> (a, b0, b1).
+
+    With check_alignment (default), raises ComposeNotAligned if the readers
+    run out at different lengths instead of silently truncating.
+    """
     check_alignment = kwargs.pop('check_alignment', True)
+    sentinel = object()
 
-    def make_tuple(x):
-        if isinstance(x, tuple):
-            return x
-        return (x,)
+    def flatten(row):
+        out = []
+        for item in row:
+            if isinstance(item, tuple):
+                out.extend(item)
+            else:
+                out.append(item)
+        return tuple(out)
 
-    def reader():
-        rs = [r() for r in readers]
-        if not check_alignment:
-            for outputs in zip(*rs):
-                yield sum(list(map(make_tuple, outputs)), ())
-        else:
-            for outputs in itertools.zip_longest(*rs):
-                for o in outputs:
-                    if o is None:
-                        raise ComposeNotAligned(
-                            "outputs of readers are not aligned.")
-                yield sum(list(map(make_tuple, outputs)), ())
-    return reader
+    def composed():
+        its = [iter(r()) for r in readers]
+        while True:
+            row = [next(it, sentinel) for it in its]
+            done = sum(1 for x in row if x is sentinel)
+            if done == len(row):
+                return
+            if done:
+                if check_alignment:
+                    raise ComposeNotAligned(
+                        "composed readers ended at different lengths "
+                        "(%d of %d exhausted)" % (done, len(row)))
+                return
+            yield flatten(row)
+    return composed
 
 
 def buffered(reader, size):

@@ -48,14 +48,26 @@ class DistributeTranspiler(object):
 
     def get_pserver_program(self, endpoint):
         assert self._transpiled, "call transpile() first"
-        return Program()  # no separate pserver process on TPU
+        # On TPU there is no parameter-server process: dense PS semantics
+        # collapse into the single SPMD program (gradient all-reduce over
+        # the mesh). A reference pserver-role script must not silently
+        # no-op, so fail loudly with migration guidance.
+        raise NotImplementedError(
+            "get_pserver_program(%r): paddle_tpu has no parameter-server "
+            "role. The transpiled program is a single SPMD program; run "
+            "get_trainer_program() on every host (the TPU runtime + XLA "
+            "collectives replace pserver RPC). For sharded embeddings use "
+            "layers.embedding with a sharded ParamAttr instead of a dist "
+            "lookup table." % (endpoint,))
 
     def get_pserver_programs(self, endpoint):
         return self.get_pserver_program(endpoint), Program()
 
     def get_startup_program(self, endpoint, pserver_program=None,
                             startup_program=None):
-        return Program()
+        raise NotImplementedError(
+            "get_startup_program: no pserver role on TPU — run the regular "
+            "startup program on every host (see get_pserver_program).")
 
 
 def memory_optimize(input_program, skip_opt_set=None, print_log=False,

@@ -203,6 +203,46 @@ def test_chunk_eval_iob():
     assert outs[1][0] == pytest.approx(2 / 3)
 
 
+def test_chunk_eval_iob_other_tag():
+    """O tags (value num_chunk_types * num_tag_types) are not chunks
+    (ref chunk_eval_op.h:145 other_chunk_type) — the canonical NER case."""
+    layers = fluid.layers
+    inf = layers.data(name='io', shape=[1], dtype='int64', lod_level=1)
+    lab = layers.data(name='lo', shape=[1], dtype='int64', lod_level=1)
+    prec, rec, f1, n_inf, n_lab, n_cor = layers.chunk_eval(
+        input=inf, label=lab, chunk_scheme='IOB', num_chunk_types=2)
+    # tags: B-0=0 I-0=1 B-1=2 I-1=3 O=4; gold: [B0 I0 O O B1]
+    gold = np.array([0, 1, 4, 4, 2], np.int64).reshape(-1, 1)
+    # prediction: first chunk right; predicts O where gold has B1
+    pred = np.array([0, 1, 4, 4, 4], np.int64).reshape(-1, 1)
+    outs = _run([prec, rec, f1, n_inf, n_lab, n_cor],
+                feed={'io': _lod(pred, [5]), 'lo': _lod(gold, [5])},
+                startup=False)
+    # O runs must not inflate the chunk counters
+    assert outs[3][0] == 1   # inferred chunks: just [B0 I0]
+    assert outs[4][0] == 2   # label chunks: [B0 I0], [B1]
+    assert outs[5][0] == 1
+    assert outs[0][0] == pytest.approx(1.0)
+    assert outs[1][0] == pytest.approx(0.5)
+
+
+def test_chunk_eval_plain_other_tag():
+    layers = fluid.layers
+    inf = layers.data(name='ip', shape=[1], dtype='int64', lod_level=1)
+    lab = layers.data(name='lp', shape=[1], dtype='int64', lod_level=1)
+    prec, rec, f1, n_inf, n_lab, n_cor = layers.chunk_eval(
+        input=inf, label=lab, chunk_scheme='plain', num_chunk_types=2)
+    # plain scheme: tag == chunk type, tag 2 (num_chunk_types) is Other
+    gold = np.array([0, 0, 2, 1], np.int64).reshape(-1, 1)
+    pred = np.array([0, 0, 2, 2], np.int64).reshape(-1, 1)
+    outs = _run([prec, rec, f1, n_inf, n_lab, n_cor],
+                feed={'ip': _lod(pred, [4]), 'lp': _lod(gold, [4])},
+                startup=False)
+    assert outs[3][0] == 1   # [0,0] only — the 2-run is Other
+    assert outs[4][0] == 2   # [0,0] and [1]
+    assert outs[5][0] == 1
+
+
 # ---------------------------------------------------------------------------
 # beam search
 # ---------------------------------------------------------------------------

@@ -1,0 +1,71 @@
+"""Host-side metric accumulators (paddle_tpu/metrics.py) vs direct numpy."""
+import numpy as np
+import pytest
+
+from paddle_tpu import metrics
+
+
+def test_precision_recall():
+    p, r = metrics.Precision(), metrics.Recall()
+    preds = np.array([0.9, 0.2, 0.8, 0.1, 0.7])
+    labels = np.array([1, 0, 0, 1, 1])
+    p.update(preds, labels)
+    r.update(preds, labels)
+    # predictions rint -> [1,0,1,0,1]; tp=2 fp=1 fn=1
+    assert p.eval() == pytest.approx(2 / 3)
+    assert r.eval() == pytest.approx(2 / 3)
+    # accumulation across batches
+    p.update(np.array([1.0]), np.array([1]))
+    assert p.eval() == pytest.approx(3 / 4)
+    p.reset()
+    assert p.eval() == 0.0
+
+
+def test_accuracy_weighted():
+    a = metrics.Accuracy()
+    a.update(0.5, 10)
+    a.update(1.0, 30)
+    assert a.eval() == pytest.approx((0.5 * 10 + 1.0 * 30) / 40)
+    with pytest.raises(ValueError):
+        metrics.Accuracy().eval()
+
+
+def test_auc_matches_exact():
+    rng = np.random.RandomState(3)
+    scores = rng.rand(500)
+    labels = (rng.rand(500) < scores).astype(int)  # informative scores
+    m = metrics.Auc()
+    m.update(np.stack([1 - scores, scores], 1), labels)
+    got = m.eval()
+    # exact AUC via rank statistic
+    order = np.argsort(scores)
+    ranks = np.empty(500)
+    ranks[order] = np.arange(1, 501)
+    npos = labels.sum()
+    nneg = 500 - npos
+    exact = (ranks[labels == 1].sum() - npos * (npos + 1) / 2) / (npos * nneg)
+    assert got == pytest.approx(exact, abs=2e-3)  # bucketization error only
+
+
+def test_chunk_evaluator_and_edit_distance():
+    c = metrics.ChunkEvaluator()
+    c.update(4, 5, 3)
+    c.update(1, 0, 0)
+    prec, rec, f1 = c.eval()
+    assert prec == pytest.approx(3 / 5)
+    assert rec == pytest.approx(3 / 5)
+    e = metrics.EditDistance()
+    e.update(np.array([2.0, 0.0, 1.0]), 3)
+    avg, err = e.eval()
+    assert avg == pytest.approx(1.0)
+    assert err == pytest.approx(2 / 3)
+
+
+def test_composite():
+    cm = metrics.CompositeMetric()
+    cm.add_metric(metrics.Precision())
+    cm.add_metric(metrics.Recall())
+    cm.update(np.array([1.0, 0.0]), np.array([1, 1]))
+    assert cm.eval() == [1.0, 0.5]
+    with pytest.raises(TypeError):
+        cm.add_metric(object())
