@@ -179,8 +179,10 @@ def bench_ctr():
         full = (batch,) + tuple(shape)
         if dtype.startswith('int'):
             arr = rng.randint(0, vocab, full).astype(np.int32)
+        elif vocab == 2:  # binary click label
+            arr = (rng.rand(*full) < 0.5).astype(np.float32)
         else:
-            arr = rng.rand(*full).astype(np.float32)
+            arr = rng.randn(*full).astype(np.float32)
         feed[name] = jax.device_put(jnp.asarray(arr), dev)
 
     dt = _timed_steps(exe, main_p, feed, loss, steps, warmup=3)

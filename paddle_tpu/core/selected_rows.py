@@ -1,0 +1,93 @@
+"""SelectedRows: sparse row-set gradients (ref: framework/selected_rows.h:32).
+
+The reference materializes embedding gradients as {rows, value} pairs so
+pservers/optimizers touch only the looked-up rows. TPU-native re-design:
+`SelectedRowsVal` is a pytree of (rows [N] int32, values [N, ...]) plus a
+static `height` (the full table's row count). N is the STATIC number of
+lookups in the batch (ids tensor size), so every consumer is a fixed-shape
+XLA program:
+
+  - optimizer sparse paths apply `values` at `rows` with scatter-add /
+    scatter-apply (duplicate ids accumulate, exactly like the reference's
+    merged SelectedRows);
+  - `merge_selected_rows` sorts + segment-sums duplicates, parking merged
+    slots at row == height (out-of-range rows drop in scatters);
+  - densifying (`get_tensor_from_selected_rows` into a full table) is an
+    explicit .to_dense(), never implicit.
+
+Under GSPMD a sharded table + scatter from replicated SelectedRows lowers to
+the same all-to-all/scatter collectives as the reference's distributed
+lookup table update path (operators/distributed/parameter_prefetch.cc).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class SelectedRowsVal(object):
+    """rows: [N] int32 row ids (may repeat; id == height means 'empty slot').
+    values: [N, *tail] per-row data. height: static table row count."""
+
+    __slots__ = ('rows', 'values', 'height')
+
+    def __init__(self, rows, values, height):
+        self.rows = rows
+        self.values = values
+        self.height = int(height)
+
+    def tree_flatten(self):
+        return (self.rows, self.values), self.height
+
+    @classmethod
+    def tree_unflatten(cls, height, children):
+        obj = cls.__new__(cls)
+        obj.rows, obj.values = children
+        obj.height = height
+        return obj
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def to_dense(self):
+        """Full [height, *tail] tensor with duplicate rows accumulated."""
+        dense = jnp.zeros((self.height,) + self.values.shape[1:],
+                          self.values.dtype)
+        return dense.at[self.rows].add(self.values, mode='drop')
+
+    def merged(self):
+        """Deduplicate rows: sort by row id, segment-sum runs of equal ids
+        into the first slot, park the rest at row == height. Shapes stay
+        static; scatters drop the parked slots."""
+        order = jnp.argsort(self.rows)
+        rows = self.rows[order]
+        vals = self.values[order]
+        first = jnp.concatenate(
+            [jnp.ones((1,), bool), rows[1:] != rows[:-1]])
+        seg = jnp.cumsum(first.astype(jnp.int32)) - 1  # [N] run index
+        n = rows.shape[0]
+        sum_vals = jax.ops.segment_sum(vals, seg, num_segments=n)
+        # row id of each run = first row of the run
+        run_rows = jnp.full((n,), self.height, rows.dtype).at[seg].set(rows)
+        return SelectedRowsVal(run_rows, sum_vals, self.height)
+
+    def scale(self, s):
+        return SelectedRowsVal(self.rows, self.values * s, self.height)
+
+    def __repr__(self):
+        return "SelectedRowsVal(n=%s, height=%d, tail=%s)" % (
+            self.rows.shape[0], self.height, self.values.shape[1:])
+
+
+def concat_rows(srs):
+    """Accumulate several SelectedRows over the same table (the `sum` op on
+    sparse grads): concatenation IS addition for scatter consumers."""
+    height = srs[0].height
+    for s in srs:
+        if s.height != height:
+            raise ValueError("SelectedRows height mismatch: %d vs %d"
+                             % (s.height, height))
+    return SelectedRowsVal(jnp.concatenate([s.rows for s in srs]),
+                           jnp.concatenate([s.values for s in srs]), height)

@@ -53,6 +53,12 @@ def is_float_dtype(dtype):
     return convert_dtype(dtype) in ('float16', 'bfloat16', 'float32', 'float64')
 
 
+def int_t():
+    """Runtime carrier dtype for declared-int64 outputs (int32 without
+    jax x64; resolved per call so an x64 toggle after import is honored)."""
+    return runtime_dtype('int64')
+
+
 def runtime_dtype(dtype):
     """The dtype a declared var dtype actually carries on device: jax
     without x64 stores int64/float64 as 32-bit. Canonicalizing HERE keeps
@@ -156,10 +162,14 @@ class Operator(object):
         self.outputs = {k: self._norm_slot(v) for k, v in (outputs or {}).items()}
         self.attrs = dict(attrs or {})
         # stable per-op uid: seeds op-local RNG streams (dropout etc.) so the
-        # vjp-derived grad lowering reproduces the forward's randomness
+        # vjp-derived grad lowering reproduces the forward's randomness.
+        # Counted PER PROGRAM: identical model code builds identical uid
+        # streams regardless of what was built before in the process, so
+        # same-seed programs are reproducible by construction.
         if '_op_uid' not in self.attrs:
-            Operator._uid_counter[0] += 1
-            self.attrs['_op_uid'] = Operator._uid_counter[0]
+            program = block.program
+            program._op_uid_counter += 1
+            self.attrs['_op_uid'] = program._op_uid_counter
 
     def input(self, slot):
         return self.inputs.get(slot, [])
@@ -298,6 +308,7 @@ class Program(object):
         Program._uid_counter[0] += 1
         self._uid = Program._uid_counter[0]
         self._build_epoch = 0
+        self._op_uid_counter = 0
 
     # -- block management -------------------------------------------------
     def global_block(self):
@@ -359,6 +370,7 @@ class Program(object):
         Program._uid_counter[0] += 1
         p._uid = Program._uid_counter[0]
         p._build_epoch = self._build_epoch
+        p._op_uid_counter = self._op_uid_counter
         for b in self.blocks:
             nb = Block(p, b.idx, b.parent_idx)
             p.blocks.append(nb)

@@ -121,6 +121,11 @@ def program_from_dict(d):
         for od in bd['ops']:
             b.ops.append(Operator(b, od['type'], od['inputs'], od['outputs'],
                                   od['attrs']))
+    # resume the per-program uid counter past the loaded ops' serialized
+    # uids, so ops appended later (fine-tuning) get fresh RNG streams
+    p._op_uid_counter = max(
+        (op.attrs.get('_op_uid', 0) for b in p.blocks for op in b.ops),
+        default=0)
     return p
 
 

@@ -1328,3 +1328,98 @@ def beam_search_decode(ids, scores, beam_size, end_id, name=None,
     sent_ids.stop_gradient = True
     sent_scores.stop_gradient = True
     return sent_ids, sent_scores
+
+
+# ---------------------------------------------------------------------------
+# large-vocabulary losses + SelectedRows surface
+# (ref: nn.py nce/hsigmoid, operators/nce_op.cc,
+#  operators/hierarchical_sigmoid_op.cc, get_tensor_from_selected_rows_op.cc,
+#  merge_selected_rows_op.cc)
+# ---------------------------------------------------------------------------
+_NCE_SAMPLERS = {'uniform': 0, 'log_uniform': 1, 'custom_dist': 2}
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None, sampler='uniform',
+        custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (ref nce_op.cc). Scores the true
+    class(es) plus `num_neg_samples` sampled noise classes per example;
+    with is_sparse the weight gradient is SelectedRows over sampled rows."""
+    helper = LayerHelper('nce', param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[num_total_classes, dim],
+                                dtype=input.dtype)
+    inputs = {'Input': input, 'Label': label, 'Weight': w}
+    battr = helper.bias_attr
+    if battr:
+        b = helper.create_parameter(attr=battr,
+                                    shape=[num_total_classes, 1],
+                                    dtype=input.dtype, is_bias=True)
+        inputs['Bias'] = b
+    if sample_weight is not None:
+        inputs['SampleWeight'] = sample_weight
+    S = int(num_neg_samples) if num_neg_samples else 10
+    cost = helper.create_variable_for_type_inference(input.dtype)
+    sample_logits = helper.create_variable_for_type_inference(input.dtype)
+    sample_labels = helper.create_variable_for_type_inference('int64')
+    helper.append_op(
+        type='nce', inputs=inputs,
+        outputs={'Cost': cost, 'SampleLogits': sample_logits,
+                 'SampleLabels': sample_labels},
+        attrs={'num_total_classes': int(num_total_classes),
+               'num_neg_samples': S, 'seed': seed,
+               'sampler': _NCE_SAMPLERS[sampler], 'is_sparse': is_sparse})
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    """Hierarchical sigmoid over a complete binary class tree
+    (ref hierarchical_sigmoid_op.cc). Cost is O(log2 C) dots per example."""
+    if is_custom or path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "hsigmoid custom trees (path_table/path_code) are not "
+            "supported; the default complete binary tree covers the "
+            "reference's non-custom path")
+    helper = LayerHelper('hierarchical_sigmoid', param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[num_classes - 1, dim],
+                                dtype=input.dtype)
+    inputs = {'X': input, 'Label': label, 'W': w}
+    battr = helper.bias_attr
+    if battr:
+        b = helper.create_parameter(attr=battr, shape=[1, num_classes - 1],
+                                    dtype=input.dtype, is_bias=True)
+        inputs['Bias'] = b
+    out = helper.create_variable_for_type_inference(input.dtype)
+    pre_out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type='hierarchical_sigmoid', inputs=inputs,
+        outputs={'Out': out, 'PreOut': pre_out},
+        attrs={'num_classes': int(num_classes), 'is_sparse': is_sparse})
+    return out
+
+
+def merge_selected_rows(x, name=None):
+    """Deduplicate a SelectedRows' rows, summing values
+    (ref merge_selected_rows_op.cc)."""
+    helper = LayerHelper('merge_selected_rows', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='merge_selected_rows', inputs={'X': x},
+                     outputs={'Out': out})
+    return out
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    """The dense values tensor of a SelectedRows
+    (ref get_tensor_from_selected_rows_op.cc)."""
+    helper = LayerHelper('get_tensor_from_selected_rows', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='get_tensor_from_selected_rows', inputs={'X': x},
+                     outputs={'Out': out})
+    return out

@@ -53,7 +53,7 @@ class MetricBase(object):
                 setattr(self, k, 0.0)
             elif isinstance(v, int):
                 setattr(self, k, 0)
-            elif isinstance(v, np.ndarray):
+            elif isinstance(v, (np.ndarray, np.generic)):
                 setattr(self, k, np.zeros_like(v))
             elif isinstance(v, list):
                 setattr(self, k, [0] * len(v))

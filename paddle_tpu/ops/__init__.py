@@ -14,3 +14,4 @@ from . import array_ops       # noqa: F401
 from . import decode_ops      # noqa: F401
 from . import sequence_ops    # noqa: F401
 from . import rnn_ops         # noqa: F401
+from . import sparse_ops      # noqa: F401

@@ -305,7 +305,17 @@ def _scale(ctx, ins):
 
 @register('sum')
 def _sum(ctx, ins):
+    from ..core.selected_rows import SelectedRowsVal, concat_rows
     xs = [x for x in ins['X'] if x is not None]
+    sparse = [x for x in xs if isinstance(x, SelectedRowsVal)]
+    if sparse:
+        # sparse grad accumulation (ref selected_rows_functor Add): all
+        # sparse -> concatenated rows (addition for scatter consumers);
+        # mixed -> densify the sparse parts
+        if len(sparse) == len(xs):
+            return {'Out': [concat_rows(xs)]}
+        xs = [x.to_dense() if isinstance(x, SelectedRowsVal) else x
+              for x in xs]
     out = xs[0]
     for x in xs[1:]:
         out = out + x

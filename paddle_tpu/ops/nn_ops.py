@@ -328,6 +328,66 @@ def _embedding(ctx, ins):
     return _lookup_table(ctx, ins)
 
 
+@register('lookup_table_grad', no_grad=True, lod='aware')
+def _lookup_table_grad(ctx, ins):
+    """Explicit grad: with is_sparse the table gradient is a SelectedRows
+    (rows = the batch's ids, values = output cotangent rows) instead of a
+    dense [V, D] scatter — the SelectedRows path of the reference
+    (lookup_table_op.cc W@GRAD as SelectedRows, selected_rows_functor.h).
+    Dense fallback matches the generic vjp."""
+    from ..core.selected_rows import SelectedRowsVal
+    from ..core.lod import unwrap as _unw
+    a = ctx.attrs
+    w_name = a['_fwd_inputs']['W'][0]
+    ids_name = a['_fwd_inputs']['Ids'][0]
+    out_name = a['_fwd_outputs']['Out'][0]
+    gname = a['_in_grad_map'].get(w_name, '')
+    if not gname:
+        return
+    g_out_name = a['_out_grad_map'].get(out_name, '')
+    w = _unw(ctx.env(w_name))
+    ids = _unw(ctx.env(ids_name))
+    flat = ids.reshape(-1).astype(jnp.int32)
+    if not g_out_name or g_out_name not in ctx.tracer.env:
+        gv = jnp.zeros((flat.shape[0], w.shape[1]), w.dtype)
+    else:
+        gv = _unw(ctx.env(g_out_name)).reshape(flat.shape[0], w.shape[1])
+    pad = ctx.attr('padding_idx', -1)
+    if pad is not None and pad != -1:
+        if pad < 0:
+            pad += w.shape[0]
+        gv = jnp.where((flat == pad)[:, None], 0.0, gv)
+    if ctx.attr('is_sparse', False):
+        return {'IN@GRAD': [SelectedRowsVal(flat, gv, w.shape[0])]}
+    dense = jnp.zeros_like(w).at[flat].add(gv, mode='drop')
+    return {'IN@GRAD': [dense]}
+
+
+@register('embedding_grad', no_grad=True, lod='aware')
+def _embedding_grad(ctx, ins):
+    return _lookup_table_grad(ctx, ins)
+
+
+@register('merge_selected_rows', no_grad=True, lod='none')
+def _merge_selected_rows(ctx, ins):
+    from ..core.selected_rows import SelectedRowsVal
+    x = X(ins)
+    if not isinstance(x, SelectedRowsVal):
+        raise TypeError("merge_selected_rows expects SelectedRows input "
+                        "(a sparse embedding gradient), got %r" % (x,))
+    return {'Out': [x.merged()]}
+
+
+@register('get_tensor_from_selected_rows', no_grad=True, lod='none')
+def _get_tensor_from_selected_rows(ctx, ins):
+    from ..core.selected_rows import SelectedRowsVal
+    x = X(ins)
+    if not isinstance(x, SelectedRowsVal):
+        raise TypeError("get_tensor_from_selected_rows expects SelectedRows "
+                        "input, got %r" % (x,))
+    return {'Out': [x.values]}
+
+
 # ---------------------------------------------------------------------------
 # image resize (ref: operators/interpolate_op.cc)
 # ---------------------------------------------------------------------------

@@ -11,6 +11,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.registry import register
+from ..core.selected_rows import SelectedRowsVal
 
 
 def _lr(ins):
@@ -18,10 +19,19 @@ def _lr(ins):
     return lr.reshape(()) if hasattr(lr, 'reshape') else lr
 
 
+def _is_sparse(g):
+    return isinstance(g, SelectedRowsVal)
+
+
 @register('sgd', no_grad=True, lod='none')
 def _sgd(ctx, ins):
     p, g = ins['Param'][0], ins['Grad'][0]
-    return {'ParamOut': [p - _lr(ins) * g]}
+    lr = _lr(ins)
+    if _is_sparse(g):
+        # sparse update touches only looked-up rows (ref sgd_op.h
+        # SelectedRows path); duplicate ids accumulate via scatter-add
+        return {'ParamOut': [p.at[g.rows].add(-lr * g.values, mode='drop')]}
+    return {'ParamOut': [p - lr * g]}
 
 
 @register('momentum', no_grad=True, lod='none')
@@ -29,6 +39,21 @@ def _momentum(ctx, ins):
     p, g, v = ins['Param'][0], ins['Grad'][0], ins['Velocity'][0]
     mu = ctx.attr('mu')
     lr = _lr(ins)
+    if _is_sparse(g):
+        # rowwise sparse momentum (ref momentum_op.h SparseMomentumFunctor):
+        # only touched rows update velocity/param; merge duplicates first so
+        # the read-modify-write per row sees the full row gradient
+        m = g.merged()
+        gv = m.values
+        rows = m.rows
+        v_rows = v.at[rows].get(mode='fill', fill_value=0.0)
+        v_new = mu * v_rows + gv
+        if ctx.attr('use_nesterov', False):
+            p_delta = (gv + mu * v_new) * lr
+        else:
+            p_delta = lr * v_new
+        return {'ParamOut': [p.at[rows].add(-p_delta, mode='drop')],
+                'VelocityOut': [v.at[rows].set(v_new, mode='drop')]}
     v_out = mu * v + g
     if ctx.attr('use_nesterov', False):
         p_out = p - (g + mu * v_out) * lr
@@ -60,9 +85,28 @@ def _adam(ctx, ins):
     b2 = ctx.attr('beta2', 0.999)
     eps = ctx.attr('epsilon', 1e-8)
     lr = _lr(ins)
+    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
+    if _is_sparse(g) and not ctx.attr('lazy_mode', False):
+        # reference default (lazy_mode=False): a sparse grad still updates
+        # every row's moments/param (missing rows see grad 0) — densify and
+        # fall through (ref adam_op.h SparseAdamFunctor non-lazy branch)
+        g = g.merged().to_dense()
+    if _is_sparse(g):
+        # lazy sparse adam (ref adam_op.h SparseAdamFunctor, lazy_mode):
+        # moments/param update only on looked-up rows
+        mg = g.merged()
+        rows, gv = mg.rows, mg.values
+        m_rows = m.at[rows].get(mode='fill', fill_value=0.0)
+        v_rows = v.at[rows].get(mode='fill', fill_value=0.0)
+        m_new = b1 * m_rows + (1 - b1) * gv
+        v_new = b2 * v_rows + (1 - b2) * jnp.square(gv)
+        delta = lr_t * m_new / (jnp.sqrt(v_new) + eps)
+        return {'ParamOut': [p.at[rows].add(-delta, mode='drop')],
+                'Moment1Out': [m.at[rows].set(m_new, mode='drop')],
+                'Moment2Out': [v.at[rows].set(v_new, mode='drop')],
+                'Beta1PowOut': [b1p * b1], 'Beta2PowOut': [b2p * b2]}
     m_out = b1 * m + (1 - b1) * g
     v_out = b2 * v + (1 - b2) * jnp.square(g)
-    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
     p_out = p - lr_t * m_out / (jnp.sqrt(v_out) + eps)
     return {'ParamOut': [p_out], 'Moment1Out': [m_out], 'Moment2Out': [v_out],
             'Beta1PowOut': [b1p * b1], 'Beta2PowOut': [b2p * b2]}
@@ -87,8 +131,18 @@ def _adamax(ctx, ins):
 def _adagrad(ctx, ins):
     p, g, m = ins['Param'][0], ins['Grad'][0], ins['Moment'][0]
     eps = ctx.attr('epsilon', 1e-6)
+    lr = _lr(ins)
+    if _is_sparse(g):
+        # sparse adagrad (ref adagrad_op.h SparseAdagradFunctor)
+        mg = g.merged()
+        rows, gv = mg.rows, mg.values
+        m_rows = m.at[rows].get(mode='fill', fill_value=0.0)
+        m_new = m_rows + jnp.square(gv)
+        delta = lr * gv / (jnp.sqrt(m_new) + eps)
+        return {'ParamOut': [p.at[rows].add(-delta, mode='drop')],
+                'MomentOut': [m.at[rows].set(m_new, mode='drop')]}
     m_out = m + jnp.square(g)
-    p_out = p - _lr(ins) * g / (jnp.sqrt(m_out) + eps)
+    p_out = p - lr * g / (jnp.sqrt(m_out) + eps)
     return {'ParamOut': [p_out], 'MomentOut': [m_out]}
 
 

@@ -15,13 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.registry import register
-from ..framework import runtime_dtype
-
-
-def INT_T():
-    # declared int64; resolved per call so a jax x64 toggle
-    # after import is honored (32-bit carrier otherwise)
-    return runtime_dtype('int64')
+from ..framework import int_t as INT_T
 from ..core.lod import LoDArray, unwrap, segment_ids_from_offsets
 
 

@@ -1,0 +1,216 @@
+"""Sampled / hierarchical softmax ops: nce, hsigmoid
+(ref: operators/nce_op.cc/.h, operators/hierarchical_sigmoid_op.cc/.h,
+operators/math/matrix_bit_code.h, operators/math/sampler.cc).
+
+These are the reference's large-vocabulary losses: instead of a full [B, C]
+softmax, NCE scores num_true + S sampled classes per example and hsigmoid
+scores the ~log2(C) nodes on the label's path through a complete binary
+tree. Both keep the MXU busy with small dense gathers + batched dots —
+exactly the shapes XLA handles well — and NCE's weight gradient is a
+SelectedRows over the sampled rows when is_sparse is set.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+from ..core.selected_rows import SelectedRowsVal
+from ..core.lod import unwrap
+
+
+# ---------------------------------------------------------------------------
+# samplers (ref operators/math/sampler.cc): probability of drawing class c
+# ---------------------------------------------------------------------------
+def _sample_ids(rng, sampler, shape, num_classes):
+    if sampler == 2:
+        raise NotImplementedError(
+            "nce sampler='custom_dist' is not supported; use 'uniform' or "
+            "'log_uniform' (CustomDistProbs would need a host-side alias "
+            "table)")
+    if sampler == 1:  # log-uniform (Zipfian), ref LogUniformSampler
+        u = jax.random.uniform(rng, shape)
+        ids = jnp.exp(u * np.log(num_classes + 1.0)).astype(jnp.int32) - 1
+        return jnp.clip(ids, 0, num_classes - 1)
+    return jax.random.randint(rng, shape, 0, num_classes)  # uniform
+
+
+def _sample_prob(sampler, ids, num_classes):
+    if sampler == 1:
+        idf = ids.astype(jnp.float32)
+        return (jnp.log((idf + 2.0) / (idf + 1.0))
+                / np.log(num_classes + 1.0))
+    return jnp.full(ids.shape, 1.0 / num_classes)
+
+
+def _nce_logits(x, w, b, ids):
+    """x [B,D], ids [B,K] -> logits [B,K] = w[ids]·x + b[ids]."""
+    w_rows = w[ids]                              # [B, K, D]
+    logits = jnp.einsum('bkd,bd->bk', w_rows, x)
+    if b is not None:
+        logits = logits + b.reshape(-1)[ids]
+    return logits
+
+
+def _nce_parts(ctx, ins):
+    x = unwrap(ins['Input'][0])
+    label = unwrap(ins['Label'][0]).astype(jnp.int32)
+    w = ins['Weight'][0]
+    b = ins['Bias'][0] if ins.get('Bias') and ins['Bias'][0] is not None \
+        else None
+    C = int(ctx.attr('num_total_classes'))
+    S = int(ctx.attr('num_neg_samples', 10))
+    sampler = int(ctx.attr('sampler', 0))
+    B = x.shape[0]
+    num_true = label.shape[-1] if label.ndim > 1 else 1
+    label = label.reshape(B, num_true)
+    neg = _sample_ids(ctx.rng(), sampler, (B, S), C)
+    ids = jnp.concatenate([label, neg], axis=1)      # [B, T+S]
+    logits = _nce_logits(x, w, b, ids)
+    q = _sample_prob(sampler, ids, C)
+    # P(sampled|x) model: o/(o + k·q); in log space l = logit - log(k·q)
+    k = float(S)
+    l = logits - jnp.log(k * q)
+    is_true = jnp.concatenate([jnp.ones((B, num_true), bool),
+                               jnp.zeros((B, S), bool)], axis=1)
+    sw = None
+    if ins.get('SampleWeight') and ins['SampleWeight'][0] is not None:
+        sw = unwrap(ins['SampleWeight'][0]).reshape(B, 1)
+    return x, w, b, ids, l, is_true, logits, sw
+
+
+@register('nce', lod='aware', diff_inputs=('Input', 'Weight', 'Bias'))
+def _nce(ctx, ins):
+    _, _, _, ids, l, is_true, logits, sw = _nce_parts(ctx, ins)
+    # -log σ(l) for true classes, -log σ(-l) for noise (ref nce_op.h:
+    # ComputeCost) — softplus keeps it stable without the reference's clip
+    cost = jnp.where(is_true, jax.nn.softplus(-l), jax.nn.softplus(l))
+    cost = jnp.sum(cost, axis=1, keepdims=True)
+    if sw is not None:
+        cost = cost * sw  # per-example weight (ref nce_op.h sample_weight)
+    return {'Cost': [cost],
+            'SampleLogits': [logits],
+            'SampleLabels': [ids.astype(jnp.int32)]}
+
+
+@register('nce_grad', no_grad=True, lod='aware')
+def _nce_grad(ctx, ins):
+    """Explicit grad so Weight@GRAD can be SelectedRows over the sampled
+    rows (ref nce_op.h NCEGradKernel SelectedRows path). Dense fallback
+    when is_sparse is off."""
+    a = ctx.attrs
+    igm, ogm = a['_in_grad_map'], a['_out_grad_map']
+    cost_name = a['_fwd_outputs']['Cost'][0]
+    cot_name = ogm.get(cost_name, '')
+    x, w, b, ids, l, is_true, _, sw = _nce_parts(ctx, ins)
+    B = x.shape[0]
+    cot = (unwrap(ctx.env(cot_name)).reshape(B, 1)
+           if cot_name and cot_name in ctx.tracer.env
+           else jnp.zeros((B, 1), x.dtype))
+    if sw is not None:
+        cot = cot * sw
+    # d cost / d logit: σ(l) - 1 on true slots, σ(l) on noise slots
+    g_logit = (jax.nn.sigmoid(l) - is_true.astype(x.dtype)) * cot  # [B,K]
+    outs = {}
+    names = []
+    x_name = a['_fwd_inputs']['Input'][0]
+    w_name = a['_fwd_inputs']['Weight'][0]
+    b_name = (a['_fwd_inputs'].get('Bias') or [''])[0]
+    for n in (x_name, w_name, b_name):
+        if n and igm.get(n):
+            names.append(n)
+    vals = {}
+    if igm.get(x_name):
+        vals[x_name] = jnp.einsum('bk,bkd->bd', g_logit, w[ids])
+    if igm.get(w_name):
+        rows = ids.reshape(-1)
+        gw_vals = (g_logit[..., None] * x[:, None, :]).reshape(-1, x.shape[1])
+        if ctx.attr('is_sparse', False):
+            vals[w_name] = SelectedRowsVal(rows, gw_vals, w.shape[0])
+        else:
+            vals[w_name] = jnp.zeros_like(w).at[rows].add(gw_vals,
+                                                          mode='drop')
+    if b_name and igm.get(b_name):
+        gb = jnp.zeros((b.size,), x.dtype).at[ids.reshape(-1)].add(
+            g_logit.reshape(-1), mode='drop')
+        vals[b_name] = gb.reshape(b.shape)
+    # IN@GRAD output order follows in_grad_map insertion order
+    ordered = [vals[n] for n in igm if n in vals]
+    return {'IN@GRAD': ordered}
+
+
+# ---------------------------------------------------------------------------
+# hierarchical sigmoid over the default complete binary tree
+# ---------------------------------------------------------------------------
+def _hsigmoid_parts(ctx, ins):
+    """Path encoding mirrors the reference SimpleCode
+    (math/matrix_bit_code.h): for label c, node index at depth j is
+    ((c + C) >> (j + 1)) - 1 and the target bit is ((c + C) >> j) & 1,
+    with path length floor(log2(c + C)). Everything is a fixed [B, Lmax]
+    program with a depth mask, so XLA sees static shapes for any labels."""
+    x = unwrap(ins['X'][0])
+    label = unwrap(ins['Label'][0]).astype(jnp.int32).reshape(-1)
+    w = ins['W'][0]            # [C-1, D]
+    b = ins['Bias'][0] if ins.get('Bias') and ins['Bias'][0] is not None \
+        else None
+    C = int(ctx.attr('num_classes'))
+    Lmax = int(np.floor(np.log2(2 * C - 1)))
+    code = label + C                                   # [B]
+    j = jnp.arange(Lmax, dtype=jnp.int32)              # [Lmax]
+    idx = (code[:, None] >> (j[None, :] + 1)) - 1      # [B, Lmax]
+    bit = ((code[:, None] >> j[None, :]) & 1).astype(x.dtype)
+    length = 31 - jax.lax.clz(code)                    # floor(log2(code))
+    mask = (j[None, :] < length[:, None]).astype(x.dtype)
+    idx = jnp.clip(idx, 0, w.shape[0] - 1)
+    pre = jnp.einsum('bld,bd->bl', w[idx], x)          # [B, Lmax]
+    if b is not None:
+        pre = pre + b.reshape(-1)[idx]
+    pre = jnp.clip(pre, -40.0, 40.0)
+    return x, w, b, idx, bit, mask, pre
+
+
+@register('hierarchical_sigmoid', lod='aware',
+          diff_inputs=('X', 'W', 'Bias'))
+def _hsigmoid(ctx, ins):
+    x, w, b, idx, bit, mask, pre = _hsigmoid_parts(ctx, ins)
+    # BCE with logits per path node: softplus(pre) - bit * pre
+    loss = (jax.nn.softplus(pre) - bit * pre) * mask
+    return {'Out': [jnp.sum(loss, axis=1, keepdims=True)],
+            'PreOut': [pre * mask]}
+
+
+@register('hierarchical_sigmoid_grad', no_grad=True, lod='aware')
+def _hsigmoid_grad(ctx, ins):
+    """Explicit grad: with is_sparse the W gradient is SelectedRows over the
+    ~log2(C) path nodes per example (ref hierarchical_sigmoid_op.cc
+    W@GRAD SelectedRows path); dense scatter fallback otherwise."""
+    a = ctx.attrs
+    igm, ogm = a['_in_grad_map'], a['_out_grad_map']
+    out_name = a['_fwd_outputs']['Out'][0]
+    cot_name = ogm.get(out_name, '')
+    x, w, b, idx, bit, mask, pre = _hsigmoid_parts(ctx, ins)
+    B = x.shape[0]
+    cot = (unwrap(ctx.env(cot_name)).reshape(B, 1)
+           if cot_name and cot_name in ctx.tracer.env
+           else jnp.zeros((B, 1), x.dtype))
+    # dL/dpre = (σ(pre) - bit) * mask * cot  (clip is inactive in (-40,40))
+    g_pre = (jax.nn.sigmoid(pre) - bit) * mask * cot       # [B, Lmax]
+    x_name = a['_fwd_inputs']['X'][0]
+    w_name = a['_fwd_inputs']['W'][0]
+    b_name = (a['_fwd_inputs'].get('Bias') or [''])[0]
+    vals = {}
+    if igm.get(x_name):
+        vals[x_name] = jnp.einsum('bl,bld->bd', g_pre, w[idx])
+    if igm.get(w_name):
+        rows = idx.reshape(-1)
+        gw = (g_pre[..., None] * x[:, None, :]).reshape(-1, x.shape[1])
+        if ctx.attr('is_sparse', False):
+            vals[w_name] = SelectedRowsVal(rows, gw, w.shape[0])
+        else:
+            vals[w_name] = jnp.zeros_like(w).at[rows].add(gw, mode='drop')
+    if b_name and igm.get(b_name):
+        gb = jnp.zeros((b.size,), x.dtype).at[idx.reshape(-1)].add(
+            g_pre.reshape(-1), mode='drop')
+        vals[b_name] = gb.reshape(b.shape)
+    return {'IN@GRAD': [vals[n] for n in igm if n in vals]}

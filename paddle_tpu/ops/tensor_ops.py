@@ -12,13 +12,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.registry import register
-from ..framework import runtime_dtype
-
-
-def INT_T():
-    # declared int64; resolved per call so a jax x64 toggle
-    # after import is honored (32-bit carrier otherwise)
-    return runtime_dtype('int64')
+from ..framework import runtime_dtype, int_t as INT_T
 from ..framework import convert_dtype
 from .math_ops import X
 

@@ -255,6 +255,7 @@ class AdamOptimizer(Optimizer):
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        self._lazy_mode = lazy_mode
 
     def _create_accumulators(self, block, parameters):
         for p in parameters:
@@ -286,7 +287,8 @@ class AdamOptimizer(Optimizer):
                      "Beta1PowOut": [beta1_pow.name],
                      "Beta2PowOut": [beta2_pow.name]},
             attrs={"beta1": self._beta1, "beta2": self._beta2,
-                   "epsilon": self._epsilon, 'op_role': OP_ROLE_OPTIMIZE},
+                   "epsilon": self._epsilon, "lazy_mode": self._lazy_mode,
+                   'op_role': OP_ROLE_OPTIMIZE},
             infer_shape=False)
 
 
