@@ -11,7 +11,7 @@ from . import ops as _ops_mod
 from .tensor import (create_tensor, create_parameter, create_global_var,  # noqa
                      sums, assign, fill_constant, fill_constant_batch_size_like,
                      ones, zeros, zeros_like, reverse, has_inf, has_nan,
-                     isfinite, tensor_array_to_tensor)
+                     isfinite, tensor_array_to_tensor, range)
 from .io import (data, read_file, load, py_reader,  # noqa: F401
                  create_py_reader_by_data, double_buffer, batch, shuffle)
 from .sequence import (sequence_pool, sequence_first_step,  # noqa: F401

@@ -145,3 +145,17 @@ def tensor_array_to_tensor(input, axis=1, name=None):
                      outputs={'Out': [out], 'OutIndex': [out_index]},
                      attrs={'axis': axis})
     return out, out_index
+
+
+def range(start, end, step=1, dtype='int64', name=None):
+    """[start, end) with stride step, static bounds (jnp.arange)."""
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper('range', name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='range', inputs={},
+                     outputs={'Out': [out.name]},
+                     attrs={'start': start, 'end': end, 'step': step,
+                            'dtype': dtype}, infer_shape=False)
+    out.shape = (max(0, (end - start + step - 1) // step)
+                 if step > 0 else 0,)
+    return out

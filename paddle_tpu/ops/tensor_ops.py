@@ -43,6 +43,15 @@ def _fill_constant_bsl(ctx, ins):
     return {'Out': [jnp.full(shape, ctx.attr('value', 0.0), dtype=dt)]}
 
 
+@register('range', no_grad=True)
+def _range(ctx, ins):
+    # static start/end/step (attrs) -> jnp.arange; tensor inputs would make
+    # the output shape dynamic, which XLA cannot compile
+    dt = _np_dtype(ctx.attr('dtype'), 'int64')
+    return {'Out': [jnp.arange(ctx.attr('start', 0), ctx.attr('end'),
+                               ctx.attr('step', 1), dtype=dt)]}
+
+
 @register('fill_zeros_like', no_grad=True)
 def _fill_zeros_like(ctx, ins):
     return {'Out': [jnp.zeros_like(X(ins))]}
