@@ -3,13 +3,28 @@
 The reference's LoDTensor (paddle/fluid/framework/lod_tensor.h:58,110) packs
 variable-length sequences into one dense tensor plus offset tables, and its
 kernels read the offsets on the HOST (mixed_vector.h keeps a CPU home for
-the LoD). TPU-native re-design keeps that split: the dense data is a
-jax.Array; the offsets are STATIC host-side tuples carried in the pytree
-structure (aux data). Sequence ops therefore lower to fully static-shape XLA
-programs — the fastest form XLA can compile — and the jit cache keys on the
-lod pattern. Variable-length batches should be bucketed/padded on the host
-(reader decorators provide bucketing) to bound recompiles, exactly as
-TPU input pipelines do.
+the LoD).
+
+TPU-native re-design, two modes per LoDArray:
+
+- STATIC (default): offsets are host tuples carried in the pytree STRUCTURE
+  (aux data). Sequence ops constant-fold them into static-shape XLA
+  programs; the jit cache keys on the lod pattern. Right for fixed corpora
+  and for ops whose OUTPUT SHAPE depends on lod content (sequence_expand,
+  sequence_erase, lod_tensor_to_array) — dynamic output shapes cannot be
+  compiled, so those recompile per pattern by design.
+
+- TRACED: offsets are device int32 arrays carried as pytree CHILDREN. The
+  compiled program's shape depends only on the BUCKET shape (total rows,
+  nseq, padded length), not the lod values, so any same-bucket batch hits
+  the same executable — this kills the per-batch recompile the reference
+  avoided with lod-generic kernels (operators/math/sequence2batch.h).
+  Lowerings use `off_t()` + searchsorted/segment math, which serves BOTH
+  modes (static offsets become XLA constants and fold away).
+
+Host-side bucketing (reader decorators bucket_by_length) pairs with traced
+mode: pad each batch to its bucket's (rows, nseq) and every bucket compiles
+exactly once.
 """
 from __future__ import annotations
 
@@ -25,24 +40,95 @@ def _freeze(lod):
 
 @jax.tree_util.register_pytree_node_class
 class LoDArray(object):
-    """Dense device data + static per-level row-split offsets."""
+    """Dense device data + per-level row-split offsets (static or traced)."""
 
-    __slots__ = ('data', 'lod')
+    __slots__ = ('data', '_lod', '_lod_t')
 
     def __init__(self, data, lod=()):
         self.data = data
-        self.lod = _freeze(lod)
-
-    # -- pytree protocol: lod is STRUCTURE, not a leaf --------------------
-    def tree_flatten(self):
-        return (self.data,), self.lod
+        self._lod = _freeze(lod)
+        self._lod_t = None
 
     @classmethod
-    def tree_unflatten(cls, lod, children):
+    def traced(cls, data, offsets):
+        """Build a traced-offset LoDArray. offsets: list of int32 device
+        arrays [n_i + 1] (one per lod level)."""
         obj = cls.__new__(cls)
-        obj.data = children[0]
-        obj.lod = lod
+        obj.data = data
+        obj._lod = None
+        obj._lod_t = tuple(jnp.asarray(o, jnp.int32) for o in offsets)
         return obj
+
+    # -- pytree protocol ---------------------------------------------------
+    def tree_flatten(self):
+        if self._lod_t is not None:
+            return (self.data,) + self._lod_t, ('traced', len(self._lod_t))
+        return (self.data,), ('static', self._lod)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        obj = cls.__new__(cls)
+        kind, info = aux
+        if kind == 'traced':
+            obj.data = children[0]
+            obj._lod = None
+            obj._lod_t = tuple(children[1:1 + info])
+        else:
+            obj.data = children[0]
+            obj._lod = info
+            obj._lod_t = None
+        return obj
+
+    # -- mode --------------------------------------------------------------
+    @property
+    def is_traced(self):
+        return self._lod_t is not None
+
+    @property
+    def lod(self):
+        """Host offsets (tuple of tuples). In traced mode this works only
+        OUTSIDE a trace (concrete device offsets pull to host — fetch/save
+        time); under jit the offsets are tracers and ops that genuinely
+        need host values (content-dependent output shapes) cannot run on
+        traced-lod inputs."""
+        if self._lod_t is not None:
+            if any(isinstance(o, jax.core.Tracer) for o in self._lod_t):
+                raise TracedLoDError(
+                    "this op needs HOST lod values (its output shape "
+                    "depends on them), but the input carries traced "
+                    "(device) lod. Feed a static-lod batch for this op, or "
+                    "restructure to the padded equivalent "
+                    "(sequence_pad/sequence_mask).")
+            return _freeze([np.asarray(o) for o in self._lod_t])
+        return self._lod
+
+    @property
+    def nlevels(self):
+        return len(self._lod_t) if self._lod_t is not None else len(self._lod)
+
+    def off_t(self, level=-1):
+        """Offsets of `level` as an int32 device value — traced arrays in
+        traced mode, XLA constants in static mode. The uniform currency for
+        lowerings (one implementation serves both modes)."""
+        if self._lod_t is not None:
+            return self._lod_t[level]
+        return jnp.asarray(np.asarray(self._lod[level]), jnp.int32)
+
+    def nseq_of(self, level=-1):
+        """STATIC sequence count (offset array length - 1) — shape-level in
+        both modes."""
+        if self._lod_t is not None:
+            return int(self._lod_t[level].shape[0]) - 1
+        return len(self._lod[level]) - 1
+
+    def with_lod_of(self, data, level_slice=None):
+        """New LoDArray around `data` sharing this one's lod (same mode)."""
+        if self._lod_t is not None:
+            lt = self._lod_t if level_slice is None else \
+                self._lod_t[level_slice]
+            return LoDArray.traced(data, lt)
+        l = self._lod if level_slice is None else self._lod[level_slice]
+        return LoDArray(data, l)
 
     @property
     def shape(self):
@@ -54,7 +140,9 @@ class LoDArray(object):
 
     @property
     def nseq(self):
-        return len(self.lod[0]) - 1 if self.lod else None
+        if self._lod_t is not None:
+            return int(self._lod_t[0].shape[0]) - 1
+        return len(self._lod[0]) - 1 if self._lod else None
 
     def offsets(self, level=0):
         return np.asarray(self.lod[level], dtype=np.int64)
@@ -70,9 +158,16 @@ class LoDArray(object):
         return self.offsets(len(self.lod) - 1)
 
     def __repr__(self):
+        if self._lod_t is not None:
+            return "LoDArray(shape=%s, traced lod x%d)" % (
+                tuple(self.data.shape), len(self._lod_t))
         return "LoDArray(shape=%s, lod=%s)" % (
             tuple(self.data.shape),
-            [list(l)[:8] for l in self.lod])
+            [list(l)[:8] for l in self._lod])
+
+
+class TracedLoDError(TypeError):
+    pass
 
 
 def unwrap(x):
@@ -88,14 +183,41 @@ def lengths_to_offsets(lengths):
     return np.concatenate([[0], np.cumsum(lengths)]).astype(np.int64)
 
 
-def create_lod_array(data, recursive_seq_lens=None, lod=None):
-    """Build a LoDArray from dense data + python nested lengths or offsets."""
+def create_lod_array(data, recursive_seq_lens=None, lod=None, traced=False,
+                     bucket_rows=None):
+    """Build a LoDArray from dense data + nested lengths or offsets.
+
+    traced=True: offsets become device data (see module docstring) so the
+    compiled program is lod-generic. bucket_rows pads `data`'s leading dim
+    up to the bucket capacity so every same-bucket batch shares one shape.
+    """
     if lod is None:
         lod = []
         if recursive_seq_lens:
             for lens in recursive_seq_lens:
                 lod.append(lengths_to_offsets(lens))
-    return LoDArray(jnp.asarray(data), lod)
+    data = jnp.asarray(data)
+    if bucket_rows is not None and data.shape[0] < bucket_rows:
+        pad = [(0, bucket_rows - data.shape[0])] + [(0, 0)] * (data.ndim - 1)
+        data = jnp.pad(data, pad)
+    if traced:
+        return LoDArray.traced(data, [jnp.asarray(np.asarray(l), jnp.int32)
+                                      for l in lod])
+    return LoDArray(data, lod)
+
+
+def seg_ids_t(off_t, total):
+    """Traced/constant row -> sequence-index map: searchsorted over the
+    offsets. Padding rows past off[-1] map to nseq (out of range), which
+    jax segment_* ops drop and gathers must mask."""
+    return (jnp.searchsorted(off_t.astype(jnp.int32),
+                             jnp.arange(total, dtype=jnp.int32),
+                             side='right') - 1).astype(jnp.int32)
+
+
+def valid_rows_t(off_t, total):
+    """Bool [total]: row belongs to a real sequence (not bucket padding)."""
+    return jnp.arange(total, dtype=jnp.int32) < off_t[-1].astype(jnp.int32)
 
 
 def segment_ids_from_offsets(offsets, total):

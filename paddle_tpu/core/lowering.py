@@ -132,30 +132,32 @@ class Tracer(object):
                              (t, op))
         ctx = OpCtx(self, op, block)
         ins = self._gather_inputs(op, block)
-        src_lod = None
+        src_la = None
         src_rows = None
         if d.lod_mode != 'aware':
             for vals in ins.values():
                 for v in vals:
-                    if isinstance(v, LoDArray) and src_lod is None:
-                        src_lod = v.lod
+                    if isinstance(v, LoDArray) and src_la is None:
+                        src_la = v
                         src_rows = v.data.shape[0] if v.data.ndim else None
-            if src_lod is not None:
+            if src_la is not None:
                 ins = {slot: [unwrap(v) for v in vals]
                        for slot, vals in ins.items()}
         outs = d.lower(ctx, ins)
-        if (d.lod_mode == 'pass' and src_lod is not None and outs):
-            outs = {slot: [self._maybe_wrap(v, src_lod, src_rows)
+        if (d.lod_mode == 'pass' and src_la is not None and outs):
+            outs = {slot: [self._maybe_wrap(v, src_la, src_rows)
                            for v in vals] if vals is not None else None
                     for slot, vals in outs.items()}
         self._scatter_outputs(op, outs)
 
     @staticmethod
-    def _maybe_wrap(v, lod, rows):
+    def _maybe_wrap(v, src_la, rows):
+        # ShareLoD: rewrap row-aligned outputs with the source's lod,
+        # preserving its static/traced mode
         if (v is not None and not isinstance(v, LoDArray)
                 and hasattr(v, 'ndim') and v.ndim >= 1 and rows is not None
                 and v.shape[0] == rows):
-            return LoDArray(v, lod)
+            return src_la.with_lod_of(v)
         return v
 
     def _gather_inputs(self, op, block):
@@ -224,7 +226,7 @@ class Tracer(object):
             for n, v in zip(diff_names, diff_vals):
                 orig = base_env.get(n)
                 if isinstance(orig, LoDArray):
-                    v = LoDArray(v, orig.lod)
+                    v = orig.with_lod_of(v)
                 env2[n] = v
             ins = {slot: [env2.get(n) if n else None for n in names]
                    for slot, names in fwd_inputs.items()}

@@ -208,7 +208,12 @@ class Executor(object):
 
     def _sig(self, v):
         if isinstance(v, LoDArray):
-            # lod offsets are static structure: part of the compile key
+            if v.is_traced:
+                # traced lod: offsets are data — the compiled program is
+                # lod-generic, so only bucket SHAPES key the cache
+                return ('lodt', v.data.shape, str(v.data.dtype),
+                        tuple(int(o.shape[0]) for o in v._lod_t))
+            # static lod offsets are structure: part of the compile key
             return ('lod', v.data.shape, str(v.data.dtype), v.lod)
         return (tuple(np.shape(v)), str(getattr(v, 'dtype', type(v).__name__)))
 

@@ -156,6 +156,8 @@ def range(start, end, step=1, dtype='int64', name=None):
                      outputs={'Out': [out.name]},
                      attrs={'start': start, 'end': end, 'step': step,
                             'dtype': dtype}, infer_shape=False)
-    out.shape = (max(0, (end - start + step - 1) // step)
-                 if step > 0 else 0,)
+    if step == 0:
+        raise ValueError("range step must be nonzero")
+    span = end - start
+    out.shape = (max(0, -(-span // step)),)  # ceil-div, sign-correct
     return out

@@ -10,22 +10,30 @@ from .core.lod import LoDArray, lengths_to_offsets
 LoDTensor = LoDArray
 
 
-def create_lod_tensor(data, recursive_seq_lens, place=None):
+def create_lod_tensor(data, recursive_seq_lens, place=None, traced=False,
+                      bucket_rows=None):
     """Build a LoDTensor from numpy data + nested sequence lengths
-    (ref lod_tensor.py create_lod_tensor)."""
+    (ref lod_tensor.py create_lod_tensor).
+
+    traced=True makes the lod DEVICE DATA instead of compile-time structure:
+    every batch with the same bucket shape (data rows padded to bucket_rows,
+    same sequence count) then reuses one compiled program — see
+    core/lod.py. Pair with reader.bucket_by_length."""
     if isinstance(data, LoDArray):
         return create_lod_tensor(np.asarray(data.data), recursive_seq_lens,
-                                 place)
+                                 place, traced=traced,
+                                 bucket_rows=bucket_rows)
     if isinstance(data, list):
         # list of sequences: flatten, derive lengths
         flat = np.concatenate([np.asarray(s).reshape(len(s), -1) for s in data])
         seq_lens = [len(s) for s in data]
         assert [seq_lens] == recursive_seq_lens or recursive_seq_lens is None
-        return create_lod_tensor(flat, [seq_lens], place)
-    data = np.asarray(data)
-    lod = [lengths_to_offsets(l) for l in (recursive_seq_lens or [])]
-    import jax.numpy as jnp
-    return LoDArray(jnp.asarray(data), lod)
+        return create_lod_tensor(flat, [seq_lens], place, traced=traced,
+                                 bucket_rows=bucket_rows)
+    from .core.lod import create_lod_array
+    return create_lod_array(np.asarray(data),
+                            recursive_seq_lens=recursive_seq_lens,
+                            traced=traced, bucket_rows=bucket_rows)
 
 
 def create_random_int_lodtensor(recursive_seq_lens, base_shape, place, low,

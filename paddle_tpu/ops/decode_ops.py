@@ -90,18 +90,25 @@ def _ctc_greedy_decoder(ctx, ins):
     x = ins['Input'][0]
     blank = int(ctx.attr('blank', 0))
     off = _lod_offsets(x, 'ctc_greedy_decoder')
-    best = jnp.argmax(unwrap(x), axis=-1).astype(INT_T())  # [sum]
-    outs = []
-    for i in range(len(off) - 1):
-        seg = best[int(off[i]):int(off[i + 1])]
-        prev = jnp.concatenate([jnp.full((1,), -1, seg.dtype), seg[:-1]])
-        keep = (seg != prev) & (seg != blank)
-        pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
-        L = seg.shape[0]
-        tgt = jnp.where(keep, pos, L)  # L is out of bounds -> write dropped
-        row = jnp.full((L,), -1, seg.dtype).at[tgt].set(seg, mode='drop')
-        outs.append(row.reshape(-1, 1))
-    return {'Output': [LoDArray(jnp.concatenate(outs, 0), x.lod)]}
+    best = jnp.argmax(unwrap(x), axis=-1).astype(INT_T())  # [T]
+    T = best.shape[0]
+    # flat segment formulation (one program regardless of batch): a frame is
+    # kept if it differs from the previous frame OF THE SAME SEQUENCE and is
+    # not blank; kept tokens scatter to their within-sequence rank
+    lens = off[1:] - off[:-1]
+    seg = jnp.asarray(np.repeat(np.arange(len(lens)), lens).astype(np.int32))
+    off_j = jnp.asarray(off.astype(np.int32))
+    prev = jnp.concatenate([jnp.full((1,), -1, best.dtype), best[:-1]])
+    first = jnp.asarray(
+        np.isin(np.arange(T), off[:-1]))  # first frame of each sequence
+    keep = (first | (best != prev)) & (best != blank)
+    csum = jnp.cumsum(keep.astype(jnp.int32))
+    seq_base = jnp.take(jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), csum]), jnp.take(off_j, seg))
+    rank = csum - 1 - seq_base                    # within-seq kept rank
+    tgt = jnp.where(keep, jnp.take(off_j, seg) + rank, T)
+    out = jnp.full((T,), -1, best.dtype).at[tgt].set(best, mode='drop')
+    return {'Output': [LoDArray(out.reshape(-1, 1), x.lod)]}
 
 
 @register('edit_distance', no_grad=True, lod='aware')
@@ -155,16 +162,20 @@ def _edit_distance(ctx, ins):
         all_rows = jnp.concatenate([row0[None], rows], axis=0)
         return all_rows[hlen, rlen].astype(jnp.float32)
 
-    dists = []
-    for i in range(n):
-        hseq = compact(h[int(h_off[i]):int(h_off[i + 1])])
-        rseq = compact(r[int(r_off[i]):int(r_off[i + 1])])
-        d = one_pair(hseq, rseq)
-        if normalized:
-            rlen = jnp.maximum(jnp.sum(rseq >= 0), 1)
-            d = d / rlen.astype(jnp.float32)
-        dists.append(d)
-    return {'Out': [jnp.stack(dists).reshape(-1, 1)],
+    # batch the pairs: lod-pad to [B, maxH]/[B, maxR] (-1 beyond each
+    # sequence) and vmap the DP — program size is O(1) in the batch
+    from .rnn_ops import _pad_from_lod
+    hp, hm = _pad_from_lod(h, h_off)
+    rp, rm = _pad_from_lod(r, r_off)
+    hp = jnp.where(hm, hp, -1)
+    rp = jnp.where(rm, rp, -1)
+    hseq = jax.vmap(compact)(hp)
+    rseq = jax.vmap(compact)(rp)
+    d = jax.vmap(one_pair)(hseq, rseq)
+    if normalized:
+        rlen = jnp.maximum(jnp.sum(rseq >= 0, axis=1), 1)
+        d = d / rlen.astype(jnp.float32)
+    return {'Out': [d.reshape(-1, 1)],
             'SequenceNum': [jnp.asarray(n, INT_T()).reshape(1)]}
 
 
@@ -277,10 +288,9 @@ def _crf_decoding(ctx, ins):
     path = jnp.concatenate([tag0[None], tail_rev[::-1]], axis=0)  # [T,B]
     path = jnp.moveaxis(path, 1, 0).astype(INT_T())             # [B,T]
 
-    rows = []
-    for i in range(B):
-        rows.append(path[i, :int(lens[i])])
-    flat = jnp.concatenate(rows).reshape(-1, 1)
+    from .rnn_ops import _unpad_to_lod
+    off_b = np.concatenate([[0], np.cumsum(lens)])
+    flat = _unpad_to_lod(path[..., None], off_b).reshape(-1, 1)
     if label is not None:
         lab = unwrap(label).reshape(-1, 1).astype(INT_T())
         flat = (flat == lab).astype(INT_T())

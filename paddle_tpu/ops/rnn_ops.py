@@ -38,35 +38,45 @@ _ACT = {
 
 
 def _pad_from_lod(x, off):
-    """[T, D] + offsets -> ([N, L, D], mask [N, L])."""
+    """[T, D] + static offsets -> ([N, L, D], mask [N, L]); vectorized
+    numpy index construction (no per-row python)."""
+    off = np.asarray(off, np.int64)
     lens = off[1:] - off[:-1]
     n, maxlen = len(lens), int(lens.max()) if len(lens) else 0
     d = x.shape[1:]
-    gather = np.zeros((n, maxlen), dtype=np.int32)
-    mask = np.zeros((n, maxlen), dtype=bool)
-    for i in range(n):
-        l = int(lens[i])
-        gather[i, :l] = np.arange(off[i], off[i] + l)
-        mask[i, :l] = True
+    j = np.arange(maxlen, dtype=np.int64)
+    gather = np.minimum(off[:-1, None] + j[None, :],
+                        max(x.shape[0] - 1, 0)).astype(np.int32)
+    mask = j[None, :] < lens[:, None]
     rows = jnp.take(x, jnp.asarray(gather.reshape(-1)), axis=0)
     return rows.reshape((n, maxlen) + d), jnp.asarray(mask)
 
 
-def _unpad_to_lod(y, off):
+def _unpad_idx(off, maxlen):
+    """Flat [N*L] -> packed-row gather index for the valid positions."""
+    off = np.asarray(off, np.int64)
     lens = off[1:] - off[:-1]
-    maxlen = y.shape[1]
-    idx = []
-    for i in range(len(lens)):
-        idx.extend(range(i * maxlen, i * maxlen + int(lens[i])))
+    ends = np.cumsum(lens)
+    total = int(ends[-1]) if len(lens) else 0
+    base = np.repeat(np.arange(len(lens), dtype=np.int64) * maxlen
+                     - (ends - lens), lens)
+    return (np.arange(total, dtype=np.int64) + base).astype(np.int32)
+
+
+def _unpad_to_lod(y, off):
     flat = y.reshape((-1,) + y.shape[2:])
-    return jnp.take(flat, jnp.asarray(idx, dtype=jnp.int32), axis=0)
+    return jnp.take(flat, jnp.asarray(_unpad_idx(off, y.shape[1])), axis=0)
 
 
 def _reverse_lod_rows(x, off):
-    idx = np.arange(x.shape[0], dtype=np.int32)
-    for i in range(len(off) - 1):
-        idx[off[i]:off[i + 1]] = idx[off[i]:off[i + 1]][::-1]
-    return jnp.take(x, jnp.asarray(idx), axis=0)
+    off = np.asarray(off, np.int64)
+    lens = off[1:] - off[:-1]
+    seg = np.repeat(np.arange(len(lens)), lens)
+    valid = int(off[-1])
+    pos = np.arange(valid, dtype=np.int64)
+    idx = np.arange(x.shape[0], dtype=np.int64)  # bucket-pad rows: identity
+    idx[:valid] = off[seg] + off[seg + 1] - 1 - pos
+    return jnp.take(x, jnp.asarray(idx.astype(np.int32)), axis=0)
 
 
 @register('lstm', lod='aware')

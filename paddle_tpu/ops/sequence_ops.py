@@ -1,12 +1,20 @@
 """Sequence (LoD) op lowerings (ref: paddle/fluid/operators/sequence_ops/ —
 ~20 ops — plus lod_reset_op.cc, im2sequence_op.cc, row_conv_op.cc).
 
-Design (core/lod.py): LoD offsets are STATIC host metadata; every lowering
-here turns them into constant index/segment arrays, so the compiled program
-is pure static-shape XLA — gathers, segment reductions, matmuls. The jit
-cache keys on the lod pattern; host-side bucketing (reader decorators)
-bounds recompiles. This trades the reference's per-batch dynamic kernels
-(e.g. math/sequence2batch.h re-batching) for XLA-optimal static programs.
+Design (core/lod.py): every lowering here is written in OFFSET MATH —
+searchsorted segment ids, offset-gather indices, masked windows — over
+`off_t()`, the device view of the lod. The SAME code therefore serves both
+lod modes: with static lod the offsets are XLA constants (folded away,
+yesterday's behavior); with traced lod the compiled program is lod-GENERIC
+— any batch of the same bucket shape reuses the executable, the moral
+equivalent of the reference's lod-generic kernels
+(operators/math/sequence2batch.h). No lowering loops over rows or bakes
+O(batch) Python into the trace.
+
+Ops whose OUTPUT SHAPE depends on lod content (sequence_expand,
+sequence_slice, sequence_erase) read `x.lod` (host values) and remain
+static-mode only — dynamic output shapes cannot be compiled; they raise
+TracedLoDError with guidance on traced inputs.
 """
 from __future__ import annotations
 
@@ -16,32 +24,36 @@ import jax.numpy as jnp
 
 from ..core.registry import register
 from ..framework import int_t as INT_T
-from ..core.lod import LoDArray, unwrap, segment_ids_from_offsets
+from ..core.lod import (LoDArray, unwrap, seg_ids_t, valid_rows_t,
+                        segment_ids_from_offsets)
+
+
+def _la(x, what):
+    assert isinstance(x, LoDArray) and x.nlevels, (
+        "%s input must carry LoD (got %r)" % (what, x))
+    return x
 
 
 def _off(x, level=-1):
-    assert isinstance(x, LoDArray) and x.lod, (
+    """STATIC host offsets — only for ops with content-dependent shapes."""
+    assert isinstance(x, LoDArray) and x.nlevels, (
         "sequence op input must carry LoD (got %r)" % (x,))
     return np.asarray(x.lod[level], dtype=np.int64)
 
 
-def _seg_ids(x):
-    off = _off(x)
-    return segment_ids_from_offsets(off, x.data.shape[0]), len(off) - 1
-
-
 # ---------------------------------------------------------------------------
-# pooling / softmax — reductions within sequences
+# pooling / softmax — segment reductions
 # ---------------------------------------------------------------------------
 @register('sequence_pool', lod='aware')
 def _sequence_pool(ctx, ins):
-    x = ins['X'][0]
+    x = _la(ins['X'][0], 'sequence_pool')
     ptype = ctx.attr('pooltype', 'AVERAGE').upper()
     data = x.data
-    off = _off(x)
-    n = len(off) - 1
-    seg, _ = _seg_ids(x)
-    lens = jnp.asarray((off[1:] - off[:-1]).astype(np.float32))
+    off = x.off_t()
+    n = x.nseq_of()
+    T = data.shape[0]
+    seg = seg_ids_t(off, T)
+    lens = (off[1:] - off[:-1]).astype(jnp.float32)
     lens_col = lens.reshape((n,) + (1,) * (data.ndim - 1))
     if ptype == 'SUM':
         out = jax.ops.segment_sum(data, seg, num_segments=n)
@@ -53,17 +65,15 @@ def _sequence_pool(ctx, ins):
             jnp.maximum(lens_col, 1.0))
     elif ptype == 'MAX':
         out = jax.ops.segment_max(data, seg, num_segments=n)
-        idx = jnp.argmax(
-            jnp.where((seg[:, None] == jnp.arange(n)[None, :]).T[..., None]
-                      if data.ndim > 1 else
-                      (seg[None, :] == jnp.arange(n)[:, None]),
-                      data[None], -jnp.inf).reshape(n, data.shape[0], -1),
-            axis=1)
+        member = seg[None, :] == jnp.arange(n, dtype=jnp.int32)[:, None]
+        masked = jnp.where(member[..., None] if data.ndim > 1 else member,
+                           data[None], -jnp.inf)
+        idx = jnp.argmax(masked.reshape(n, T, -1), axis=1)
         return {'Out': [out], 'MaxIndex': [idx.astype(jnp.int32)]}
     elif ptype == 'LAST':
-        out = jnp.take(data, jnp.asarray(off[1:] - 1), axis=0)
+        out = jnp.take(data, jnp.maximum(off[1:] - 1, 0), axis=0)
     elif ptype == 'FIRST':
-        out = jnp.take(data, jnp.asarray(off[:-1]), axis=0)
+        out = jnp.take(data, off[:-1], axis=0)
     else:
         raise ValueError("unknown pooltype %r" % ptype)
     return {'Out': [out]}
@@ -71,55 +81,48 @@ def _sequence_pool(ctx, ins):
 
 @register('sequence_softmax', lod='aware')
 def _sequence_softmax(ctx, ins):
-    x = ins['X'][0]
+    x = _la(ins['X'][0], 'sequence_softmax')
     data = x.data
     flat = data.reshape(-1)
-    seg, n = _seg_ids(x)
+    T = flat.shape[0]
+    seg = seg_ids_t(x.off_t(), T)
+    n = x.nseq_of()
     mx = jax.ops.segment_max(flat, seg, num_segments=n)
-    e = jnp.exp(flat - mx[seg])
+    safe = jnp.where(jnp.isfinite(mx), mx, 0.0)
+    e = jnp.exp(flat - jnp.take(safe, jnp.minimum(seg, n - 1)))
+    e = jnp.where(valid_rows_t(x.off_t(), T), e, 0.0)
     s = jax.ops.segment_sum(e, seg, num_segments=n)
-    out = (e / s[seg]).reshape(data.shape)
-    return {'Out': [LoDArray(out, x.lod)]}
+    out = (e / jnp.maximum(jnp.take(s, jnp.minimum(seg, n - 1)), 1e-30)
+           ).reshape(data.shape)
+    return {'Out': [x.with_lod_of(out)]}
 
 
 # ---------------------------------------------------------------------------
-# expand / concat / reshape / reverse — row-index gathers from static lod
+# expand / concat / reshape / reverse
 # ---------------------------------------------------------------------------
-def _expand_index(x_off, y_off):
-    """Row gather index replicating x regions to match y lengths."""
-    idx = []
-    for i in range(len(y_off) - 1):
-        xs, xe = x_off[i], x_off[i + 1]
-        reps = y_off[i + 1] - y_off[i]
-        if xe - xs == 0:
-            continue
-        # reference semantics: repeat x's region `reps` times
-        region = list(range(xs, xe))
-        idx.extend(region * int(reps))
-    return np.asarray(idx, dtype=np.int32)
-
-
 @register('sequence_expand', lod='aware')
 def _sequence_expand(ctx, ins):
+    # output row count depends on lod VALUES -> static mode by design
     x, y = ins['X'][0], ins['Y'][0]
     ref_level = ctx.attr('ref_level', -1)
-    y_lod = y.lod
-    y_off = np.asarray(y_lod[ref_level], dtype=np.int64)
+    y_off = np.asarray(y.lod[ref_level], dtype=np.int64)
     xd = unwrap(x)
-    if isinstance(x, LoDArray) and x.lod:
+    if isinstance(x, LoDArray) and x.nlevels:
         x_off = _off(x, 0)
     else:
         x_off = np.arange(xd.shape[0] + 1, dtype=np.int64)
-    # out region i = x region i tiled (y_len_i) times
-    idx = []
-    out_lens = []
-    for i in range(len(y_off) - 1):
-        xs, xe = int(x_off[i]), int(x_off[i + 1])
-        reps = int(y_off[i + 1] - y_off[i])
-        region = list(range(xs, xe))
-        idx.extend(region * reps)
-        out_lens.append(len(region) * reps)
-    out = jnp.take(xd, jnp.asarray(idx, dtype=jnp.int32), axis=0)
+    # out region i = x region i tiled (y_len_i) times — vectorized index
+    # construction (no per-row python)
+    reps = (y_off[1:] - y_off[:-1]).astype(np.int64)
+    xlens = (x_off[1:] - x_off[:-1]).astype(np.int64)
+    out_lens = xlens * reps
+    starts = np.repeat(x_off[:-1], reps)            # region start per copy
+    copy_lens = np.repeat(xlens, reps)              # region len per copy
+    ends = np.cumsum(copy_lens)
+    total = int(ends[-1]) if len(ends) else 0
+    base = np.repeat(starts - (ends - copy_lens), copy_lens)
+    idx = (np.arange(total, dtype=np.int64) + base).astype(np.int32)
+    out = jnp.take(xd, jnp.asarray(idx), axis=0)
     off = np.concatenate([[0], np.cumsum(out_lens)])
     return {'Out': [LoDArray(out, (off,))]}
 
@@ -127,107 +130,162 @@ def _sequence_expand(ctx, ins):
 @register('sequence_expand_as', lod='aware')
 def _sequence_expand_as(ctx, ins):
     x, y = ins['X'][0], ins['Y'][0]
-    y_off = _off(y, 0)
+    y = _la(y, 'sequence_expand_as Y')
     xd = unwrap(x)
-    reps = (y_off[1:] - y_off[:-1]).astype(np.int64)
-    idx = np.repeat(np.arange(xd.shape[0]), reps).astype(np.int32)
-    out = jnp.take(xd, jnp.asarray(idx), axis=0)
-    return {'Out': [LoDArray(out, (y_off,))]}
+    y_off = y.off_t(0)
+    T = unwrap(y).shape[0]
+    seg = seg_ids_t(y_off, T)  # out row j copies x row seg[j]
+    out = jnp.take(xd, jnp.minimum(seg, xd.shape[0] - 1), axis=0)
+    out = jnp.where(
+        valid_rows_t(y_off, T).reshape((T,) + (1,) * (out.ndim - 1)),
+        out, 0)
+    return {'Out': [y.with_lod_of(out, slice(0, 1))]}
 
 
 @register('sequence_concat', lod='aware')
 def _sequence_concat(ctx, ins):
-    xs = [x for x in ins['X'] if x is not None]
-    offs = [_off(x, 0) for x in xs]
-    n = len(offs[0]) - 1
-    idx = []
-    out_lens = []
-    bases = np.cumsum([0] + [unwrap(x).shape[0] for x in xs])
-    for i in range(n):
-        total = 0
-        for k, off in enumerate(offs):
-            s, e = int(off[i]), int(off[i + 1])
-            idx.extend(range(bases[k] + s, bases[k] + e))
-            total += e - s
-        out_lens.append(total)
-    big = jnp.concatenate([unwrap(x) for x in xs], axis=0)
-    out = jnp.take(big, jnp.asarray(idx, dtype=jnp.int32), axis=0)
-    off = np.concatenate([[0], np.cumsum(out_lens)])
-    return {'Out': [LoDArray(out, (off,))]}
+    """Interleave per-sequence regions of K inputs. Output rows = sum of
+    input rows (STATIC); positions are offset math — scatter each input's
+    rows to out_off[seg] + prior-inputs' length + within-seq index."""
+    xs = [_la(x, 'sequence_concat') for x in ins['X'] if x is not None]
+    offs = [x.off_t(0) for x in xs]
+    n = xs[0].nseq_of(0)
+    lens = [o[1:] - o[:-1] for o in offs]                 # [K][n]
+    out_lens = sum(lens[1:], lens[0])
+    out_off = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               jnp.cumsum(out_lens).astype(jnp.int32)])
+    total = sum(unwrap(x).shape[0] for x in xs)
+    out = jnp.zeros((total,) + unwrap(xs[0]).shape[1:], unwrap(xs[0]).dtype)
+    prior = jnp.zeros((n,), jnp.int32)
+    for k, x in enumerate(xs):
+        d = unwrap(x)
+        Tk = d.shape[0]
+        seg = seg_ids_t(offs[k], Tk)
+        segc = jnp.minimum(seg, n - 1)
+        within = jnp.arange(Tk, dtype=jnp.int32) - jnp.take(offs[k], segc)
+        pos = jnp.take(out_off[:-1], segc) + jnp.take(prior, segc) + within
+        pos = jnp.where(valid_rows_t(offs[k], Tk), pos, total)  # drop pads
+        out = out.at[pos].set(d, mode='drop')
+        prior = prior + lens[k].astype(jnp.int32)
+    if xs[0].is_traced:
+        return {'Out': [LoDArray.traced(out, [out_off])]}
+    return {'Out': [LoDArray(out, (np.asarray(out_off),))]}
 
 
 @register('sequence_reshape', lod='aware')
 def _sequence_reshape(ctx, ins):
-    x = ins['X'][0]
+    x = _la(ins['X'][0], 'sequence_reshape')
     new_dim = ctx.attr('new_dim')
-    off = _off(x, 0)
     d = x.data.shape[1]
     out = x.data.reshape(-1, new_dim)
-    new_off = (off * d) // new_dim
-    return {'Out': [LoDArray(out, (new_off,))]}
+    new_off = (x.off_t(0) * d) // new_dim
+    if x.is_traced:
+        return {'Out': [LoDArray.traced(out, [new_off])]}
+    return {'Out': [LoDArray(out, (np.asarray(new_off),))]}
 
 
 @register('sequence_reverse', lod='aware')
 def _sequence_reverse(ctx, ins):
-    x = ins['X'][0]
-    off = _off(x)
-    idx = np.arange(unwrap(x).shape[0], dtype=np.int32)
-    for i in range(len(off) - 1):
-        idx[off[i]:off[i + 1]] = idx[off[i]:off[i + 1]][::-1]
-    out = jnp.take(unwrap(x), jnp.asarray(idx), axis=0)
-    return {'Y': [LoDArray(out, x.lod)]}
+    x = _la(ins['X'][0], 'sequence_reverse')
+    data = unwrap(x)
+    T = data.shape[0]
+    off = x.off_t()
+    n = x.nseq_of()
+    seg = seg_ids_t(off, T)
+    segc = jnp.minimum(seg, n - 1)
+    # reversed index within the row's sequence: start + end - 1 - i
+    idx = (jnp.take(off, segc) + jnp.take(off, segc + 1) - 1
+           - jnp.arange(T, dtype=jnp.int32))
+    valid = valid_rows_t(off, T)
+    idx = jnp.where(valid, idx, jnp.arange(T, dtype=jnp.int32))
+    out = jnp.take(data, idx, axis=0)
+    return {'Y': [x.with_lod_of(out)]}
 
 
 @register('sequence_slice', lod='aware')
 def _sequence_slice(ctx, ins):
+    # output rows = sum(Length) -> content-dependent: static mode only
     x = ins['X'][0]
-    offset = np.asarray(unwrap(ins['Offset'][0]))
-    length = np.asarray(unwrap(ins['Length'][0]))
-    # Offset/Length must be trace-time constants (host numpy); the layers API
-    # passes them as fed numpy or assign_value constants.
+    offset = np.asarray(unwrap(ins['Offset'][0])).reshape(-1)
+    length = np.asarray(unwrap(ins['Length'][0])).reshape(-1)
     off = _off(x, 0)
-    idx = []
-    lens = []
-    for i in range(len(off) - 1):
-        s = int(off[i] + offset.reshape(-1)[i])
-        l = int(length.reshape(-1)[i])
-        idx.extend(range(s, s + l))
-        lens.append(l)
-    out = jnp.take(unwrap(x), jnp.asarray(idx, dtype=jnp.int32), axis=0)
-    return {'Out': [LoDArray(out, (np.concatenate([[0], np.cumsum(lens)]),))]}
+    starts = off[:-1] + offset.astype(np.int64)
+    lens = length.astype(np.int64)
+    ends_cum = np.cumsum(lens)
+    total = int(ends_cum[-1]) if len(lens) else 0
+    base = np.repeat(starts - (ends_cum - lens), lens)
+    idx = (np.arange(total, dtype=np.int64) + base).astype(np.int32)
+    out = jnp.take(unwrap(x), jnp.asarray(idx), axis=0)
+    return {'Out': [LoDArray(out, (np.concatenate([[0], ends_cum]),))]}
+
+
+# ---------------------------------------------------------------------------
+# windowed ops: gather[r, k] = r + shift_k, valid iff same sequence
+# ---------------------------------------------------------------------------
+def _window(x, shifts):
+    """Returns (cols [T, K, ...], mask [T, K]) of per-row windows clipped to
+    the row's sequence — pure offset math, mode-generic."""
+    data = unwrap(x)
+    T = data.shape[0]
+    off = x.off_t()
+    seg = seg_ids_t(off, T)
+    r = jnp.arange(T, dtype=jnp.int32)[:, None]
+    src = r + jnp.asarray(shifts, jnp.int32)[None, :]      # [T, K]
+    inb = (src >= 0) & (src < T)
+    srcc = jnp.clip(src, 0, T - 1)
+    same = jnp.take(seg, srcc) == seg[:, None]
+    mask = inb & same & valid_rows_t(off, T)[:, None]
+    cols = jnp.take(data, srcc.reshape(-1), axis=0)
+    cols = cols.reshape((T, len(shifts)) + data.shape[1:])
+    return cols, mask
 
 
 @register('sequence_enumerate', lod='aware', no_grad=True)
 def _sequence_enumerate(ctx, ins):
-    x = ins['X'][0]
+    x = _la(ins['X'][0], 'sequence_enumerate')
     win = ctx.attr('win_size')
     pad = ctx.attr('pad_value', 0)
-    off = _off(x)
-    t = unwrap(x).shape[0]
-    flat = unwrap(x).reshape(t)
-    gather = np.zeros((t, win), dtype=np.int32)
-    mask = np.zeros((t, win), dtype=bool)
-    for i in range(len(off) - 1):
-        for r in range(off[i], off[i + 1]):
-            for k in range(win):
-                if r + k < off[i + 1]:
-                    gather[r, k] = r + k
-                    mask[r, k] = True
-    out = jnp.where(jnp.asarray(mask), jnp.take(flat, jnp.asarray(gather)),
-                    jnp.asarray(pad, dtype=flat.dtype))
-    return {'Out': [LoDArray(out, x.lod)]}
+    flat_in = unwrap(x).reshape(unwrap(x).shape[0])
+    cols, mask = _window(x.with_lod_of(flat_in), list(range(win)))
+    out = jnp.where(mask, cols, jnp.asarray(pad, flat_in.dtype))
+    return {'Out': [x.with_lod_of(out)]}
+
+
+@register('sequence_conv', lod='aware')
+def _sequence_conv(ctx, ins):
+    x = _la(ins['X'][0], 'sequence_conv')
+    w = unwrap(ins['Filter'][0])  # [ctx_len * D, num_filters]
+    ctx_len = ctx.attr('contextLength')
+    ctx_start = ctx.attr('contextStart', -(ctx_len // 2) if ctx_len else 0)
+    t, d = unwrap(x).shape
+    cols, mask = _window(x, [ctx_start + k for k in range(ctx_len)])
+    cols = jnp.where(mask[:, :, None], cols, 0.0)
+    out = cols.reshape(t, ctx_len * d) @ w
+    return {'Out': [x.with_lod_of(out)]}
+
+
+@register('row_conv', lod='aware')
+def _row_conv(ctx, ins):
+    x = _la(ins['X'][0], 'row_conv')
+    w = unwrap(ins['Filter'][0])  # [future_ctx, D]
+    fut = w.shape[0]
+    cols, mask = _window(x, list(range(fut)))
+    cols = jnp.where(mask[:, :, None], cols, 0.0)
+    out = jnp.einsum('tfd,fd->td', cols, w)
+    return {'Out': [x.with_lod_of(out)]}
 
 
 @register('sequence_erase', lod='aware', no_grad=True)
 def _sequence_erase(ctx, ins):
+    # output rows = count of kept tokens -> content-dependent: static mode,
+    # and the DATA must be a trace-time constant (reference erases by value)
     x = ins['X'][0]
     tokens = set(ctx.attr('tokens', []))
-    data = np.asarray(unwrap(x))  # trace-time constant path only
+    data = np.asarray(unwrap(x))
     off = _off(x)
     keep = ~np.isin(data.reshape(-1), list(tokens))
-    lens = []
-    for i in range(len(off) - 1):
-        lens.append(int(keep[off[i]:off[i + 1]].sum()))
+    seg = segment_ids_from_offsets(off, data.shape[0])
+    lens = np.bincount(np.asarray(seg)[keep], minlength=len(off) - 1)
     out = jnp.asarray(data.reshape(-1)[keep].reshape(-1, 1))
     return {'Out': [LoDArray(out, (np.concatenate([[0], np.cumsum(lens)]),))]}
 
@@ -237,32 +295,44 @@ def _sequence_erase(ctx, ins):
 # ---------------------------------------------------------------------------
 @register('sequence_pad', lod='aware')
 def _sequence_pad(ctx, ins):
-    x = ins['X'][0]
+    x = _la(ins['X'][0], 'sequence_pad')
     pad_value = unwrap(ins['PadValue'][0])
     padded_len = ctx.attr('padded_length', -1)
-    off = _off(x, 0)
+    off = x.off_t(0)
+    n = x.nseq_of(0)
+    data = unwrap(x)
+    feat = data.shape[1:]
+    if padded_len not in (-1, None):
+        maxlen = int(padded_len)
+    elif not x.is_traced:
+        lens_np = np.asarray(x.lod[0])
+        maxlen = int((lens_np[1:] - lens_np[:-1]).max())
+    else:
+        raise TypeError(
+            "sequence_pad on traced-lod input needs a static padded_length "
+            "attr (the bucket's max length) — the default max-over-batch "
+            "is a lod VALUE, which is device data here")
     lens = off[1:] - off[:-1]
-    n = len(lens)
-    maxlen = int(lens.max()) if padded_len in (-1, None) else int(padded_len)
-    feat = unwrap(x).shape[1:]
-    gather = np.zeros((n, maxlen), dtype=np.int32)
-    mask = np.zeros((n, maxlen), dtype=bool)
-    for i in range(n):
-        l = min(int(lens[i]), maxlen)
-        gather[i, :l] = np.arange(off[i], off[i] + l)
-        mask[i, :l] = True
-    rows = jnp.take(unwrap(x), jnp.asarray(gather.reshape(-1)), axis=0)
-    rows = rows.reshape((n, maxlen) + feat)
-    m = jnp.asarray(mask).reshape((n, maxlen) + (1,) * len(feat))
-    out = jnp.where(m, rows, pad_value.astype(rows.dtype).reshape(
-        (1, 1) + pad_value.shape if pad_value.ndim else (1, 1) + (1,) * len(feat)))
-    ctx.tracer.static_lengths[ctx.op.outputs['Length'][0]] = tuple(
-        int(v) for v in lens)
-    return {'Out': [out], 'Length': [jnp.asarray(lens, dtype=INT_T())]}
+    j = jnp.arange(maxlen, dtype=jnp.int32)
+    gather = off[:-1, None] + j[None, :]                 # [n, maxlen]
+    mask = j[None, :] < lens[:, None]
+    rows = jnp.take(data, jnp.clip(gather, 0, data.shape[0] - 1).reshape(-1),
+                    axis=0).reshape((n, maxlen) + feat)
+    m = mask.reshape((n, maxlen) + (1,) * len(feat))
+    pv = pad_value.astype(rows.dtype).reshape(
+        (1, 1) + pad_value.shape if pad_value.ndim
+        else (1, 1) + (1,) * len(feat))
+    out = jnp.where(m, rows, pv)
+    if not x.is_traced:
+        lens_np = np.asarray(x.lod[0])
+        ctx.tracer.static_lengths[ctx.op.outputs['Length'][0]] = tuple(
+            int(v) for v in (lens_np[1:] - lens_np[:-1]))
+    return {'Out': [out], 'Length': [lens.astype(INT_T())]}
 
 
 @register('sequence_unpad', lod='aware')
 def _sequence_unpad(ctx, ins):
+    # output rows = sum(Length) -> content-dependent: static mode only
     x = unwrap(ins['X'][0])  # [N, L, ...]
     len_name = ctx.op.inputs['Length'][0]
     lens = ctx.tracer.static_lengths.get(len_name)
@@ -270,12 +340,9 @@ def _sequence_unpad(ctx, ins):
         lv = ins['Length'][0]
         lens_np = np.asarray(unwrap(lv))  # works only for constants
         lens = tuple(int(v) for v in lens_np.reshape(-1))
-    idx = []
-    for i, l in enumerate(lens):
-        idx.extend(range(i * x.shape[1], i * x.shape[1] + int(l)))
-    flat = x.reshape((-1,) + x.shape[2:])
-    out = jnp.take(flat, jnp.asarray(idx, dtype=jnp.int32), axis=0)
-    off = np.concatenate([[0], np.cumsum(lens)])
+    from .rnn_ops import _unpad_to_lod
+    off = np.concatenate([[0], np.cumsum(np.asarray(lens, np.int64))])
+    out = _unpad_to_lod(x, off)
     return {'Out': [LoDArray(out, (off,))]}
 
 
@@ -288,11 +355,11 @@ def _sequence_mask(ctx, ins):
     if maxlen in (-1, None):
         raise ValueError(
             "sequence_mask needs a static maxlen on TPU (pass maxlen=...)")
-    from ..framework import convert_dtype
-    dt = convert_dtype(ctx.attr('out_dtype', 'int64'))
+    from ..framework import convert_dtype, runtime_dtype
+    dt = runtime_dtype(convert_dtype(ctx.attr('out_dtype', 'int64')))
     rng = jnp.arange(maxlen, dtype=x.dtype if jnp.issubdtype(
         x.dtype, jnp.integer) else INT_T())
-    out = (rng[None, :] < x.reshape(-1)[:, None]).astype(jnp.dtype(dt))
+    out = (rng[None, :] < x.reshape(-1)[:, None]).astype(dt)
     return {'Y': [out.reshape(tuple(x.shape) + (maxlen,))]}
 
 
@@ -302,66 +369,17 @@ def _lod_reset(ctx, ins):
     data = unwrap(x)
     if ins.get('Y') and ins['Y'][0] is not None:
         y = ins['Y'][0]
-        if isinstance(y, LoDArray) and y.lod:
-            return {'Out': [LoDArray(data, y.lod)]}
+        if isinstance(y, LoDArray) and y.nlevels:
+            return {'Out': [y.with_lod_of(data)]}
         target = np.asarray(unwrap(y)).reshape(-1)
         return {'Out': [LoDArray(data, (target,))]}
     target = np.asarray(ctx.attr('target_lod'), dtype=np.int64)
     return {'Out': [LoDArray(data, (target,))]}
 
 
-# ---------------------------------------------------------------------------
-# sequence_conv / row_conv — context-window convolutions
-# ---------------------------------------------------------------------------
-@register('sequence_conv', lod='aware')
-def _sequence_conv(ctx, ins):
-    x = ins['X'][0]
-    w = unwrap(ins['Filter'][0])  # [ctx_len * D, num_filters]
-    ctx_len = ctx.attr('contextLength')
-    ctx_start = ctx.attr('contextStart', -(ctx_len // 2) if ctx_len else 0)
-    off = _off(x, 0)
-    t, d = unwrap(x).shape
-    gather = np.zeros((t, ctx_len), dtype=np.int32)
-    mask = np.zeros((t, ctx_len), dtype=bool)
-    for i in range(len(off) - 1):
-        for r in range(off[i], off[i + 1]):
-            for k in range(ctx_len):
-                src = r + ctx_start + k
-                if off[i] <= src < off[i + 1]:
-                    gather[r, k] = src
-                    mask[r, k] = True
-    cols = jnp.take(unwrap(x), jnp.asarray(gather.reshape(-1)), axis=0)
-    cols = cols.reshape(t, ctx_len, d)
-    cols = jnp.where(jnp.asarray(mask)[:, :, None], cols, 0.0)
-    out = cols.reshape(t, ctx_len * d) @ w
-    return {'Out': [LoDArray(out, x.lod)]}
-
-
-@register('row_conv', lod='aware')
-def _row_conv(ctx, ins):
-    x = ins['X'][0]
-    w = unwrap(ins['Filter'][0])  # [future_ctx, D]
-    fut = w.shape[0]
-    off = _off(x, 0)
-    t, d = unwrap(x).shape
-    gather = np.zeros((t, fut), dtype=np.int32)
-    mask = np.zeros((t, fut), dtype=bool)
-    for i in range(len(off) - 1):
-        for r in range(off[i], off[i + 1]):
-            for k in range(fut):
-                if r + k < off[i + 1]:
-                    gather[r, k] = r + k
-                    mask[r, k] = True
-    cols = jnp.take(unwrap(x), jnp.asarray(gather.reshape(-1)), axis=0)
-    cols = cols.reshape(t, fut, d)
-    cols = jnp.where(jnp.asarray(mask)[:, :, None], cols, 0.0)
-    out = jnp.einsum('tfd,fd->td', cols, w)
-    return {'Out': [LoDArray(out, x.lod)]}
-
-
 @register('im2sequence')
 def _im2sequence(ctx, ins):
-    x = X = ins['X'][0]  # [N, C, H, W]
+    x = ins['X'][0]  # [N, C, H, W]
     kernels = ctx.attr('kernels')
     strides = ctx.attr('strides', [1, 1])
     paddings = ctx.attr('paddings', [0, 0, 0, 0])
@@ -372,13 +390,12 @@ def _im2sequence(ctx, ins):
     xp = jnp.pad(x, [(0, 0), (0, 0), (ph0, ph1), (pw0, pw1)])
     oh = (h + ph0 + ph1 - kh) // strides[0] + 1
     ow = (w + pw0 + pw1 - kw) // strides[1] + 1
-    patches = []
-    for i in range(oh):
-        for j in range(ow):
-            si, sj = i * strides[0], j * strides[1]
-            patches.append(xp[:, :, si:si + kh, sj:sj + kw])
-    stacked = jnp.stack(patches, axis=1)  # [N, oh*ow, C, kh, kw]
-    out = stacked.reshape(n * oh * ow, c * kh * kw)
+    # extract all patches in one strided-window op (no python loop over
+    # output pixels): [N, C*kh*kw, oh, ow] -> rows
+    patches = jax.lax.conv_general_dilated_patches(
+        xp, (kh, kw), strides, 'VALID',
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+    out = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * kh * kw)
     off = np.arange(n + 1, dtype=np.int64) * (oh * ow)
     return {'Out': [LoDArray(out, (off,))]}
 
@@ -386,16 +403,13 @@ def _im2sequence(ctx, ins):
 @register('sequence_scatter', lod='aware')
 def _sequence_scatter(ctx, ins):
     x = unwrap(ins['X'][0])
-    ids = ins['Ids'][0]
+    ids = _la(ins['Ids'][0], 'sequence_scatter Ids')
     updates = ins['Updates'][0]
-    off = _off(ids, 0)
-    idx_np = np.asarray(unwrap(ids)).reshape(-1)
-    rows = []
-    for i in range(len(off) - 1):
-        rows.extend([i] * int(off[i + 1] - off[i]))
-    out = x.at[(jnp.asarray(np.asarray(rows, np.int32)),
-                jnp.asarray(idx_np.astype(np.int32)))].add(
-        unwrap(updates).reshape(-1))
+    T = unwrap(ids).shape[0]
+    rows = seg_ids_t(ids.off_t(0), T)
+    cols = unwrap(ids).reshape(-1).astype(jnp.int32)
+    rows = jnp.where(valid_rows_t(ids.off_t(0), T), rows, x.shape[0])
+    out = x.at[(rows, cols)].add(unwrap(updates).reshape(-1), mode='drop')
     return {'Out': [out]}
 
 

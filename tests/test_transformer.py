@@ -7,13 +7,14 @@ import os
 import sys
 
 import numpy as np
+import pytest
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import paddle_tpu as fluid
 
 
-def test_tiny_transformer_trains():
+def _train_tiny(bf16):
     from models.transformer import build_transformer_train
     main_p, startup_p = fluid.Program(), fluid.Program()
     main_p.random_seed = startup_p.random_seed = 5
@@ -22,6 +23,8 @@ def test_tiny_transformer_trains():
             src_vocab=300, trg_vocab=300, max_len=12, d_model=32, d_ff=64,
             n_head=2, n_layer=1, dropout=0.0, lr=0.002)
     assert fpt > 0
+    if bf16:
+        fluid.contrib.mixed_precision.enable_bf16(main_p)
     exe = fluid.Executor(fluid.CPUPlace())
     scope = fluid.core.Scope()
     rng = np.random.RandomState(0)
@@ -34,31 +37,12 @@ def test_tiny_transformer_trains():
         for _ in range(12):
             l, = exe.run(main_p, feed=feed, fetch_list=[loss])
             losses.append(float(l[0]))
+    return losses
+
+
+@pytest.mark.parametrize('bf16', [False, True])
+def test_tiny_transformer_trains(bf16):
+    losses = _train_tiny(bf16)
     assert np.isfinite(losses).all()
     # memorizing a fixed batch: loss must drop well below ln(300) ~ 5.7
-    assert losses[-1] < losses[0] - 0.5
-
-
-def test_transformer_bf16_trains():
-    from models.transformer import build_transformer_train
-    main_p, startup_p = fluid.Program(), fluid.Program()
-    main_p.random_seed = startup_p.random_seed = 5
-    with fluid.program_guard(main_p, startup_p):
-        feeds, loss, _ = build_transformer_train(
-            src_vocab=300, trg_vocab=300, max_len=12, d_model=32, d_ff=64,
-            n_head=2, n_layer=1, dropout=0.0, lr=0.002)
-    fluid.contrib.mixed_precision.enable_bf16(main_p)
-    exe = fluid.Executor(fluid.CPUPlace())
-    scope = fluid.core.Scope()
-    rng = np.random.RandomState(0)
-    feed = {'src_ids': rng.randint(1, 300, (8, 12)),
-            'trg_ids': rng.randint(1, 300, (8, 12)),
-            'lbl_ids': rng.randint(1, 300, (8, 12))}
-    with fluid.scope_guard(scope):
-        exe.run(startup_p)
-        losses = []
-        for _ in range(12):
-            l, = exe.run(main_p, feed=feed, fetch_list=[loss])
-            losses.append(float(l[0]))
-    assert np.isfinite(losses).all()
     assert losses[-1] < losses[0] - 0.5
