@@ -1,0 +1,88 @@
+"""BERT-style encoder pretraining model (masked LM) on paddle_tpu layers.
+
+The ERNIE/BERT-base north star (BASELINE.md): 12-layer post-LN Transformer
+encoder, learned token/position/segment embeddings, MLM head tied math
+(dense -> layer_norm -> vocab projection). Reuses the transformer building
+blocks (models/transformer.py); scale out with ParallelExecutor/
+CompiledProgram over a dp x mp mesh + contrib.gradient_merge for the global
+batch.
+"""
+from __future__ import annotations
+
+import paddle_tpu as fluid
+
+from models.transformer import encoder_layer
+
+
+def build_bert_pretrain(vocab=30522, max_len=128, d_model=768, d_ff=3072,
+                        n_head=12, n_layer=12, type_vocab=2, dropout=0.1,
+                        lr=1e-4):
+    """Returns (feeds, avg_mlm_loss). feeds = [(name, shape, dtype)]."""
+    S = max_len
+    tok = fluid.layers.data(name='tok_ids', shape=[S], dtype='int64')
+    seg = fluid.layers.data(name='seg_ids', shape=[S], dtype='int64')
+    mlm_lbl = fluid.layers.data(name='mlm_labels', shape=[S], dtype='int64')
+    mlm_w = fluid.layers.data(name='mlm_weights', shape=[S], dtype='float32')
+
+    def emb(ids, size, name):
+        e = fluid.layers.embedding(
+            ids, size=size,
+            param_attr=fluid.ParamAttr(
+                name=name,
+                initializer=fluid.initializer.Normal(0., 0.02)))
+        return fluid.layers.reshape(e, shape=[-1, S, size[1]])
+
+    pos_ids = fluid.layers.reshape(
+        fluid.layers.range(0, S, 1, 'int64'), shape=[S, 1])
+    x = emb(tok, [vocab, d_model], 'word_emb') \
+        + emb(seg, [type_vocab, d_model], 'sent_emb')
+    pos = fluid.layers.embedding(
+        pos_ids, size=[S, d_model],
+        param_attr=fluid.ParamAttr(
+            name='pos_emb', initializer=fluid.initializer.Normal(0., 0.02)))
+    x = x + fluid.layers.reshape(pos, shape=[1, S, d_model])
+    x = fluid.layers.layer_norm(x, begin_norm_axis=2)
+    if dropout:
+        x = fluid.layers.dropout(x, dropout_prob=dropout,
+                                 dropout_implementation='upscale_in_train')
+
+    for _ in range(n_layer):
+        x = encoder_layer(x, n_head, d_model, d_ff, S, dropout)
+
+    # MLM head: transform + vocab projection
+    h = fluid.layers.fc(x, size=d_model, num_flatten_dims=2, act='relu')
+    h = fluid.layers.layer_norm(h, begin_norm_axis=2)
+    logits = fluid.layers.fc(h, size=vocab, num_flatten_dims=2,
+                             bias_attr=False)
+    logits2d = fluid.layers.reshape(logits, shape=[-1, vocab])
+    lbl2d = fluid.layers.reshape(mlm_lbl, shape=[-1, 1])
+    loss = fluid.layers.softmax_with_cross_entropy(logits=logits2d,
+                                                   label=lbl2d)
+    w = fluid.layers.reshape(mlm_w, shape=[-1, 1])
+    # masked mean: only the masked positions contribute
+    avg_loss = fluid.layers.reduce_sum(loss * w) / (
+        fluid.layers.reduce_sum(w) + 1e-6)
+    fluid.optimizer.Adam(learning_rate=lr).minimize(avg_loss)
+
+    feeds = [('tok_ids', (S,), 'int64'), ('seg_ids', (S,), 'int64'),
+             ('mlm_labels', (S,), 'int64'), ('mlm_weights', (S,), 'float32')]
+    return feeds, avg_loss
+
+
+def shard_for_mesh(program, mp_axis='mp'):
+    """Megatron-style TP annotations for the encoder weights: qkv/ffn-in
+    column-parallel, output/ffn-out row-parallel, embeddings row-sharded —
+    the GSPMD equivalent of the reference's dist-lookup-table + per-layer
+    model parallelism."""
+    from paddle_tpu.parallel import shard_parameter
+    for p in program.global_block().all_parameters():
+        if len(p.shape) != 2:
+            continue
+        rows, cols = p.shape
+        if p.name in ('word_emb',):
+            shard_parameter(p, (mp_axis, None))
+        elif cols > rows:     # expanding matmuls: column-parallel
+            shard_parameter(p, (None, mp_axis))
+        elif rows > cols:     # contracting matmuls: row-parallel
+            shard_parameter(p, (mp_axis, None))
+    return program

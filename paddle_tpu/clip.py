@@ -21,7 +21,7 @@ class ErrorClipByValue(BaseErrorClipAttr):
         block.append_op(type='clip', inputs={'X': [grad_name]},
                         outputs={'Out': [grad_name]},
                         attrs={'min': self.min, 'max': self.max,
-                               'op_role': OP_ROLE_BACKWARD}, infer_shape=False)
+                               'op_role': OP_ROLE_BACKWARD, '_grad_transform': True}, infer_shape=False)
 
 
 def error_clip_callback(block, context):
@@ -54,7 +54,7 @@ class GradientClipByValue(BaseGradientClipAttr):
         block.append_op(type='clip', inputs={'X': [grad.name]},
                         outputs={'Out': [out.name]},
                         attrs={'min': self.min, 'max': self.max,
-                               'op_role': OP_ROLE_BACKWARD}, infer_shape=False)
+                               'op_role': OP_ROLE_BACKWARD, '_grad_transform': True}, infer_shape=False)
         return param, out
 
 
@@ -69,7 +69,7 @@ class GradientClipByNorm(BaseGradientClipAttr):
         block.append_op(type='clip_by_norm', inputs={'X': [grad.name]},
                         outputs={'Out': [out.name]},
                         attrs={'max_norm': self.clip_norm,
-                               'op_role': OP_ROLE_BACKWARD}, infer_shape=False)
+                               'op_role': OP_ROLE_BACKWARD, '_grad_transform': True}, infer_shape=False)
         return param, out
 
 
@@ -88,7 +88,7 @@ class GradientClipByGlobalNorm(BaseGradientClipAttr):
         sq = block.create_var(dtype=grad.dtype, shape=())
         block.append_op(type='squared_l2_norm', inputs={'X': [grad.name]},
                         outputs={'Out': [sq.name]},
-                        attrs={'op_role': OP_ROLE_BACKWARD}, infer_shape=False)
+                        attrs={'op_role': OP_ROLE_BACKWARD, '_grad_transform': True}, infer_shape=False)
         context[self.group_name].append(sq)
         self.context = context
 
@@ -100,12 +100,12 @@ class GradientClipByGlobalNorm(BaseGradientClipAttr):
             gsum = block.create_var(dtype=grad.dtype, shape=())
             block.append_op(type='sum', inputs={'X': [v.name for v in group]},
                             outputs={'Out': [gsum.name]},
-                            attrs={'op_role': OP_ROLE_BACKWARD},
+                            attrs={'op_role': OP_ROLE_BACKWARD, '_grad_transform': True},
                             infer_shape=False)
             gnorm = block.create_var(dtype=grad.dtype, shape=())
             block.append_op(type='sqrt', inputs={'X': [gsum.name]},
                             outputs={'Out': [gnorm.name]},
-                            attrs={'op_role': OP_ROLE_BACKWARD},
+                            attrs={'op_role': OP_ROLE_BACKWARD, '_grad_transform': True},
                             infer_shape=False)
             scale = block.create_var(dtype=grad.dtype, shape=(),
                                      name=unique_name.generate(
@@ -114,7 +114,7 @@ class GradientClipByGlobalNorm(BaseGradientClipAttr):
                             inputs={'Norm': [gnorm.name]},
                             outputs={'Out': [scale.name]},
                             attrs={'clip_norm': self.clip_norm,
-                                   'op_role': OP_ROLE_BACKWARD},
+                                   'op_role': OP_ROLE_BACKWARD, '_grad_transform': True},
                             infer_shape=False)
             self.context[scale_key] = scale.name
         out = block.create_var(dtype=grad.dtype, shape=grad.shape,
@@ -123,7 +123,7 @@ class GradientClipByGlobalNorm(BaseGradientClipAttr):
             type='elementwise_mul',
             inputs={'X': [grad.name], 'Y': [self.context[scale_key]]},
             outputs={'Out': [out.name]},
-            attrs={'axis': -1, 'op_role': OP_ROLE_BACKWARD}, infer_shape=False)
+            attrs={'axis': -1, 'op_role': OP_ROLE_BACKWARD, '_grad_transform': True}, infer_shape=False)
         return param, out
 
 

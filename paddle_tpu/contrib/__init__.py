@@ -8,7 +8,9 @@ Shipped submodules:
   - op_frequence: op histogram over a Program (ref: contrib/op_frequence.py).
 """
 from . import mixed_precision
+from . import gradient_merge
 from .memory_usage_calc import memory_usage
 from .op_frequence import op_freq_statistic
 
-__all__ = ['mixed_precision', 'memory_usage', 'op_freq_statistic']
+__all__ = ['mixed_precision', 'gradient_merge', 'memory_usage',
+           'op_freq_statistic']

@@ -1,0 +1,47 @@
+"""Gradient merge / batch accumulation
+(ref: framework/ir/multi_batch_merge_pass.cc, used by
+dist_mnist_batch_merge): train with an effective batch k x larger than what
+fits per step by accumulating k microbatch gradients before one optimizer
+update.
+
+TPU-native mechanism: the Executor slices the fed batch into k microbatches
+and runs the forward+backward cone inside a lax.scan with (1/k)-scaled grad
+accumulation, then applies the optimizer once (executor._ga_step). The
+merged gradient equals the mean-loss gradient of the one big batch, so
+`decorate(opt, k)` training matches big-batch training step for step.
+"""
+from __future__ import annotations
+
+from ..framework import default_main_program
+
+
+class GradientMergeOptimizer(object):
+    """Wraps an optimizer; minimize() marks the program for k-way
+    microbatch accumulation."""
+
+    def __init__(self, optimizer, k_steps):
+        if int(k_steps) < 1:
+            raise ValueError("k_steps must be >= 1, got %r" % (k_steps,))
+        self._optimizer = optimizer
+        self._k = int(k_steps)
+
+    def __getattr__(self, name):
+        return getattr(self._optimizer, name)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        loss.block.program._grad_accum_k = self._k
+        return self._optimizer.minimize(
+            loss, startup_program=startup_program,
+            parameter_list=parameter_list, no_grad_set=no_grad_set)
+
+
+def decorate(optimizer, k_steps):
+    return GradientMergeOptimizer(optimizer, k_steps)
+
+
+def enable(k_steps, program=None):
+    """Mark an already-built program for k-way gradient merge."""
+    program = program if program is not None else default_main_program()
+    program._grad_accum_k = int(k_steps)
+    return program

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 from . import framework
 from .framework import Program, Variable, default_main_program, _place_backend
 from .core.scope import Scope, global_scope, scope_guard  # re-export
-from .core.lowering import Tracer
+from .core.lowering import Tracer, TraceError
 from .core.lod import LoDArray, unwrap
 from .core import amp
 
@@ -43,12 +43,24 @@ _entropy_seed = None
 
 
 def _process_entropy():
-    """Per-process random seed root, drawn once (used when a program has no
-    random_seed and FLAGS deterministic is off)."""
+    """Random seed root drawn once per JOB (used when a program has no
+    random_seed and FLAGS deterministic is off). Under multi-host, every
+    process must share the root — the SPMD program's replicated values are
+    only replicated if every host computes them from the same seed — so
+    process 0's draw is broadcast."""
     global _entropy_seed
     if _entropy_seed is None:
         import os as _os
-        _entropy_seed = int.from_bytes(_os.urandom(4), 'little') or 1
+        seed = int.from_bytes(_os.urandom(4), 'little') or 1
+        try:
+            nproc = jax.process_count()
+        except RuntimeError:
+            nproc = 1
+        if nproc > 1:
+            from jax.experimental import multihost_utils
+            seed = int(np.asarray(multihost_utils.broadcast_one_to_all(
+                np.uint32(seed))))
+        _entropy_seed = seed or 1
     return _entropy_seed
 
 
@@ -78,7 +90,10 @@ class Executor(object):
         self._device = None
         if backend is not None:
             try:
-                self._device = jax.devices(backend)[0]
+                # local_devices: under multi-host, devices() is the GLOBAL
+                # list and entry 0 belongs to process 0 — single-device
+                # executor work must stay on a device THIS process owns
+                self._device = jax.local_devices(backend=backend)[0]
             except RuntimeError:
                 self._device = None
         self._cache = {}
@@ -151,7 +166,10 @@ class Executor(object):
                 else _process_entropy()
         with jax.default_device(self._device) if self._device is not None \
                 else _nullcontext():
-            rng = jax.random.fold_in(jax.random.key(seed), step)
+            # carried as RAW key data (uint32) so multi-host placement can
+            # treat it like any other array; step() re-wraps it
+            rng = jax.random.key_data(
+                jax.random.fold_in(jax.random.key(seed), step))
 
         if _config.get_flag('check_nan_inf'):
             # reference FLAGS_check_nan_inf scans every op output
@@ -222,17 +240,170 @@ class Executor(object):
                 tuple((n, self._sig(v)) for n, v in sorted(feed_vals.items())),
                 tuple(fetch_names),
                 tuple((n, self._sig(v)) for n, v in sorted(state.items())),
-                out_names, bool(getattr(program, '_amp_bf16', False)))
+                out_names, bool(getattr(program, '_amp_bf16', False)),
+                int(getattr(program, '_grad_accum_k', 1) or 1))
+
+    @staticmethod
+    def _ga_partition(program, fetch_names):
+        """Split the block for gradient merge (ref multi_batch_merge_pass).
+
+        The scan cone — repeated per microbatch inside lax.scan — is the
+        ancestor set of the RAW gradients. Optimize-role ops and tagged
+        grad-transform ops (gradient clip / weight decay, clip.py /
+        regularizer.py `_grad_transform`) are excluded from the cone, so
+        clipping/decay applies ONCE to the merged gradient, matching the
+        reference pass (accumulate raw grads, transform once). Outer ops
+        are pruned to those reachable from fetches/persistables (a metric
+        op nobody fetches must not drag scan intermediates out)."""
+        from .backward import OP_ROLE_OPTIMIZE, OP_ROLE_BACKWARD
+        ops = list(program.global_block().ops)
+        excl = {i for i, op in enumerate(ops)
+                if int(op.attrs.get('op_role', 0)) == OP_ROLE_OPTIMIZE
+                or op.attrs.get('_grad_transform')}
+        # the cone's roots are the RAW GRADIENTS: excluded-op inputs that a
+        # backward-role non-excluded op produces. Params/moments (state) and
+        # the LR schedule (forward-role) must NOT seed the cone — pulling
+        # the LR counter chain into the scan would tick it k times per step
+        bwd_out = {o for i, op in enumerate(ops) if i not in excl
+                   and int(op.attrs.get('op_role', 0)) & OP_ROLE_BACKWARD
+                   for o in op.output_arg_names() if o}
+        seed = {n for i in excl for n in ops[i].input_arg_names()
+                if n in bwd_out}
+        needed = set(seed)
+        scan_set = set()
+        for i in range(len(ops) - 1, -1, -1):
+            if i in excl or ops[i].type == 'feed':
+                continue
+            if any(o in needed for o in ops[i].output_arg_names()):
+                scan_set.add(i)
+                needed |= {n for n in ops[i].input_arg_names() if n}
+        scan_idx = sorted(scan_set)
+        scan_outs = {n for i in scan_idx
+                     for n in ops[i].output_arg_names() if n}
+        persist = {v.name for v in program.list_vars() if v.persistable}
+        # prune outer ops: keep excluded (clip/decay/optimize) ops plus any
+        # op reachable backward from fetches / persistable writes
+        keep_out = set(fetch_names) | persist
+        outer_set = set()
+        for i in range(len(ops) - 1, -1, -1):
+            if i in scan_set or ops[i].type == 'feed':
+                continue
+            if i in excl or any(o in keep_out
+                                for o in ops[i].output_arg_names()):
+                outer_set.add(i)
+                keep_out |= {n for n in ops[i].input_arg_names() if n}
+        outer_idx = sorted(outer_set)
+        # everything the outer phase consumes from the scan is accumulated
+        outer_reads = {n for i in outer_idx
+                       for n in ops[i].input_arg_names() if n}
+        carried = sorted((outer_reads | set(fetch_names)) & scan_outs)
+        return ops, scan_idx, outer_idx, carried, scan_outs
+
+    def _ga_step(self, program, state, feed, rng, k, ops, scan_idx,
+                 outer_idx, carried, persist_scan, fetch_names,
+                 out_state_names):
+        """Gradient merge (ref framework/ir/multi_batch_merge_pass.cc, SURVEY
+        maps it to lax.scan microbatching): slice the fed batch into k
+        microbatches, scan the raw-gradient cone accumulating (1/k)-scaled
+        values (so the merged grad equals the one big batch's mean-loss
+        grad), then run the outer ops — gradient clip/decay, LR schedule,
+        optimizer — once on the merged values."""
+        block = program.global_block()
+        for n, v in feed.items():
+            if isinstance(v, LoDArray):
+                raise TypeError("gradient merge does not support LoD feeds "
+                                "(pad/bucket first): %r" % n)
+            if v.shape[0] % k:
+                raise ValueError(
+                    "gradient merge: batch %d of feed %r is not divisible "
+                    "by num_microbatches=%d" % (v.shape[0], n, k))
+        sliced = {n: v.reshape((k, v.shape[0] // k) + v.shape[1:])
+                  for n, v in feed.items()}
+        pers0 = {n: state[n] for n in persist_scan if n in state}
+        outer_reads = {n for i in outer_idx
+                       for n in ops[i].input_arg_names() if n}
+
+        def micro(mb_feed, mb_rng, pers):
+            tracer = Tracer(program, mb_rng)
+            tracer.env.update(state)
+            tracer.env.update(pers)
+            tracer.env.update(mb_feed)
+            for i in scan_idx:
+                tracer.run_op(ops[i], block)
+            env = tracer.env
+            acc = {n: env[n] for n in carried}
+            new_pers = {n: env[n] for n in pers}
+            return acc, new_pers
+
+        mb0 = {n: v[0] for n, v in sliced.items()}
+        a_sh, _ = jax.eval_shape(micro, mb0, rng, pers0)
+        for n, s in a_sh.items():
+            if not jnp.issubdtype(s.dtype, jnp.floating):
+                raise TraceError(
+                    "gradient merge cannot carry %r (dtype %s) out of the "
+                    "microbatch scan: only float values average across "
+                    "microbatches. Fetch the loss or a persistable instead."
+                    % (n, s.dtype))
+            if n in fetch_names and n not in outer_reads \
+                    and int(np.prod(s.shape)) != 1:
+                raise TraceError(
+                    "fetch %r has per-microbatch shape %s under gradient "
+                    "merge; only scalar (loss-like) fetches are "
+                    "well-defined — per-example outputs of a microbatch "
+                    "scan would silently average. Fetch the loss, or run "
+                    "without gradient merge." % (n, tuple(s.shape)))
+        zeros = {n: jnp.zeros(s.shape, s.dtype) for n, s in a_sh.items()}
+
+        def body(carry, xs):
+            acc, pers = carry
+            mb, i = xs
+            a, pers = micro(mb, jax.random.fold_in(rng, i), pers)
+            acc = jax.tree.map(lambda x, y: x + y / k, acc, a)
+            return (acc, pers), None
+
+        (acc, pers), _ = jax.lax.scan(body, (zeros, pers0),
+                                      (sliced, jnp.arange(k)))
+
+        tracer = Tracer(program, rng)
+        tracer.env.update(state)
+        tracer.env.update(acc)
+        tracer.env.update(pers)
+        for i in outer_idx:
+            tracer.run_op(ops[i], block)
+        env = tracer.env
+        missing = [n for n in fetch_names if n not in env]
+        if missing:
+            raise TraceError(
+                "fetch %r is computed inside the gradient-merge microbatch "
+                "scan and is not a carried output; fetch the loss or a "
+                "persistable instead" % (missing,))
+        fetches = [env[n] for n in fetch_names]
+        new_state = {n: env[n] for n in out_state_names if n in env}
+        return fetches, new_state
 
     def _build(self, program, feed_names, fetch_names, state_names,
                out_state_names, mesh=None, feed_vals=None):
         amp_on = bool(getattr(program, '_amp_bf16', False))
+        k = int(getattr(program, '_grad_accum_k', 1) or 1)
 
-        def step(state, feed, rng):
+        if k > 1:
+            (ga_ops, ga_scan, ga_outer, ga_carried,
+             ga_scan_outs) = self._ga_partition(program, fetch_names)
+            persist_all = set(_program_analysis(program)[0])
+            ga_persist = sorted(persist_all & ga_scan_outs)
+            ga_carried = [n for n in ga_carried if n not in ga_persist]
+
+        def step(state, feed, rng_raw):
+            rng = jax.random.wrap_key_data(rng_raw)
             # amp scope is a trace-time flag: the body below runs exactly
             # once per compile, so the context governs which lowering the
             # matmul/conv ops pick (core/amp.py), not per-step state
             with amp.scope(amp_on):
+                if k > 1:
+                    return self._ga_step(program, state, feed, rng, k,
+                                         ga_ops, ga_scan, ga_outer,
+                                         ga_carried, ga_persist, fetch_names,
+                                         out_state_names)
                 tracer = Tracer(program, rng)
                 tracer.env.update(state)
                 tracer.env.update(feed)
@@ -292,11 +463,20 @@ class Executor(object):
             else:
                 state_shardings[n] = rep
 
+        from .parallel import multihost
+        multi = multihost.mesh_spans_processes(mesh)
+        nproc = len({d.process_index
+                     for d in np.asarray(mesh.devices).reshape(-1)})
+
         def feed_spec(name):
             v = feed_vals.get(name)
             arr = unwrap(v) if v is not None else None
-            if (arr is not None and getattr(arr, 'ndim', 0) >= 1
-                    and arr.shape[0] % ndp == 0 and arr.shape[0] > 0):
+            # each process feeds its LOCAL shard: the global batch dim is
+            # local_rows x nproc when the mesh spans hosts
+            rows = (arr.shape[0] * (nproc if multi else 1)
+                    if arr is not None and getattr(arr, 'ndim', 0) >= 1
+                    else 0)
+            if rows > 0 and rows % ndp == 0:
                 if isinstance(v, LoDArray):
                     return None  # lod arrays: replicate (offsets are global)
                 return batch_sharded(mesh, arr.ndim)
@@ -305,14 +485,39 @@ class Executor(object):
         feed_specs = {n: feed_spec(n) or rep for n in feed_names}
         jitted = jax.jit(step, donate_argnums=(0,))
 
+        def _place_feed(n, v):
+            spec = feed_specs[n]
+            if multi and spec is not rep and not isinstance(v, LoDArray):
+                # each trainer holds its LOCAL batch shard; assemble the
+                # global batch-sharded array (test_dist_base semantics —
+                # every process feeds its own slice)
+                return multihost.place_local_shard(spec, np.asarray(v),
+                                                   nproc)
+            return _mesh_put(v, spec)
+
+        def _mesh_put_leaf(v, sharding):
+            if isinstance(v, jax.Array) and not v.is_fully_addressable:
+                return v  # already global (previous step's output)
+            host = np.asarray(v)
+            return jax.make_array_from_callback(
+                host.shape, sharding, lambda idx: host[idx])
+
+        def _mesh_put(v, sharding):
+            # device_put cannot target non-addressable shardings: under
+            # multi-host, build the global array from each process's
+            # (identical) host copy instead. tree_map handles LoDArray and
+            # other pytree values leaf-wise.
+            if multi:
+                return jax.tree.map(lambda x: _mesh_put_leaf(x, sharding), v)
+            return jax.device_put(v, sharding)
+
         def run_with_mesh(state, feed, rng):
             # place inputs on the mesh (resharding no-op when already there);
             # jit compiles to the arg shardings, GSPMD does the rest
-            state = {n: jax.device_put(v, state_shardings.get(n, rep))
+            state = {n: _mesh_put(v, state_shardings.get(n, rep))
                      for n, v in state.items()}
-            feed = {n: jax.device_put(v, feed_specs[n])
-                    for n, v in feed.items()}
-            rng = jax.device_put(rng, rep)
+            feed = {n: _place_feed(n, v) for n, v in feed.items()}
+            rng = _mesh_put(rng, rep)
             with mesh:
                 return jitted(state, feed, rng)
         return run_with_mesh

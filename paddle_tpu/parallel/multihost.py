@@ -1,0 +1,88 @@
+"""Multi-host (pod / multi-node) wiring.
+
+The reference's multi-node story is an id-rendezvous + NCCL communicator
+per rank (gen_nccl_id_op.cc:31, platform/nccl_helper.h:130, nranks =
+num_trainers x ndev, parallel_executor.cc:203) or gRPC parameter servers.
+TPU-native replacement: `jax.distributed.initialize` joins every host into
+ONE runtime; jax.devices() then spans the pod, a Mesh built over them spans
+hosts, and the SAME SPMD program runs everywhere — GSPMD collectives ride
+ICI within a slice and DCN across hosts. No id exchange, no pserver role.
+
+Cluster env contract follows the reference's
+(transpiler/distribute_transpiler.py:222 nccl2 mode / test_dist_base.py):
+  PADDLE_TRAINERS            number of processes (trainer count)
+  PADDLE_TRAINER_ID          this process's rank
+  PADDLE_TRAINER_ENDPOINTS   comma list host:port; entry 0 is the
+                             coordinator (or set PADDLE_COORDINATOR)
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import jax
+
+_initialized = {'done': False}
+
+
+def init_distributed(coordinator_address=None, num_trainers=None,
+                     trainer_id=None, platform=None):
+    """Join this process into the multi-host runtime. No-op for a single
+    trainer. Call before any other jax use (backends must not be
+    initialized yet). Returns (num_trainers, trainer_id)."""
+    if num_trainers is None:
+        num_trainers = int(os.environ.get('PADDLE_TRAINERS', '1'))
+    if trainer_id is None:
+        trainer_id = int(os.environ.get('PADDLE_TRAINER_ID', '0'))
+    if coordinator_address is None:
+        coordinator_address = os.environ.get('PADDLE_COORDINATOR')
+    if coordinator_address is None:
+        eps = os.environ.get('PADDLE_TRAINER_ENDPOINTS', '')
+        if eps:
+            coordinator_address = eps.split(',')[0]
+    if num_trainers <= 1:
+        return 1, 0
+    if coordinator_address is None:
+        raise ValueError(
+            "multi-host init needs a coordinator: set PADDLE_COORDINATOR or "
+            "PADDLE_TRAINER_ENDPOINTS (first endpoint is the coordinator)")
+    if platform is not None:
+        # pin the platform BEFORE backend init (e.g. 'cpu' for the
+        # simulated-pod tests; on a real pod the TPU platform is default)
+        jax.config.update('jax_platforms', platform)
+    if not _initialized['done']:
+        jax.distributed.initialize(coordinator_address,
+                                   num_processes=num_trainers,
+                                   process_id=trainer_id)
+        _initialized['done'] = True
+    return num_trainers, trainer_id
+
+
+def process_count():
+    try:
+        return jax.process_count()
+    except RuntimeError:
+        return 1
+
+
+def process_index():
+    try:
+        return jax.process_index()
+    except RuntimeError:
+        return 0
+
+
+def mesh_spans_processes(mesh):
+    devs = np.asarray(mesh.devices).reshape(-1)
+    return len({d.process_index for d in devs}) > 1
+
+
+def place_local_shard(sharding, local_np, n_processes):
+    """Assemble a GLOBAL array from this process's local batch shard
+    (the TPU equivalent of each trainer feeding its own data shard,
+    test_dist_base methodology). The global leading dim is
+    local_rows x n_processes; sharded dims must divide accordingly."""
+    local_np = np.asarray(local_np)
+    global_shape = (local_np.shape[0] * n_processes,) + local_np.shape[1:]
+    return jax.make_array_from_process_local_data(sharding, local_np,
+                                                  global_shape=global_shape)

@@ -20,7 +20,7 @@ class L2DecayRegularizer(WeightDecayRegularizer):
         block.append_op(type='scale', inputs={"X": [param.name]},
                         outputs={"Out": [decay.name]},
                         attrs={"scale": self._regularization_coeff,
-                               'op_role': OP_ROLE_BACKWARD},
+                               'op_role': OP_ROLE_BACKWARD, '_grad_transform': True},
                         infer_shape=False)
         return decay
 
@@ -34,11 +34,11 @@ class L1DecayRegularizer(WeightDecayRegularizer):
         decay = block.create_var(dtype=param.dtype, shape=param.shape)
         block.append_op(type='sign', inputs={"X": [param.name]},
                         outputs={"Out": [sign.name]},
-                        attrs={'op_role': OP_ROLE_BACKWARD}, infer_shape=False)
+                        attrs={'op_role': OP_ROLE_BACKWARD, '_grad_transform': True}, infer_shape=False)
         block.append_op(type='scale', inputs={"X": [sign.name]},
                         outputs={"Out": [decay.name]},
                         attrs={"scale": self._regularization_coeff,
-                               'op_role': OP_ROLE_BACKWARD},
+                               'op_role': OP_ROLE_BACKWARD, '_grad_transform': True},
                         infer_shape=False)
         return decay
 
@@ -65,7 +65,7 @@ def append_regularization_ops(parameters_and_grads, regularization=None):
         block.append_op(type='sum',
                         inputs={"X": [grad.name, regularization_term.name]},
                         outputs={"Out": [new_grad.name]},
-                        attrs={'op_role': OP_ROLE_BACKWARD}, infer_shape=False)
+                        attrs={'op_role': OP_ROLE_BACKWARD, '_grad_transform': True}, infer_shape=False)
         params_and_grads.append((param, new_grad))
     return params_and_grads
 

@@ -1,0 +1,145 @@
+"""Gradient merge (contrib.gradient_merge): k microbatches == 1 big batch.
+
+Parity methodology follows the reference's dist_mnist_batch_merge test
+(multi_batch_merge_pass): the merged-gradient run must track the big-batch
+run step for step.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _train(k, steps=6, seed=17, fetch_acc=False):
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup_p.random_seed = seed
+    with fluid.program_guard(main_p, startup_p):
+        x = fluid.layers.data(name='x', shape=[16], dtype='float32')
+        lab = fluid.layers.data(name='lab', shape=[1], dtype='int64')
+        h = fluid.layers.fc(x, size=32, act='relu')
+        logits = fluid.layers.fc(h, size=5)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            logits=logits, label=lab))
+        opt = fluid.optimizer.Momentum(
+            learning_rate=fluid.layers.exponential_decay(0.1, 10, 0.9),
+            momentum=0.9)
+        if k > 1:
+            opt = fluid.contrib.gradient_merge.decorate(opt, k)
+        opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    rng = np.random.RandomState(3)
+    xs = rng.randn(32, 16).astype(np.float32)
+    labs = rng.randint(0, 5, (32, 1))
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        for _ in range(steps):
+            l, = exe.run(main_p, feed={'x': xs, 'lab': labs},
+                         fetch_list=[loss])
+            losses.append(float(np.asarray(l).reshape(-1)[0]))
+        counter = scope.get('@LR_DECAY_COUNTER@')
+    return losses, int(np.asarray(counter).reshape(-1)[0])
+
+
+def test_k_microbatches_match_big_batch():
+    base, c1 = _train(1)
+    merged, c4 = _train(4)
+    # same data, same lr schedule: trajectories must match (fp32, no BN)
+    np.testing.assert_allclose(base, merged, rtol=1e-4, atol=1e-5)
+    assert base[-1] < base[0]
+    # LR counter increments once per STEP, not once per microbatch
+    assert c1 == c4
+
+
+def test_merge_with_clip_and_metric_matches_big_batch():
+    """Gradient clip must apply ONCE to the merged grad (mean(clip(g_i)) !=
+    clip(mean(g_i)) would diverge), and an unfetched metric op in the block
+    must not break the partition."""
+    def run(k):
+        main_p, startup_p = fluid.Program(), fluid.Program()
+        main_p.random_seed = startup_p.random_seed = 23
+        with fluid.program_guard(main_p, startup_p):
+            x = fluid.layers.data(name='x', shape=[16], dtype='float32')
+            lab = fluid.layers.data(name='lab', shape=[1], dtype='int64')
+            logits = fluid.layers.fc(fluid.layers.fc(x, 32, act='relu'), 5)
+            sm = fluid.layers.softmax(logits)
+            _acc = fluid.layers.accuracy(input=sm, label=lab)  # never fetched
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits=logits,
+                                                        label=lab))
+            fluid.set_gradient_clip(fluid.GradientClipByGlobalNorm(0.01))
+            opt = fluid.optimizer.SGD(learning_rate=0.5)
+            if k > 1:
+                opt = fluid.contrib.gradient_merge.decorate(opt, k)
+            opt.minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.core.Scope()
+        rng = np.random.RandomState(8)
+        xs = rng.randn(32, 16).astype(np.float32)
+        # strong per-microbatch signal so per-microbatch clipping WOULD
+        # change the trajectory if it (incorrectly) ran inside the scan
+        xs[:8] *= 10.0
+        labs = rng.randint(0, 5, (32, 1))
+        out = []
+        with fluid.scope_guard(scope):
+            exe.run(startup_p)
+            for _ in range(5):
+                l, = exe.run(main_p, feed={'x': xs, 'lab': labs},
+                             fetch_list=[loss])
+                out.append(float(np.asarray(l).reshape(-1)[0]))
+        return out
+
+    np.testing.assert_allclose(run(1), run(4), rtol=1e-4, atol=1e-5)
+
+
+def test_batch_not_divisible_raises():
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup_p):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(
+            fluid.layers.fc(x, size=1), y))
+        fluid.contrib.gradient_merge.decorate(
+            fluid.optimizer.SGD(0.1), 3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup_p)
+    with pytest.raises(ValueError, match='divisible'):
+        exe.run(main_p, feed={'x': np.ones((8, 4), np.float32),
+                              'y': np.ones((8, 1), np.float32)},
+                fetch_list=[loss])
+
+
+def test_grad_merge_with_batchnorm_trains():
+    """BN inside the scan updates running stats k times per step (reference
+    batch-merge repeats the forward subgraph the same way) — must train."""
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup_p.random_seed = 2
+    with fluid.program_guard(main_p, startup_p):
+        x = fluid.layers.data(name='x', shape=[1, 8, 8], dtype='float32')
+        lab = fluid.layers.data(name='lab', shape=[1], dtype='int64')
+        c = fluid.layers.conv2d(x, num_filters=4, filter_size=3, padding=1)
+        c = fluid.layers.batch_norm(c, act='relu')
+        logits = fluid.layers.fc(c, size=3)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            logits=logits, label=lab))
+        fluid.contrib.gradient_merge.decorate(
+            fluid.optimizer.Adam(1e-2), 2).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    rng = np.random.RandomState(0)
+    xs = rng.randn(16, 1, 8, 8).astype(np.float32)
+    labs = rng.randint(0, 3, (16, 1))
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        losses = []
+        for _ in range(10):
+            l, = exe.run(main_p, feed={'x': xs, 'lab': labs},
+                         fetch_list=[loss])
+            losses.append(float(np.asarray(l).reshape(-1)[0]))
+        # BN running stats must have moved off their init (mean 0)
+        bn_means = [np.asarray(scope.get(v.name))
+                    for v in main_p.list_vars()
+                    if v.persistable and 'mean' in v.name]
+    assert losses[-1] < losses[0] * 0.7
+    assert any(np.abs(m).sum() > 0 for m in bn_means)
