@@ -6,6 +6,8 @@ All layer modules are merged into this namespace, matching the reference's
 """
 from . import math_op_patch
 from .nn import *            # noqa: F401,F403
+from .detection import *     # noqa: F401,F403
+from . import detection      # noqa: F401
 from .ops import *           # noqa: F401,F403
 from . import ops as _ops_mod
 from .tensor import (create_tensor, create_parameter, create_global_var,  # noqa

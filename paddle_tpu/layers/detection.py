@@ -1,0 +1,476 @@
+"""Detection layers (ref: python/paddle/fluid/layers/detection.py — the 17
+public functions of the SSD/RPN/YOLO era). Each wraps the detection op
+lowerings (ops/detection_ops.py); ssd_loss and multi_box_head are
+composites, exactly as in the reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..layer_helper import LayerHelper
+from ..framework import Variable
+from . import nn
+from . import tensor
+
+__all__ = ['prior_box', 'density_prior_box', 'anchor_generator',
+           'iou_similarity', 'box_coder', 'bipartite_match', 'target_assign',
+           'ssd_loss', 'detection_output', 'multiclass_nms', 'multi_box_head',
+           'rpn_target_assign', 'generate_proposals',
+           'generate_proposal_labels', 'polygon_box_transform',
+           'roi_perspective_transform', 'yolov3_loss', 'detection_map',
+           'roi_pool', 'roi_align', 'psroi_pool']
+
+
+def _out(helper, dtype='float32'):
+    return helper.create_variable_for_type_inference(dtype)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    helper = LayerHelper('prior_box', name=name)
+    boxes, var = _out(helper), _out(helper)
+    helper.append_op(
+        type='prior_box', inputs={'Input': input, 'Image': image},
+        outputs={'Boxes': boxes, 'Variances': var},
+        attrs={'min_sizes': list(min_sizes),
+               'max_sizes': list(max_sizes or []),
+               'aspect_ratios': list(aspect_ratios),
+               'variances': list(variance), 'flip': flip, 'clip': clip,
+               'step_w': steps[0], 'step_h': steps[1], 'offset': offset},
+        infer_shape=False)
+    return boxes, var
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=(0.1, 0.1, 0.2, 0.2),
+                      clip=False, steps=(0.0, 0.0), offset=0.5, name=None):
+    helper = LayerHelper('density_prior_box', name=name)
+    boxes, var = _out(helper), _out(helper)
+    helper.append_op(
+        type='density_prior_box', inputs={'Input': input, 'Image': image},
+        outputs={'Boxes': boxes, 'Variances': var},
+        attrs={'densities': list(densities or []),
+               'fixed_sizes': list(fixed_sizes or []),
+               'fixed_ratios': list(fixed_ratios or []),
+               'variances': list(variance), 'clip': clip,
+               'step_w': steps[0], 'step_h': steps[1], 'offset': offset},
+        infer_shape=False)
+    return boxes, var
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=None, offset=0.5,
+                     name=None):
+    helper = LayerHelper('anchor_generator', name=name)
+    anchors, var = _out(helper), _out(helper)
+    helper.append_op(
+        type='anchor_generator', inputs={'Input': input},
+        outputs={'Anchors': anchors, 'Variances': var},
+        attrs={'anchor_sizes': list(anchor_sizes),
+               'aspect_ratios': list(aspect_ratios),
+               'variances': list(variance), 'stride': list(stride),
+               'offset': offset}, infer_shape=False)
+    return anchors, var
+
+
+def iou_similarity(x, y, name=None):
+    helper = LayerHelper('iou_similarity', name=name)
+    out = _out(helper)
+    helper.append_op(type='iou_similarity', inputs={'X': x, 'Y': y},
+                     outputs={'Out': out}, infer_shape=False)
+    return out
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type='encode_center_size', box_normalized=True,
+              name=None, axis=0):
+    helper = LayerHelper('box_coder', name=name)
+    out = _out(helper)
+    inputs = {'PriorBox': prior_box, 'TargetBox': target_box}
+    attrs = {'code_type': code_type, 'box_normalized': box_normalized,
+             'axis': axis}
+    if isinstance(prior_box_var, Variable):
+        inputs['PriorBoxVar'] = prior_box_var
+    elif prior_box_var is not None:
+        attrs['variance'] = list(prior_box_var)
+    helper.append_op(type='box_coder', inputs=inputs,
+                     outputs={'OutputBox': out}, attrs=attrs,
+                     infer_shape=False)
+    return out
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    helper = LayerHelper('bipartite_match', name=name)
+    match_indices = _out(helper, 'int32')
+    match_distance = _out(helper)
+    helper.append_op(
+        type='bipartite_match', inputs={'DistMat': dist_matrix},
+        outputs={'ColToRowMatchIndices': match_indices,
+                 'ColToRowMatchDist': match_distance},
+        attrs={'match_type': match_type or 'bipartite',
+               'dist_threshold': (0.5 if dist_threshold is None
+                                  else dist_threshold)}, infer_shape=False)
+    return match_indices, match_distance
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=None, name=None):
+    helper = LayerHelper('target_assign', name=name)
+    out = _out(helper, input.dtype)
+    out_weight = _out(helper)
+    inputs = {'X': input, 'MatchIndices': matched_indices}
+    if negative_indices is not None:
+        inputs['NegIndices'] = negative_indices
+    helper.append_op(type='target_assign', inputs=inputs,
+                     outputs={'Out': out, 'OutWeight': out_weight},
+                     attrs={'mismatch_value': mismatch_value or 0},
+                     infer_shape=False)
+    return out, out_weight
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None):
+    helper = LayerHelper('multiclass_nms', name=name)
+    out = _out(helper)
+    out.lod_level = 1
+    helper.append_op(
+        type='multiclass_nms', inputs={'BBoxes': bboxes, 'Scores': scores},
+        outputs={'Out': out},
+        attrs={'background_label': background_label,
+               'score_threshold': score_threshold, 'nms_top_k': nms_top_k,
+               'nms_threshold': nms_threshold, 'keep_top_k': keep_top_k,
+               'nms_eta': nms_eta, 'normalized': normalized},
+        infer_shape=False)
+    return out
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0):
+    """SSD inference head (ref detection.py detection_output): decode loc
+    deltas against priors, then class-wise NMS."""
+    decoded = box_coder(prior_box=prior_box, prior_box_var=prior_box_var,
+                        target_box=loc, code_type='decode_center_size')
+    scores = nn.softmax(scores)
+    scores = nn.transpose(scores, perm=[0, 2, 1])
+    return multiclass_nms(bboxes=decoded, scores=scores,
+                          score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold, nms_eta=nms_eta,
+                          background_label=background_label)
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type='per_prediction',
+             mining_type='max_negative', normalize=True,
+             sample_size=None):
+    """SSD training loss (ref detection.py ssd_loss): match priors to gt
+    (bipartite + per-prediction), mine hard negatives, localization
+    smooth-L1 + confidence cross-entropy."""
+    helper = LayerHelper('ssd_loss')
+    if mining_type != 'max_negative':
+        raise NotImplementedError("ssd_loss: only mining_type='max_negative'")
+    # 1. match (overlap_threshold gates per-prediction matches, ref
+    # ssd_loss -> bipartite_match(iou, match_type, overlap_threshold))
+    iou = iou_similarity(x=gt_box, y=prior_box)
+    matched_indices, matched_dist = bipartite_match(iou, match_type,
+                                                    overlap_threshold)
+    # 2. confidence loss for mining: cross entropy against matched labels
+    gt_lbl, _ = target_assign(gt_label, matched_indices,
+                              mismatch_value=background_label)
+    gt_lbl.stop_gradient = True
+    conf_sm = nn.softmax(confidence)
+    cls_loss = nn.cross_entropy(conf_sm, tensor.cast(gt_lbl, 'int64'))
+    cls_loss2d = nn.reshape(cls_loss, shape=[-1, confidence.shape[1]])
+    # 3. mine hard negatives
+    neg_indices = _out(helper, 'int32')
+    neg_indices.lod_level = 1
+    updated = _out(helper, 'int32')
+    helper.append_op(
+        type='mine_hard_examples',
+        inputs={'ClsLoss': cls_loss2d, 'MatchIndices': matched_indices,
+                'MatchDist': matched_dist},
+        outputs={'NegIndices': neg_indices,
+                 'UpdatedMatchIndices': updated},
+        attrs={'neg_pos_ratio': neg_pos_ratio,
+               'neg_dist_threshold': neg_overlap,
+               'mining_type': mining_type}, infer_shape=False)
+    # 4. targets with negatives enabled
+    gt_lbl2, conf_w = target_assign(gt_label, updated,
+                                    negative_indices=neg_indices,
+                                    mismatch_value=background_label)
+    gt_lbl2.stop_gradient = True
+    conf_w.stop_gradient = True
+    enc_gt = box_coder(prior_box=prior_box, prior_box_var=prior_box_var,
+                       target_box=gt_box, code_type='encode_center_size')
+    loc_tgt, loc_w = target_assign(enc_gt, updated)
+    loc_tgt.stop_gradient = True
+    loc_w.stop_gradient = True
+    # 5. losses over flattened [B*M, .] rows (reference __reshape_to_2d)
+    loc2d = nn.reshape(location, shape=[-1, 4])
+    tgt2d = nn.reshape(loc_tgt, shape=[-1, 4])
+    lw2d = nn.reshape(loc_w, shape=[-1, 1])
+    loc_loss = nn.smooth_l1(loc2d, tgt2d) * lw2d           # [B*M, 1]
+    conf_loss = nn.cross_entropy(conf_sm, tensor.cast(gt_lbl2, 'int64'))
+    conf_loss = nn.reshape(conf_loss, shape=[-1, 1])
+    conf_loss = conf_loss * nn.reshape(conf_w, shape=[-1, 1])
+    loss = loc_loss_weight * loc_loss + conf_loss_weight * conf_loss
+    if normalize:
+        norm = nn.reduce_sum(loc_w) + 1e-6
+        loss = loss / norm
+    return loss
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD prediction head over several feature maps (ref detection.py
+    multi_box_head): per map a prior_box + 3x3 conv loc/conf predictions,
+    flattened and concatenated."""
+    if min_sizes is None:
+        # reference ratio interpolation
+        num_layer = len(inputs)
+        min_sizes, max_sizes = [], []
+        step = int(np.floor((max_ratio - min_ratio) / (num_layer - 2)))
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes
+        max_sizes = [base_size * 0.2] + max_sizes
+    locs, confs, boxes, vars_ = [], [], [], []
+    for i, x in enumerate(inputs):
+        ms = min_sizes[i]
+        ms = [ms] if not isinstance(ms, (list, tuple)) else list(ms)
+        mx = max_sizes[i] if max_sizes else []
+        mx = [mx] if not isinstance(mx, (list, tuple)) else list(mx)
+        ar = aspect_ratios[i]
+        ar = [ar] if not isinstance(ar, (list, tuple)) else list(ar)
+        st = steps[i] if steps else (step_w[i] if step_w else 0.0,
+                                     step_h[i] if step_h else 0.0)
+        if not isinstance(st, (list, tuple)):
+            st = (st, st)
+        box, var = prior_box(x, image, ms, mx, ar, list(variance), flip,
+                             clip, st, offset)
+        # prior count per location (mirror of the prior_box op's wh list)
+        n_other = 0
+        seen = [1.0]
+        for a in ar:
+            if not any(abs(a - s) < 1e-6 for s in seen):
+                seen.append(a)
+                n_other += 1
+                if flip:
+                    seen.append(1.0 / a)
+                    n_other += 1
+        num_priors = len(ms) * (1 + n_other) + min(len(mx), len(ms))
+        loc = nn.conv2d(x, num_filters=num_priors * 4,
+                        filter_size=kernel_size, padding=pad, stride=stride)
+        loc = nn.transpose(loc, perm=[0, 2, 3, 1])
+        loc = nn.reshape(loc, shape=[-1, int(np.prod(loc.shape[1:])) // 4, 4])
+        conf = nn.conv2d(x, num_filters=num_priors * num_classes,
+                         filter_size=kernel_size, padding=pad, stride=stride)
+        conf = nn.transpose(conf, perm=[0, 2, 3, 1])
+        conf = nn.reshape(conf, shape=[
+            -1, int(np.prod(conf.shape[1:])) // num_classes, num_classes])
+        locs.append(loc)
+        confs.append(conf)
+        boxes.append(nn.reshape(box, shape=[-1, 4]))
+        vars_.append(nn.reshape(var, shape=[-1, 4]))
+    mbox_locs = nn.concat(locs, axis=1)
+    mbox_confs = nn.concat(confs, axis=1)
+    box = nn.concat(boxes, axis=0)
+    var = nn.concat(vars_, axis=0)
+    box.stop_gradient = True
+    var.stop_gradient = True
+    return mbox_locs, mbox_confs, box, var
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    helper = LayerHelper('rpn_target_assign')
+    loc_index = _out(helper, 'int32')
+    score_index = _out(helper, 'int32')
+    target_label = _out(helper, 'int32')
+    target_bbox = _out(helper)
+    bbox_inside_weight = _out(helper)
+    helper.append_op(
+        type='rpn_target_assign',
+        inputs={'Anchor': anchor_box, 'GtBoxes': gt_boxes},
+        outputs={'LocationIndex': loc_index, 'ScoreIndex': score_index,
+                 'TargetLabel': target_label, 'TargetBBox': target_bbox,
+                 'BBoxInsideWeight': bbox_inside_weight},
+        attrs={'rpn_batch_size_per_im': rpn_batch_size_per_im,
+               'rpn_fg_fraction': rpn_fg_fraction,
+               'rpn_positive_overlap': rpn_positive_overlap,
+               'rpn_negative_overlap': rpn_negative_overlap},
+        infer_shape=False)
+    for v in (loc_index, score_index, target_label, target_bbox):
+        v.stop_gradient = True
+    return (_pred_gather(bbox_pred, loc_index),
+            _pred_gather(cls_logits, score_index),
+            target_bbox, target_label, bbox_inside_weight)
+
+
+def _pred_gather(pred, index):
+    flat = nn.reshape(pred, shape=[-1, pred.shape[-1]])
+    return nn.gather(flat, index)
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None):
+    helper = LayerHelper('generate_proposals', name=name)
+    rois = _out(helper)
+    rois.lod_level = 1
+    probs = _out(helper)
+    probs.lod_level = 1
+    helper.append_op(
+        type='generate_proposals',
+        inputs={'Scores': scores, 'BboxDeltas': bbox_deltas,
+                'ImInfo': im_info, 'Anchors': anchors,
+                'Variances': variances},
+        outputs={'RpnRois': rois, 'RpnRoiProbs': probs},
+        attrs={'pre_nms_topN': pre_nms_top_n, 'post_nms_topN': post_nms_top_n,
+               'nms_thresh': nms_thresh, 'min_size': min_size, 'eta': eta},
+        infer_shape=False)
+    rois.stop_gradient = True
+    probs.stop_gradient = True
+    return rois, probs
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256, fg_fraction=0.25,
+                             fg_thresh=0.25, bg_thresh_hi=0.5,
+                             bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True):
+    helper = LayerHelper('generate_proposal_labels')
+    rois = _out(helper)
+    rois.lod_level = 1
+    labels = _out(helper, 'int32')
+    labels.lod_level = 1
+    bbox_targets = _out(helper)
+    bbox_inside = _out(helper)
+    bbox_outside = _out(helper)
+    helper.append_op(
+        type='generate_proposal_labels',
+        inputs={'RpnRois': rpn_rois, 'GtClasses': gt_classes,
+                'GtBoxes': gt_boxes, 'ImInfo': im_info},
+        outputs={'Rois': rois, 'LabelsInt32': labels,
+                 'BboxTargets': bbox_targets,
+                 'BboxInsideWeights': bbox_inside,
+                 'BboxOutsideWeights': bbox_outside},
+        attrs={'batch_size_per_im': batch_size_per_im,
+               'fg_fraction': fg_fraction, 'fg_thresh': fg_thresh,
+               'bg_thresh_hi': bg_thresh_hi, 'bg_thresh_lo': bg_thresh_lo,
+               'class_nums': class_nums or 81}, infer_shape=False)
+    for v in (rois, labels, bbox_targets, bbox_inside, bbox_outside):
+        v.stop_gradient = True
+    return rois, labels, bbox_targets, bbox_inside, bbox_outside
+
+
+def polygon_box_transform(input, name=None):
+    helper = LayerHelper('polygon_box_transform', name=name)
+    out = _out(helper, input.dtype)
+    helper.append_op(type='polygon_box_transform', inputs={'Input': input},
+                     outputs={'Output': out}, infer_shape=False)
+    return out
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0):
+    helper = LayerHelper('roi_perspective_transform')
+    out = _out(helper, input.dtype)
+    helper.append_op(
+        type='roi_perspective_transform',
+        inputs={'X': input, 'ROIs': rois}, outputs={'Out': out},
+        attrs={'transformed_height': transformed_height,
+               'transformed_width': transformed_width,
+               'spatial_scale': spatial_scale}, infer_shape=False)
+    return out
+
+
+def yolov3_loss(x, gtbox, gtlabel, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, name=None):
+    helper = LayerHelper('yolov3_loss', name=name)
+    loss = _out(helper)
+    helper.append_op(
+        type='yolov3_loss',
+        inputs={'X': x, 'GTBox': gtbox, 'GTLabel': gtlabel},
+        outputs={'Loss': loss},
+        attrs={'anchors': list(anchors), 'anchor_mask': list(anchor_mask),
+               'class_num': class_num, 'ignore_thresh': ignore_thresh,
+               'downsample_ratio': downsample_ratio}, infer_shape=False)
+    return loss
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.3, evaluate_difficult=True,
+                  has_state=None, input_states=None, out_states=None,
+                  ap_version='integral'):
+    helper = LayerHelper('detection_map')
+    m = _out(helper)
+    pos_cnt = _out(helper, 'int32')
+    true_pos = _out(helper)
+    false_pos = _out(helper)
+    helper.append_op(
+        type='detection_map',
+        inputs={'DetectRes': detect_res, 'Label': label},
+        outputs={'MAP': m, 'AccumPosCount': pos_cnt,
+                 'AccumTruePos': true_pos, 'AccumFalsePos': false_pos},
+        attrs={'overlap_threshold': overlap_threshold,
+               'evaluate_difficult': evaluate_difficult,
+               'ap_type': ap_version, 'class_num': class_num},
+        infer_shape=False)
+    return m
+
+
+# roi pooling layers live here too (reference keeps them in nn.py; both
+# import paths work via layers/__init__)
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0):
+    helper = LayerHelper('roi_pool')
+    out = _out(helper, input.dtype)
+    helper.append_op(
+        type='roi_pool', inputs={'X': input, 'ROIs': rois},
+        outputs={'Out': out},
+        attrs={'pooled_height': pooled_height, 'pooled_width': pooled_width,
+               'spatial_scale': spatial_scale}, infer_shape=False)
+    return out
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, name=None):
+    helper = LayerHelper('roi_align', name=name)
+    out = _out(helper, input.dtype)
+    helper.append_op(
+        type='roi_align', inputs={'X': input, 'ROIs': rois},
+        outputs={'Out': out},
+        attrs={'pooled_height': pooled_height, 'pooled_width': pooled_width,
+               'spatial_scale': spatial_scale,
+               'sampling_ratio': sampling_ratio}, infer_shape=False)
+    return out
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, name=None):
+    helper = LayerHelper('psroi_pool', name=name)
+    out = _out(helper, input.dtype)
+    helper.append_op(
+        type='psroi_pool', inputs={'X': input, 'ROIs': rois},
+        outputs={'Out': out},
+        attrs={'output_channels': output_channels,
+               'spatial_scale': spatial_scale, 'pooled_height': pooled_height,
+               'pooled_width': pooled_width}, infer_shape=False)
+    return out

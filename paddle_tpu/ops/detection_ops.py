@@ -1,0 +1,1054 @@
+"""Detection op lowerings (ref: paddle/fluid/operators/detection/ — ~10k
+LoC of CUDA/C++ across prior_box_op.cc, anchor_generator_op.cc,
+iou_similarity_op.cc, box_coder_op.cc, bipartite_match_op.cc,
+target_assign_op.cc, mine_hard_examples_op.cc, multiclass_nms_op.cc,
+roi_align_op.cc, roi_pool_op.cc, psroi_pool_op.cc,
+rpn_target_assign_op.cc, generate_proposals_op.cc,
+generate_proposal_labels_op.cc, polygon_box_transform_op.cc,
+roi_perspective_transform_op.cc, yolov3_loss_op.cc, detection_map_op.cc).
+
+TPU-native designs:
+- static shapes everywhere: NMS/proposal outputs are FIXED-capacity,
+  padded with -1 labels / zero boxes (the reference emits data-dependent
+  LoD; padding carries the same information, like the decode ops);
+- greedy algorithms (bipartite match, NMS) are lax.fori_loop/scan over a
+  static iteration count with masked argmax — no host loops;
+- roi ops are vmapped bilinear/max sampling over a static roi count;
+- ground-truth boxes arrive lod-packed like the reference; the lod is
+  static structure (a handful of gt-count patterns per dataset).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+from ..core.lod import LoDArray, unwrap, lengths_to_offsets
+from .math_ops import X
+
+
+# ---------------------------------------------------------------------------
+# priors / anchors — pure functions of feature-map shape + attrs
+# ---------------------------------------------------------------------------
+def _center_grid(h, w, step_h, step_w, offset):
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * step_h
+    return jnp.meshgrid(cx, cy)  # [h, w] each
+
+
+@register('prior_box', no_grad=True)
+def _prior_box(ctx, ins):
+    x = ins['Input'][0]
+    img = ins['Image'][0]
+    h, w = x.shape[2], x.shape[3]
+    img_h, img_w = img.shape[2], img.shape[3]
+    min_sizes = [float(v) for v in ctx.attr('min_sizes')]
+    max_sizes = [float(v) for v in ctx.attr('max_sizes', []) or []]
+    ars = [1.0]
+    for ar in ctx.attr('aspect_ratios', []) or []:
+        ar = float(ar)
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(ar)
+            if ctx.attr('flip', False):
+                ars.append(1.0 / ar)
+    variances = [float(v) for v in ctx.attr('variances',
+                                            [0.1, 0.1, 0.2, 0.2])]
+    step_w = float(ctx.attr('step_w', 0) or 0) or float(img_w) / w
+    step_h = float(ctx.attr('step_h', 0) or 0) or float(img_h) / h
+    offset = float(ctx.attr('offset', 0.5))
+
+    # per-location prior (w, h) list — reference order: per min_size: the
+    # ar=1 prior, then other aspect ratios, then the max_size prior
+    whs = []
+    for i, ms in enumerate(min_sizes):
+        whs.append((ms, ms))
+        for ar in ars:
+            if abs(ar - 1.0) < 1e-6:
+                continue
+            whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        if i < len(max_sizes):
+            s = np.sqrt(ms * max_sizes[i])
+            whs.append((s, s))
+    num_priors = len(whs)
+    cx, cy = _center_grid(h, w, step_h, step_w, offset)
+    pw = jnp.asarray([p[0] for p in whs], jnp.float32) / 2.0
+    ph = jnp.asarray([p[1] for p in whs], jnp.float32) / 2.0
+    boxes = jnp.stack([
+        (cx[..., None] - pw) / img_w, (cy[..., None] - ph) / img_h,
+        (cx[..., None] + pw) / img_w, (cy[..., None] + ph) / img_h,
+    ], axis=-1)  # [h, w, P, 4]
+    if ctx.attr('clip', False):
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           (h, w, num_priors, 4))
+    return {'Boxes': [boxes], 'Variances': [var]}
+
+
+@register('density_prior_box', no_grad=True)
+def _density_prior_box(ctx, ins):
+    x = ins['Input'][0]
+    img = ins['Image'][0]
+    h, w = x.shape[2], x.shape[3]
+    img_h, img_w = img.shape[2], img.shape[3]
+    densities = [int(v) for v in ctx.attr('densities', []) or []]
+    fixed_sizes = [float(v) for v in ctx.attr('fixed_sizes', []) or []]
+    fixed_ratios = [float(v) for v in ctx.attr('fixed_ratios', []) or []]
+    variances = [float(v) for v in ctx.attr('variances',
+                                            [0.1, 0.1, 0.2, 0.2])]
+    step_w = float(ctx.attr('step_w', 0) or 0) or float(img_w) / w
+    step_h = float(ctx.attr('step_h', 0) or 0) or float(img_h) / h
+    offset = float(ctx.attr('offset', 0.5))
+    # density grid: each fixed_size spawns density^2 shifted centers per
+    # ratio (ref density_prior_box_op.h)
+    prior_list = []  # list of (shift_x, shift_y, half_w, half_h)
+    for size, density in zip(fixed_sizes, densities):
+        for ratio in fixed_ratios:
+            bw = size * np.sqrt(ratio) / 2.0
+            bh = size / np.sqrt(ratio) / 2.0
+            dstep_w, dstep_h = step_w / density, step_h / density
+            for di in range(density):
+                for dj in range(density):
+                    sx = -step_w / 2.0 + dstep_w / 2.0 + dj * dstep_w
+                    sy = -step_h / 2.0 + dstep_h / 2.0 + di * dstep_h
+                    prior_list.append((sx, sy, bw, bh))
+    P = len(prior_list)
+    cx, cy = _center_grid(h, w, step_h, step_w, offset)
+    sx = jnp.asarray([p[0] for p in prior_list], jnp.float32)
+    sy = jnp.asarray([p[1] for p in prior_list], jnp.float32)
+    bw = jnp.asarray([p[2] for p in prior_list], jnp.float32)
+    bh = jnp.asarray([p[3] for p in prior_list], jnp.float32)
+    boxes = jnp.stack([
+        (cx[..., None] + sx - bw) / img_w, (cy[..., None] + sy - bh) / img_h,
+        (cx[..., None] + sx + bw) / img_w, (cy[..., None] + sy + bh) / img_h,
+    ], axis=-1)
+    if ctx.attr('clip', False):
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           (h, w, P, 4))
+    return {'Boxes': [boxes], 'Variances': [var]}
+
+
+@register('anchor_generator', no_grad=True)
+def _anchor_generator(ctx, ins):
+    x = ins['Input'][0]
+    h, w = x.shape[2], x.shape[3]
+    sizes = [float(v) for v in ctx.attr('anchor_sizes')]
+    ratios = [float(v) for v in ctx.attr('aspect_ratios')]
+    variances = [float(v) for v in ctx.attr('variances',
+                                            [0.1, 0.1, 0.2, 0.2])]
+    stride = [float(v) for v in ctx.attr('stride')]
+    offset = float(ctx.attr('offset', 0.5))
+    whs = []
+    for r in ratios:
+        for s in sizes:
+            area = stride[0] * stride[1]
+            area_ratios = area / r
+            base_w = np.round(np.sqrt(area_ratios))
+            base_h = np.round(base_w * r)
+            scale_w = s / stride[0]
+            scale_h = s / stride[1]
+            whs.append((scale_w * base_w, scale_h * base_h))
+    A = len(whs)
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * stride[0]
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * stride[1]
+    cx, cy = jnp.meshgrid(cx, cy)
+    aw = jnp.asarray([p[0] for p in whs], jnp.float32) / 2.0
+    ah = jnp.asarray([p[1] for p in whs], jnp.float32) / 2.0
+    anchors = jnp.stack([
+        cx[..., None] - aw,  # xmin
+        cy[..., None] - ah,  # ymin
+        cx[..., None] + aw,  # xmax
+        cy[..., None] + ah,  # ymax
+    ], axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), (h, w, A, 4))
+    return {'Anchors': [anchors], 'Variances': [var]}
+
+
+# ---------------------------------------------------------------------------
+# geometry: IoU / box coding
+# ---------------------------------------------------------------------------
+def _iou_matrix(a, b):
+    """a [N,4], b [M,4] (xmin,ymin,xmax,ymax) -> IoU [N,M]."""
+    ax0, ay0, ax1, ay1 = [a[:, i:i + 1] for i in range(4)]
+    bx0, by0, bx1, by1 = [b[None, :, i] for i in range(4)]
+    ix0 = jnp.maximum(ax0, bx0)
+    iy0 = jnp.maximum(ay0, by0)
+    ix1 = jnp.minimum(ax1, bx1)
+    iy1 = jnp.minimum(ay1, by1)
+    iw = jnp.maximum(ix1 - ix0, 0.0)
+    ih = jnp.maximum(iy1 - iy0, 0.0)
+    inter = iw * ih
+    area_a = jnp.maximum((ax1 - ax0) * (ay1 - ay0), 0.0)
+    area_b = jnp.maximum((bx1 - bx0) * (by1 - by0), 0.0)
+    union = area_a + area_b - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register('iou_similarity', no_grad=True, lod='aware')
+def _iou_similarity(ctx, ins):
+    x, y = ins['X'][0], ins['Y'][0]
+    out = _iou_matrix(unwrap(x), unwrap(y))
+    if isinstance(x, LoDArray) and x.nlevels:
+        return {'Out': [x.with_lod_of(out)]}
+    return {'Out': [out]}
+
+
+def _encode_center_size(target, prior, pvar, normalized=True):
+    """target [N,4] vs prior [M,4] -> [N,M,4] (ref box_coder_op.h)."""
+    plen = 0.0 if normalized else 1.0
+    pw = prior[:, 2] - prior[:, 0] + plen
+    ph = prior[:, 3] - prior[:, 1] + plen
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    tw = (target[:, 2] - target[:, 0] + plen)[:, None]
+    th = (target[:, 3] - target[:, 1] + plen)[:, None]
+    tcx = (target[:, 0])[:, None] + tw * 0.5
+    tcy = (target[:, 1])[:, None] + th * 0.5
+    out = jnp.stack([
+        (tcx - pcx[None]) / pw[None],
+        (tcy - pcy[None]) / ph[None],
+        jnp.log(jnp.maximum(tw / pw[None], 1e-10)),
+        jnp.log(jnp.maximum(th / ph[None], 1e-10)),
+    ], axis=-1)
+    if pvar is not None:
+        out = out / pvar[None]
+    return out
+
+
+def _decode_center_size(target, prior, pvar, normalized=True):
+    """target [N,M,4] (or [N,4] broadcast) deltas -> boxes [N,M,4]."""
+    plen = 0.0 if normalized else 1.0
+    pw = prior[:, 2] - prior[:, 0] + plen
+    ph = prior[:, 3] - prior[:, 1] + plen
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    if target.ndim == 2:
+        target = target[:, None, :] if target.shape[0] != prior.shape[0] \
+            else target[None].reshape(1, prior.shape[0], 4)
+    t = target if pvar is None else target * pvar[None]
+    cx = t[..., 0] * pw + pcx
+    cy = t[..., 1] * ph + pcy
+    w = jnp.exp(t[..., 2]) * pw
+    h = jnp.exp(t[..., 3]) * ph
+    return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                      cx + w * 0.5 - plen, cy + h * 0.5 - plen], axis=-1)
+
+
+@register('box_coder', no_grad=True, lod='aware')
+def _box_coder(ctx, ins):
+    prior = unwrap(ins['PriorBox'][0])
+    pvar = None
+    if ins.get('PriorBoxVar') and ins['PriorBoxVar'][0] is not None:
+        pvar = unwrap(ins['PriorBoxVar'][0]).reshape(-1, 4)
+    target_in = ins['TargetBox'][0]
+    target = unwrap(target_in)
+    code_type = ctx.attr('code_type', 'encode_center_size')
+    normalized = ctx.attr('box_normalized', True)
+    prior = prior.reshape(-1, 4)
+    if 'encode' in code_type:
+        out = _encode_center_size(target.reshape(-1, 4), prior, pvar,
+                                  normalized)
+        if isinstance(target_in, LoDArray) and target_in.nlevels:
+            return {'OutputBox': [target_in.with_lod_of(out)]}
+        return {'OutputBox': [out]}
+    out = _decode_center_size(target.reshape(target.shape[0], -1, 4)
+                              if target.ndim == 3 else target,
+                              prior, pvar, normalized)
+    if target.ndim == 2:
+        out = out.reshape(target.shape)
+    return {'OutputBox': [out]}
+
+
+# ---------------------------------------------------------------------------
+# matching / target assignment / hard mining
+# ---------------------------------------------------------------------------
+def _bipartite_match_one(dist):
+    """Greedy global-max bipartite match (ref bipartite_match_op.cc
+    BipartiteMatch): repeatedly take the global argmax of the remaining
+    matrix; returns (match_idx [M] int32 row-or--1, match_dist [M])."""
+    n, m = dist.shape
+    steps = min(n, m)
+
+    def body(_, carry):
+        d, idx, dv = carry
+        flat = jnp.argmax(d)
+        r, c = flat // m, flat % m
+        best = d[r, c]
+        take = best > -1e9  # anything left?
+        idx = jnp.where(take, idx.at[c].set(r.astype(jnp.int32)), idx)
+        dv = jnp.where(take, dv.at[c].set(best), dv)
+        d = jnp.where(take, d.at[r, :].set(-1e10).at[:, c].set(-1e10), d)
+        return d, idx, dv
+
+    idx0 = jnp.full((m,), -1, jnp.int32)
+    dv0 = jnp.zeros((m,), dist.dtype)
+    _, idx, dv = jax.lax.fori_loop(
+        0, steps, body, (jnp.where(dist > 0, dist, -1e10), idx0, dv0))
+    return idx, dv
+
+
+def _argmax_match_one(dist, threshold):
+    """per_prediction: col -> argmax row when above threshold."""
+    best = jnp.max(dist, axis=0)
+    idx = jnp.argmax(dist, axis=0).astype(jnp.int32)
+    return jnp.where(best >= threshold, idx, -1), jnp.where(
+        best >= threshold, best, 0.0)
+
+
+@register('bipartite_match', no_grad=True, lod='aware')
+def _bipartite_match(ctx, ins):
+    x = ins['DistMat'][0]
+    match_type = ctx.attr('match_type', 'bipartite')
+    threshold = float(ctx.attr('dist_threshold', 0.5))
+    dist = unwrap(x)
+    m = dist.shape[1]
+    if isinstance(x, LoDArray) and x.nlevels:
+        off = np.asarray(x.lod[0], np.int64)
+    else:
+        off = np.asarray([0, dist.shape[0]], np.int64)
+    idxs, dvs = [], []
+    for i in range(len(off) - 1):
+        d = dist[int(off[i]):int(off[i + 1])]
+        idx, dv = _bipartite_match_one(d)
+        if match_type == 'per_prediction':
+            # keep bipartite winners, then add per-prediction extras
+            aidx, adv = _argmax_match_one(d, threshold)
+            extra = (idx < 0) & (aidx >= 0)
+            idx = jnp.where(extra, aidx, idx)
+            dv = jnp.where(extra, adv, dv)
+        idxs.append(idx)
+        dvs.append(dv)
+    return {'ColToRowMatchIndices': [jnp.stack(idxs)],
+            'ColToRowMatchDis': [jnp.stack(dvs)],
+            'ColToRowMatchDist': [jnp.stack(dvs)]}
+
+
+@register('target_assign', no_grad=True, lod='aware')
+def _target_assign(ctx, ins):
+    """Gather per-prior targets by match index (ref target_assign_op.h):
+    Out[b, m] = X_rows_of_image_b[match[b, m]]; weight 1 where matched.
+    NegIndices rows get weight 1 with mismatch_value targets."""
+    x = ins['X'][0]
+    match = unwrap(ins['MatchIndices'][0]).astype(jnp.int32)  # [B, M]
+    mismatch_value = ctx.attr('mismatch_value', 0)
+    xd = unwrap(x)
+    B, M = match.shape
+    per_prior = xd.ndim == 3  # e.g. encoded boxes [N_gt, M, K]
+    k = xd.shape[-1] if xd.ndim > 1 else 1
+    if not per_prior:
+        xd = xd.reshape(-1, k)
+    if isinstance(x, LoDArray) and x.nlevels:
+        off = np.asarray(x.lod[0], np.int64)
+    else:
+        off = np.asarray([0, xd.shape[0]], np.int64)
+    outs, wts = [], []
+    cols = jnp.arange(M, dtype=jnp.int32)
+    for b in range(B):
+        base = int(off[b])
+        rows = jnp.clip(match[b], 0, None) + base
+        if per_prior:
+            # ref target_assign_op.h: Out[b, m] = X[lod[b]+match[b,m], m]
+            vals = xd[rows, cols]
+        else:
+            vals = jnp.take(xd, rows, axis=0)
+        matched = match[b] >= 0
+        vals = jnp.where(matched[:, None], vals,
+                         jnp.asarray(mismatch_value, xd.dtype))
+        outs.append(vals)
+        wts.append(matched.astype(jnp.float32))
+    out = jnp.stack(outs)           # [B, M, K]
+    wt = jnp.stack(wts)[..., None]  # [B, M, 1]
+    if ins.get('NegIndices') and ins['NegIndices'][0] is not None:
+        neg = ins['NegIndices'][0]
+        negd = unwrap(neg).reshape(-1).astype(jnp.int32)
+        noff = np.asarray(neg.lod[0], np.int64) if isinstance(neg, LoDArray) \
+            and neg.nlevels else np.asarray([0, negd.shape[0]], np.int64)
+        for b in range(B):
+            seg = negd[int(noff[b]):int(noff[b + 1])]
+            # -1 padding must NOT wrap to the last prior: route to M (OOB)
+            seg = jnp.where(seg >= 0, seg, M)
+            wt = wt.at[b, seg, 0].set(1.0, mode='drop')
+    return {'Out': [out], 'OutWeight': [wt]}
+
+
+@register('mine_hard_examples', no_grad=True, lod='aware')
+def _mine_hard_examples(ctx, ins):
+    """Hard negative mining (ref mine_hard_examples_op.cc, max_negative):
+    per image pick the top-(neg_pos_ratio x num_pos) unmatched priors by
+    classification loss. Output NegIndices as a FIXED-capacity lod (one
+    row span per image, capacity M), -1-padded."""
+    cls_loss = unwrap(ins['ClsLoss'][0])           # [B, M]
+    match = unwrap(ins['MatchIndices'][0])         # [B, M]
+    loc_loss = None
+    if ins.get('LocLoss') and ins['LocLoss'][0] is not None:
+        loc_loss = unwrap(ins['LocLoss'][0])
+    neg_pos_ratio = float(ctx.attr('neg_pos_ratio', 3.0))
+    neg_overlap = float(ctx.attr('neg_dist_threshold', 0.5))
+    B, M = cls_loss.shape
+    loss = cls_loss if loc_loss is None else cls_loss + loc_loss
+    dist = None
+    if ins.get('MatchDist') and ins['MatchDist'][0] is not None:
+        dist = unwrap(ins['MatchDist'][0])
+    is_neg = match < 0
+    if dist is not None:
+        is_neg &= dist < neg_overlap
+    num_pos = jnp.sum((match >= 0).astype(jnp.int32), axis=1)   # [B]
+    num_neg = jnp.minimum((num_pos.astype(jnp.float32)
+                           * neg_pos_ratio).astype(jnp.int32),
+                          jnp.sum(is_neg.astype(jnp.int32), axis=1))
+    masked = jnp.where(is_neg, loss, -jnp.inf)
+    order = jnp.argsort(-masked, axis=1).astype(jnp.int32)      # [B, M]
+    rank = jnp.arange(M, dtype=jnp.int32)[None, :]
+    keep = rank < num_neg[:, None]
+    neg_idx = jnp.where(keep, order, -1)                        # [B, M]
+    lod = lengths_to_offsets([M] * B)
+    return {'NegIndices': [LoDArray(neg_idx.reshape(-1, 1), (lod,))],
+            'UpdatedMatchIndices': [match]}
+
+
+# ---------------------------------------------------------------------------
+# NMS family
+# ---------------------------------------------------------------------------
+def _nms_mask(boxes, scores, iou_threshold, top_k):
+    """Greedy NMS over boxes sorted by score. Returns (order, keep_mask)
+    of length top_k (static)."""
+    order = jnp.argsort(-scores)[:top_k]
+    b = jnp.take(boxes, order, axis=0)
+    s = jnp.take(scores, order)
+    iou = _iou_matrix(b, b)
+    K = b.shape[0]
+
+    def body(i, keep):
+        # suppressed if any kept higher-scoring box overlaps > threshold
+        over = (iou[:, i] > iou_threshold) & keep & \
+            (jnp.arange(K) < i)
+        return keep.at[i].set(~jnp.any(over) & keep[i])
+
+    keep0 = s > -jnp.inf
+    keep = jax.lax.fori_loop(0, K, body, keep0)
+    return order, keep, s
+
+
+@register('multiclass_nms', no_grad=True, lod='aware')
+def _multiclass_nms(ctx, ins):
+    """Per-class NMS + cross-class keep_top_k (ref multiclass_nms_op.cc).
+    Output is a FIXED keep_top_k rows per image [label, score, x0,y0,x1,y1],
+    label -1 on padding rows; lod = keep_top_k per image."""
+    bboxes = unwrap(ins['BBoxes'][0])   # [B, M, 4]
+    scores = unwrap(ins['Scores'][0])   # [B, C, M]
+    bg = int(ctx.attr('background_label', 0))
+    score_thresh = float(ctx.attr('score_threshold', 0.01))
+    nms_top_k = int(ctx.attr('nms_top_k', 400))
+    nms_thresh = float(ctx.attr('nms_threshold', 0.3))
+    keep_top_k = int(ctx.attr('keep_top_k', 200))
+    B, C, M = scores.shape
+    nms_top_k = min(nms_top_k if nms_top_k > 0 else M, M)
+    keep_top_k = keep_top_k if keep_top_k > 0 else C * nms_top_k
+
+    def one_image(boxes, sc):
+        rows = []
+        for c in range(C):
+            if c == bg:
+                continue
+            s = jnp.where(sc[c] >= score_thresh, sc[c], -jnp.inf)
+            order, keep, ss = _nms_mask(boxes, s, nms_thresh, nms_top_k)
+            kept_boxes = jnp.take(boxes, order, axis=0)
+            valid = keep & jnp.isfinite(ss)
+            rows.append(jnp.concatenate([
+                jnp.where(valid, float(c), -1.0)[:, None],
+                jnp.where(valid, ss, -jnp.inf)[:, None],
+                kept_boxes], axis=1))
+        allr = jnp.concatenate(rows, axis=0)    # [(C-1)*K, 6]
+        top = jnp.argsort(-allr[:, 1])[:keep_top_k]
+        out = jnp.take(allr, top, axis=0)
+        pad = ~jnp.isfinite(out[:, 1])
+        out = jnp.concatenate([
+            jnp.where(pad, -1.0, out[:, 0])[:, None],
+            jnp.where(pad, 0.0, out[:, 1])[:, None],
+            jnp.where(pad[:, None], 0.0, out[:, 2:])], axis=1)
+        return out
+
+    outs = jax.vmap(one_image)(bboxes, scores)  # [B, keep_top_k, 6]
+    lod = lengths_to_offsets([keep_top_k] * B)
+    return {'Out': [LoDArray(outs.reshape(B * keep_top_k, 6), (lod,))]}
+
+
+# ---------------------------------------------------------------------------
+# ROI ops — vmapped sampling over a static roi count
+# ---------------------------------------------------------------------------
+def _roi_batch_ids(rois, nimg):
+    """Batch id per roi from the rois' lod (static)."""
+    if isinstance(rois, LoDArray) and rois.nlevels:
+        off = np.asarray(rois.lod[0], np.int64)
+        lens = off[1:] - off[:-1]
+        return np.repeat(np.arange(len(lens)), lens).astype(np.int32)
+    return np.zeros(unwrap(rois).shape[0], np.int32)
+
+
+def _bilinear(img, y, x):
+    """img [C, H, W]; y, x scalar float coords -> [C]."""
+    H, W = img.shape[1], img.shape[2]
+    y0 = jnp.clip(jnp.floor(y), 0, H - 1)
+    x0 = jnp.clip(jnp.floor(x), 0, W - 1)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    ly, lx = y - y0, x - x0
+    y0i, y1i = y0.astype(jnp.int32), y1.astype(jnp.int32)
+    x0i, x1i = x0.astype(jnp.int32), x1.astype(jnp.int32)
+    v = (img[:, y0i, x0i] * (1 - ly) * (1 - lx)
+         + img[:, y1i, x0i] * ly * (1 - lx)
+         + img[:, y0i, x1i] * (1 - ly) * lx
+         + img[:, y1i, x1i] * ly * lx)
+    return jnp.where((y >= -1.0) & (y <= H) & (x >= -1.0) & (x <= W), v, 0.0)
+
+
+@register('roi_align', lod='aware')
+def _roi_align(ctx, ins):
+    """ref roi_align_op: average of sampling_ratio^2 bilinear samples per
+    output bin."""
+    x = unwrap(ins['X'][0])            # [N, C, H, W]
+    rois_in = ins['ROIs'][0]
+    rois = unwrap(rois_in).reshape(-1, 4)
+    ph = int(ctx.attr('pooled_height', 1))
+    pw = int(ctx.attr('pooled_width', 1))
+    scale = float(ctx.attr('spatial_scale', 1.0))
+    ratio = int(ctx.attr('sampling_ratio', -1))
+    bids = jnp.asarray(_roi_batch_ids(rois_in, x.shape[0]))
+
+    def one(roi, bid):
+        img = x[bid]
+        x0, y0, x1, y1 = roi * scale
+        rw = jnp.maximum(x1 - x0, 1.0)
+        rh = jnp.maximum(y1 - y0, 1.0)
+        bin_w, bin_h = rw / pw, rh / ph
+        r = ratio if ratio > 0 else 2
+        iy = (jnp.arange(ph)[:, None, None, None] * bin_h + y0
+              + (jnp.arange(r)[None, None, :, None] + 0.5) * bin_h / r)
+        ix = (jnp.arange(pw)[None, :, None, None] * bin_w + x0
+              + (jnp.arange(r)[None, None, None, :] + 0.5) * bin_w / r)
+        iy = jnp.broadcast_to(iy, (ph, pw, r, r)).reshape(-1)
+        ix = jnp.broadcast_to(ix, (ph, pw, r, r)).reshape(-1)
+        vals = jax.vmap(lambda yy, xx: _bilinear(img, yy, xx))(iy, ix)
+        return vals.reshape(ph, pw, r * r, -1).mean(axis=2) \
+            .transpose(2, 0, 1)  # [C, ph, pw]
+
+    out = jax.vmap(one)(rois, bids)
+    return {'Out': [out]}
+
+
+@register('roi_pool', lod='aware')
+def _roi_pool(ctx, ins):
+    """ref roi_pool_op: max over each quantized bin."""
+    x = unwrap(ins['X'][0])
+    rois_in = ins['ROIs'][0]
+    rois = unwrap(rois_in).reshape(-1, 4)
+    ph = int(ctx.attr('pooled_height', 1))
+    pw = int(ctx.attr('pooled_width', 1))
+    scale = float(ctx.attr('spatial_scale', 1.0))
+    bids = jnp.asarray(_roi_batch_ids(rois_in, x.shape[0]))
+    H, W = x.shape[2], x.shape[3]
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+
+    def one(roi, bid):
+        img = x[bid]                      # [C, H, W]
+        rx0 = jnp.round(roi[0] * scale)
+        ry0 = jnp.round(roi[1] * scale)
+        rx1 = jnp.round(roi[2] * scale)
+        ry1 = jnp.round(roi[3] * scale)
+        rw = jnp.maximum(rx1 - rx0 + 1, 1.0)
+        rh = jnp.maximum(ry1 - ry0 + 1, 1.0)
+        # bin of each pixel relative to this roi; mask pixels outside
+        by = jnp.floor((ys - ry0) * ph / rh)
+        bx = jnp.floor((xs - rx0) * pw / rw)
+        inside_y = (ys >= ry0) & (ys <= ry1)
+        inside_x = (xs >= rx0) & (xs <= rx1)
+        out = jnp.full((img.shape[0], ph, pw), -jnp.inf, img.dtype)
+        byc = jnp.clip(by, 0, ph - 1).astype(jnp.int32)
+        bxc = jnp.clip(bx, 0, pw - 1).astype(jnp.int32)
+        # scatter-max pixels into their bins
+        yy, xx = jnp.meshgrid(jnp.arange(H), jnp.arange(W), indexing='ij')
+        mask = inside_y[:, None] & inside_x[None, :]
+        vals = jnp.where(mask[None], img, -jnp.inf)
+        out = out.at[:, byc[yy].reshape(-1), bxc[xx].reshape(-1)].max(
+            vals.reshape(img.shape[0], -1))
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    out = jax.vmap(one)(rois, bids)
+    return {'Out': [out], 'Argmax': None}
+
+
+@register('psroi_pool', lod='aware')
+def _psroi_pool(ctx, ins):
+    """Position-sensitive roi pooling (ref psroi_pool_op): channel block
+    (i,j) pools bin (i,j) only; average pooling."""
+    x = unwrap(ins['X'][0])            # [N, C=out_c*ph*pw, H, W]
+    rois_in = ins['ROIs'][0]
+    rois = unwrap(rois_in).reshape(-1, 4)
+    out_c = int(ctx.attr('output_channels'))
+    ph = int(ctx.attr('pooled_height', 1))
+    pw = int(ctx.attr('pooled_width', 1))
+    scale = float(ctx.attr('spatial_scale', 1.0))
+    bids = jnp.asarray(_roi_batch_ids(rois_in, x.shape[0]))
+    H, W = x.shape[2], x.shape[3]
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+
+    def one(roi, bid):
+        img = x[bid].reshape(out_c, ph, pw, H, W)
+        rx0, ry0 = roi[0] * scale, roi[1] * scale
+        rw = jnp.maximum(roi[2] * scale - rx0, 0.1)
+        rh = jnp.maximum(roi[3] * scale - ry0, 0.1)
+        by = jnp.floor((ys - ry0) * ph / rh)
+        bx = jnp.floor((xs - rx0) * pw / rw)
+        outs = []
+        for i in range(ph):
+            row = []
+            for j in range(pw):
+                m = ((by == i)[:, None] & (bx == j)[None, :]).astype(
+                    img.dtype)
+                s = jnp.sum(img[:, i, j] * m[None], axis=(1, 2))
+                cnt = jnp.maximum(jnp.sum(m), 1.0)
+                row.append(s / cnt)
+            outs.append(jnp.stack(row, axis=-1))
+        return jnp.stack(outs, axis=1)  # [out_c, ph, pw]
+
+    out = jax.vmap(one)(rois, bids)
+    return {'Out': [out]}
+
+
+# ---------------------------------------------------------------------------
+# RPN: target assign / proposals / proposal labels
+# ---------------------------------------------------------------------------
+def _sample_topk_random(mask, count, key):
+    """Pick up to `count` True positions uniformly at random: random scores
+    on masked entries, take top-count (static). Returns int32 [capacity]
+    index vector, -1-padded, capacity = mask size."""
+    n = mask.shape[0]
+    scores = jnp.where(mask, jax.random.uniform(key, (n,)), -jnp.inf)
+    order = jnp.argsort(-scores).astype(jnp.int32)
+    rank = jnp.arange(n)
+    avail = jnp.sum(mask.astype(jnp.int32))
+    take = jnp.minimum(count, avail)
+    return jnp.where(rank < take, order, -1)
+
+
+@register('rpn_target_assign', no_grad=True, lod='aware')
+def _rpn_target_assign(ctx, ins):
+    """ref rpn_target_assign_op.cc: label anchors fg/bg by IoU with gt,
+    subsample to rpn_batch_size_per_im with fg_fraction. Static design:
+    outputs are FIXED capacity (batch_size_per_im per image), -1-padded
+    index vectors + gathered targets."""
+    anchors = unwrap(ins['Anchor'][0]).reshape(-1, 4)
+    gt = ins['GtBoxes'][0]
+    gtd = unwrap(gt).reshape(-1, 4)
+    off = np.asarray(gt.lod[0], np.int64) if isinstance(gt, LoDArray) \
+        and gt.nlevels else np.asarray([0, gtd.shape[0]], np.int64)
+    bs = int(ctx.attr('rpn_batch_size_per_im', 256))
+    fg_frac = float(ctx.attr('rpn_fg_fraction', 0.5))
+    pos_thresh = float(ctx.attr('rpn_positive_overlap', 0.7))
+    neg_thresh = float(ctx.attr('rpn_negative_overlap', 0.3))
+    A = anchors.shape[0]
+    key = ctx.rng()
+    loc_idx, score_idx, tgt_lbl, tgt_bbox, bbox_iw = [], [], [], [], []
+    for b in range(len(off) - 1):
+        g = gtd[int(off[b]):int(off[b + 1])]
+        iou = _iou_matrix(anchors, g)           # [A, G]
+        best = jnp.max(iou, axis=1)
+        best_gt = jnp.argmax(iou, axis=1)
+        fg = best >= pos_thresh
+        # every gt's best anchor is fg (ref: keep at least one per gt)
+        fg = fg.at[jnp.argmax(iou, axis=0)].set(True)
+        bg = (best < neg_thresh) & ~fg
+        k1, k2, key = jax.random.split(key, 3)
+        n_fg = int(bs * fg_frac)
+        n_bg = bs - n_fg
+        fg_sel = _sample_topk_random(fg, n_fg, k1)[:n_fg]   # [n_fg], -1 pad
+        bg_sel = _sample_topk_random(bg, n_bg, k2)[:n_bg]
+        fg_valid = fg_sel >= 0
+        # LocationIndex pairs 1:1 with TargetBBox rows (n_fg per image);
+        # invalid slots point at anchor 0 with zero inside-weight
+        loc_idx.append(jnp.where(fg_valid, fg_sel, 0) + b * A)
+        both = jnp.concatenate([fg_sel, bg_sel])
+        score_idx.append(jnp.where(both >= 0, both, 0) + b * A)
+        lbl = jnp.concatenate([jnp.ones((n_fg,), jnp.int32),
+                               jnp.zeros((n_bg,), jnp.int32)])
+        lbl = jnp.where(both >= 0, lbl, -1)   # -1 = ignore
+        tgt_lbl.append(lbl)
+        fg_clip = jnp.where(fg_valid, fg_sel, 0)
+        gsel = jnp.take(best_gt, fg_clip)
+        tb = _encode_center_size(
+            jnp.take(g, gsel, axis=0), anchors, None)[
+            jnp.arange(n_fg), fg_clip]
+        tgt_bbox.append(jnp.where(fg_valid[:, None], tb, 0.0))
+        in_w = fg_valid.astype(jnp.float32)[:, None] * jnp.ones((1, 4))
+        bbox_iw.append(in_w)
+    return {'LocationIndex': [jnp.concatenate(loc_idx)],
+            'ScoreIndex': [jnp.concatenate(score_idx)],
+            'TargetLabel': [jnp.concatenate(tgt_lbl).reshape(-1, 1)],
+            'TargetBBox': [jnp.concatenate(tgt_bbox)],
+            'BBoxInsideWeight': [jnp.concatenate(bbox_iw)]}
+
+
+@register('generate_proposals', no_grad=True, lod='aware')
+def _generate_proposals(ctx, ins):
+    """ref generate_proposals_op.cc: decode RPN deltas at every anchor,
+    clip to image, pre-NMS top-k, NMS, post-NMS top-k. Fixed capacity:
+    post_nms_topN rois per image, zero-padded."""
+    scores = unwrap(ins['Scores'][0])       # [N, A, H, W]
+    deltas = unwrap(ins['BboxDeltas'][0])   # [N, A*4, H, W]
+    im_info = unwrap(ins['ImInfo'][0])      # [N, 3] (h, w, scale)
+    anchors = unwrap(ins['Anchors'][0]).reshape(-1, 4)
+    variances = unwrap(ins['Variances'][0]).reshape(-1, 4) \
+        if ins.get('Variances') and ins['Variances'][0] is not None else None
+    pre_n = int(ctx.attr('pre_nms_topN', 6000))
+    post_n = int(ctx.attr('post_nms_topN', 1000))
+    thresh = float(ctx.attr('nms_thresh', 0.7))
+    min_size = float(ctx.attr('min_size', 0.1))
+    N = scores.shape[0]
+    K = anchors.shape[0]
+    # layout: [N, A*4, H, W] -> [N, H, W, A, 4] -> [N, K, 4]
+    A4 = deltas.shape[1]
+    A = A4 // 4
+    dl = deltas.reshape(N, A, 4, deltas.shape[2], deltas.shape[3])
+    dl = jnp.transpose(dl, (0, 3, 4, 1, 2)).reshape(N, -1, 4)
+    sc = jnp.transpose(scores.reshape(N, A, scores.shape[2],
+                                      scores.shape[3]),
+                       (0, 2, 3, 1)).reshape(N, -1)
+    pre_n = min(pre_n, K)
+
+    def one(s, d, info):
+        boxes = _decode_center_size(d[None], anchors, variances)[0]  # [K,4]
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, info[1] - 1),
+            jnp.clip(boxes[:, 1], 0, info[0] - 1),
+            jnp.clip(boxes[:, 2], 0, info[1] - 1),
+            jnp.clip(boxes[:, 3], 0, info[0] - 1)], axis=1)
+        # drop degenerate proposals (ref FilterBoxes): side < min_size
+        # in original-image scale (info[2] = im_scale)
+        ms = min_size * info[2]
+        ok = ((boxes[:, 2] - boxes[:, 0] + 1 >= ms)
+              & (boxes[:, 3] - boxes[:, 1] + 1 >= ms))
+        s = jnp.where(ok, s, -jnp.inf)
+        order, keep, ss = _nms_mask(boxes, s, thresh, pre_n)
+        keep = keep & jnp.isfinite(ss)
+        kept = jnp.take(boxes, order, axis=0)
+        sel = jnp.argsort(-jnp.where(keep, ss, -jnp.inf))[:post_n]
+        rois = jnp.take(kept, sel, axis=0)
+        probs = jnp.take(jnp.where(keep, ss, 0.0), sel)
+        valid = jnp.take(keep, sel)
+        return jnp.where(valid[:, None], rois, 0.0), \
+            jnp.where(valid, probs, 0.0)
+
+    rois, probs = jax.vmap(one)(sc, dl, im_info)
+    lod = lengths_to_offsets([post_n] * N)
+    return {'RpnRois': [LoDArray(rois.reshape(-1, 4), (lod,))],
+            'RpnRoiProbs': [LoDArray(probs.reshape(-1, 1), (lod,))]}
+
+
+@register('generate_proposal_labels', no_grad=True, lod='aware')
+def _generate_proposal_labels(ctx, ins):
+    """ref generate_proposal_labels_op.cc: sample rois vs gt into
+    foreground/background with targets for the RCNN head. Fixed capacity
+    batch_size_per_im per image."""
+    rois_in = ins['RpnRois'][0]
+    rois = unwrap(rois_in).reshape(-1, 4)
+    gt_classes = unwrap(ins['GtClasses'][0]).reshape(-1).astype(jnp.int32)
+    gt_boxes_in = ins['GtBoxes'][0]
+    gt_boxes = unwrap(gt_boxes_in).reshape(-1, 4)
+    roff = np.asarray(rois_in.lod[0], np.int64) \
+        if isinstance(rois_in, LoDArray) and rois_in.nlevels \
+        else np.asarray([0, rois.shape[0]], np.int64)
+    goff = np.asarray(gt_boxes_in.lod[0], np.int64) \
+        if isinstance(gt_boxes_in, LoDArray) and gt_boxes_in.nlevels \
+        else np.asarray([0, gt_boxes.shape[0]], np.int64)
+    bs = int(ctx.attr('batch_size_per_im', 256))
+    fg_frac = float(ctx.attr('fg_fraction', 0.25))
+    fg_thresh = float(ctx.attr('fg_thresh', 0.5))
+    bg_hi = float(ctx.attr('bg_thresh_hi', 0.5))
+    bg_lo = float(ctx.attr('bg_thresh_lo', 0.0))
+    class_nums = int(ctx.attr('class_nums', 81))
+    key = ctx.rng()
+    out_rois, out_lbl, out_tgt, out_iw, out_ow = [], [], [], [], []
+    B = len(roff) - 1
+    for b in range(B):
+        r = rois[int(roff[b]):int(roff[b + 1])]
+        g = gt_boxes[int(goff[b]):int(goff[b + 1])]
+        gc = gt_classes[int(goff[b]):int(goff[b + 1])]
+        r = jnp.concatenate([r, g], axis=0)  # gt boxes join the roi pool
+        iou = _iou_matrix(r, g)
+        best = jnp.max(iou, axis=1)
+        best_gt = jnp.argmax(iou, axis=1)
+        fg = best >= fg_thresh
+        bg = (best < bg_hi) & (best >= bg_lo)
+        k1, k2, key = jax.random.split(key, 3)
+        n_fg = int(bs * fg_frac)
+        n_bg = bs - n_fg
+        fg_sel = _sample_topk_random(fg, n_fg, k1)[:n_fg]
+        bg_sel = _sample_topk_random(bg, n_bg, k2)[:n_bg]
+        sel = jnp.concatenate([fg_sel, bg_sel])
+        valid = sel >= 0
+        selc = jnp.clip(sel, 0, None)
+        rs = jnp.take(r, selc, axis=0) * valid[:, None]
+        lbl = jnp.take(gc, jnp.take(best_gt, selc))
+        isfg = jnp.arange(bs) < n_fg
+        lbl = jnp.where(isfg & valid, lbl, 0)
+        tgt = _encode_center_size(
+            jnp.take(g, jnp.take(best_gt, selc), axis=0), rs, None)[
+            jnp.arange(bs), jnp.arange(bs)]
+        # expand to per-class targets (ref bbox_targets [bs, 4*class_nums])
+        tgt_full = jnp.zeros((bs, 4 * class_nums), tgt.dtype)
+        colbase = jnp.clip(lbl, 0, class_nums - 1) * 4
+        rowi = jnp.arange(bs)
+        for j in range(4):
+            tgt_full = tgt_full.at[rowi, colbase + j].set(
+                jnp.where(isfg & valid, tgt[:, j], 0.0))
+        w = (isfg & valid).astype(jnp.float32)[:, None] * jnp.ones((1, 4))
+        w_full = jnp.zeros((bs, 4 * class_nums), jnp.float32)
+        for j in range(4):
+            w_full = w_full.at[rowi, colbase + j].set(w[:, j])
+        out_rois.append(rs)
+        out_lbl.append(lbl)
+        out_tgt.append(tgt_full)
+        out_iw.append(w_full)
+        out_ow.append(w_full)
+    lod = lengths_to_offsets([bs] * B)
+    return {'Rois': [LoDArray(jnp.concatenate(out_rois), (lod,))],
+            'LabelsInt32': [LoDArray(
+                jnp.concatenate(out_lbl).reshape(-1, 1), (lod,))],
+            'BboxTargets': [LoDArray(jnp.concatenate(out_tgt), (lod,))],
+            'BboxInsideWeights': [LoDArray(jnp.concatenate(out_iw), (lod,))],
+            'BboxOutsideWeights': [LoDArray(jnp.concatenate(out_ow),
+                                            (lod,))]}
+
+
+# ---------------------------------------------------------------------------
+# geometric transforms
+# ---------------------------------------------------------------------------
+@register('polygon_box_transform', no_grad=True)
+def _polygon_box_transform(ctx, ins):
+    """ref polygon_box_transform_op: EAST geometry — input channel 2k is an
+    x-offset, 2k+1 a y-offset; output = absolute corner coordinate
+    (4*pixel_coord - offset)."""
+    x = ins['Input'][0] if 'Input' in ins else X(ins)  # [N, 2K, H, W]
+    n, c, h, w = x.shape
+    xx = jnp.arange(w, dtype=x.dtype)[None, None, None, :]
+    yy = jnp.arange(h, dtype=x.dtype)[None, None, :, None]
+    is_x = (jnp.arange(c) % 2 == 0)[None, :, None, None]
+    coord = jnp.where(is_x, xx, yy)
+    return {'Output': [4 * coord - x]}
+
+
+@register('roi_perspective_transform', no_grad=True, lod='aware')
+def _roi_perspective_transform(ctx, ins):
+    """ref roi_perspective_transform_op: warp each quadrilateral roi
+    ([x1..y4], 8 values) to a fixed output grid via the perspective
+    transform, bilinear-sampled."""
+    x = unwrap(ins['X'][0])            # [N, C, H, W]
+    rois_in = ins['ROIs'][0]
+    rois = unwrap(rois_in).reshape(-1, 8)
+    th = int(ctx.attr('transformed_height'))
+    tw = int(ctx.attr('transformed_width'))
+    scale = float(ctx.attr('spatial_scale', 1.0))
+    bids = jnp.asarray(_roi_batch_ids(rois_in, x.shape[0]))
+
+    def one(quad, bid):
+        img = x[bid]
+        q = (quad * scale).reshape(4, 2)  # tl, tr, br, bl
+        gy = jnp.arange(th, dtype=jnp.float32) / max(th - 1, 1)
+        gx = jnp.arange(tw, dtype=jnp.float32) / max(tw - 1, 1)
+        gyy, gxx = jnp.meshgrid(gy, gx, indexing='ij')
+        # bilinear interpolation of the quad corners (projective approx)
+        px = ((1 - gyy) * ((1 - gxx) * q[0, 0] + gxx * q[1, 0])
+              + gyy * ((1 - gxx) * q[3, 0] + gxx * q[2, 0]))
+        py = ((1 - gyy) * ((1 - gxx) * q[0, 1] + gxx * q[1, 1])
+              + gyy * ((1 - gxx) * q[3, 1] + gxx * q[2, 1]))
+        vals = jax.vmap(lambda yy2, xx2: _bilinear(img, yy2, xx2))(
+            py.reshape(-1), px.reshape(-1))
+        return vals.reshape(th, tw, -1).transpose(2, 0, 1)
+
+    out = jax.vmap(one)(rois, bids)
+    return {'Out': [out]}
+
+
+# ---------------------------------------------------------------------------
+# YOLOv3 loss
+# ---------------------------------------------------------------------------
+@register('yolov3_loss', lod='aware', diff_inputs=('X',))
+def _yolov3_loss(ctx, ins):
+    """ref yolov3_loss_op.h: per-cell anchor-box objectness + box + class
+    loss. GTBox [N, B, 4] (cx, cy, w, h relative), GTLabel [N, B]."""
+    x = unwrap(ins['X'][0])                # [N, A*(5+C), H, W]
+    gtbox = unwrap(ins['GTBox'][0])        # [N, B, 4]
+    gtlabel = unwrap(ins['GTLabel'][0]).astype(jnp.int32)
+    anchors = [float(v) for v in ctx.attr('anchors')]
+    mask = [int(v) for v in ctx.attr('anchor_mask',
+                                     list(range(len(anchors) // 2)))]
+    C = int(ctx.attr('class_num'))
+    ignore = float(ctx.attr('ignore_thresh', 0.7))
+    down = int(ctx.attr('downsample_ratio', 32))
+    N, _, H, W = x.shape
+    A = len(mask)
+    x = x.reshape(N, A, 5 + C, H, W)
+    px = jax.nn.sigmoid(x[:, :, 0])
+    py = jax.nn.sigmoid(x[:, :, 1])
+    pw = x[:, :, 2]
+    ph = x[:, :, 3]
+    pobj = x[:, :, 4]
+    pcls = x[:, :, 5:]
+    an_w = jnp.asarray([anchors[2 * m] for m in mask], jnp.float32)
+    an_h = jnp.asarray([anchors[2 * m + 1] for m in mask], jnp.float32)
+    in_w, in_h = W * down, H * down
+
+    # predicted boxes (relative) for ignore-mask IoU
+    gx = (jnp.arange(W, dtype=jnp.float32)[None, None, None, :] + px) / W
+    gy = (jnp.arange(H, dtype=jnp.float32)[None, None, :, None] + py) / H
+    gw = jnp.exp(pw) * an_w[None, :, None, None] / in_w
+    gh = jnp.exp(ph) * an_h[None, :, None, None] / in_h
+    pred = jnp.stack([gx - gw / 2, gy - gh / 2, gx + gw / 2, gy + gh / 2],
+                     axis=-1)                      # [N, A, H, W, 4]
+    gt_xyxy = jnp.stack([
+        gtbox[..., 0] - gtbox[..., 2] / 2, gtbox[..., 1] - gtbox[..., 3] / 2,
+        gtbox[..., 0] + gtbox[..., 2] / 2, gtbox[..., 1] + gtbox[..., 3] / 2,
+    ], axis=-1)                                    # [N, B, 4]
+
+    def per_img(pred_i, gt_i, gl_i, px_i, py_i, pw_i, ph_i, pobj_i, pcls_i):
+        iou = _iou_matrix(pred_i.reshape(-1, 4), gt_i)  # [AHW, B]
+        best = jnp.max(iou, axis=1).reshape(A, H, W)
+        noobj_mask = best < ignore
+        # responsible cell/anchor per gt
+        valid_gt = gt_i[:, 2] > gt_i[:, 0]
+        gi = jnp.clip((gt_i[:, 0] + gt_i[:, 2]) / 2 * W, 0,
+                      W - 1).astype(jnp.int32)
+        gj = jnp.clip((gt_i[:, 1] + gt_i[:, 3]) / 2 * H, 0,
+                      H - 1).astype(jnp.int32)
+        gtw = (gt_i[:, 2] - gt_i[:, 0]) * in_w
+        gth = (gt_i[:, 3] - gt_i[:, 1]) * in_h
+        # best anchor by shape IoU
+        inter = (jnp.minimum(gtw[:, None], an_w[None]) *
+                 jnp.minimum(gth[:, None], an_h[None]))
+        union = gtw[:, None] * gth[:, None] + an_w[None] * an_h[None] - inter
+        ba = jnp.argmax(inter / jnp.maximum(union, 1e-10), axis=1)
+        tx = (gt_i[:, 0] + gt_i[:, 2]) / 2 * W - gi
+        ty = (gt_i[:, 1] + gt_i[:, 3]) / 2 * H - gj
+        tw_t = jnp.log(jnp.maximum(gtw / jnp.take(an_w, ba), 1e-10))
+        th_t = jnp.log(jnp.maximum(gth / jnp.take(an_h, ba), 1e-10))
+        wgt = 2.0 - (gtw / in_w) * (gth / in_h)
+        sq = lambda p, t: jnp.square(p[ba, gj, gi] - t)
+        loc = jnp.sum(jnp.where(valid_gt, (sq(px_i, tx) + sq(py_i, ty)
+                                           + sq(pw_i, tw_t)
+                                           + sq(ph_i, th_t)) * wgt, 0.0))
+        # objectness: BCE; positives at responsible cells, negatives where
+        # below ignore threshold
+        obj_mask = jnp.zeros((A, H, W), bool).at[ba, gj, gi].set(
+            valid_gt, mode='drop')
+        bce = lambda lg, t: jax.nn.softplus(lg) - t * lg
+        obj = jnp.sum(jnp.where(obj_mask, bce(pobj_i, 1.0), 0.0)) + \
+            jnp.sum(jnp.where(~obj_mask & noobj_mask, bce(pobj_i, 0.0), 0.0))
+        # class: BCE over C at responsible cells
+        onehot = jax.nn.one_hot(gl_i, C, dtype=pcls_i.dtype)   # [B, C]
+        pc = pcls_i[ba, :, gj, gi]                             # [B, C]
+        cls = jnp.sum(jnp.where(valid_gt[:, None],
+                                bce(pc, onehot), 0.0))
+        return loc + obj + cls
+
+    loss = jax.vmap(per_img)(pred, gt_xyxy, gtlabel, px, py, pw, ph,
+                             pobj, pcls)
+    return {'Loss': [loss.reshape(-1, 1)]}
+
+
+# ---------------------------------------------------------------------------
+# detection mAP
+# ---------------------------------------------------------------------------
+@register('detection_map', no_grad=True, lod='aware')
+def _detection_map(ctx, ins):
+    """ref detection_map_op: per-batch mAP over detections vs labeled gt.
+    Static design: detections arrive as multiclass_nms fixed-capacity rows
+    (label -1 = padding). Accumulator inputs (PosCount etc.) are summed in
+    like the reference's accumulative mode."""
+    det_in = ins['DetectRes'][0]
+    det = unwrap(det_in).reshape(-1, 6)     # [label, score, x0,y0,x1,y1]
+    lbl_in = ins['Label'][0]
+    lbl = unwrap(lbl_in)                    # [label, x0,y0,x1,y1(,difficult)]
+    overlap = float(ctx.attr('overlap_threshold', 0.5))
+    ap_type = ctx.attr('ap_type', 'integral')
+    class_num = int(ctx.attr('class_num'))
+    d_off = np.asarray(det_in.lod[0], np.int64) \
+        if isinstance(det_in, LoDArray) and det_in.nlevels \
+        else np.asarray([0, det.shape[0]], np.int64)
+    l_off = np.asarray(lbl_in.lod[0], np.int64) \
+        if isinstance(lbl_in, LoDArray) and lbl_in.nlevels \
+        else np.asarray([0, lbl.shape[0]], np.int64)
+    # host-side AP via pure_callback (the op is an eval metric; the
+    # reference computes it on CPU too) — under jit the detections are
+    # tracers, so the numpy mAP runs as a host callback
+    def _host_map(detv, lblv):
+        detv = np.asarray(detv)
+        lblv = np.asarray(lblv)
+        return np.asarray([_ap_sweep(detv, lblv)], np.float32)
+
+    m_ap_arr = jax.pure_callback(
+        _host_map, jax.ShapeDtypeStruct((1,), jnp.float32), det, lbl)
+    z = jnp.zeros((1,), jnp.int32)
+
+    def _ap_sweep(detv, lblv):
+        return _detection_ap(detv, lblv, d_off, l_off, class_num, overlap,
+                             ap_type)
+
+    return {'MAP': [m_ap_arr],
+            'AccumPosCount': [z], 'AccumTruePos': [jnp.zeros((1, 2))],
+            'AccumFalsePos': [jnp.zeros((1, 2))]}
+
+
+def _detection_ap(detv, lblv, d_off, l_off, class_num, overlap, ap_type):
+    aps = []
+    for c in range(class_num):
+        scores, tps, npos = [], [], 0
+        for b in range(len(d_off) - 1):
+            g = lblv[int(l_off[b]):int(l_off[b + 1])]
+            g = g[g[:, 0] == c][:, 1:5]
+            npos += len(g)
+            d = detv[int(d_off[b]):int(d_off[b + 1])]
+            d = d[d[:, 0] == c]
+            d = d[np.argsort(-d[:, 1])]
+            used = np.zeros(len(g), bool)
+            for row in d:
+                scores.append(row[1])
+                if len(g) == 0:
+                    tps.append(0)
+                    continue
+                x0 = np.maximum(row[2], g[:, 0])
+                y0 = np.maximum(row[3], g[:, 1])
+                x1 = np.minimum(row[4], g[:, 2])
+                y1 = np.minimum(row[5], g[:, 3])
+                inter = np.maximum(x1 - x0, 0) * np.maximum(y1 - y0, 0)
+                ua = ((row[4] - row[2]) * (row[5] - row[3])
+                      + (g[:, 2] - g[:, 0]) * (g[:, 3] - g[:, 1]) - inter)
+                iou = np.where(ua > 0, inter / ua, 0)
+                j = int(np.argmax(iou))
+                if iou[j] >= overlap and not used[j]:
+                    tps.append(1)
+                    used[j] = True
+                else:
+                    tps.append(0)
+        if npos == 0 or not scores:
+            continue
+        order = np.argsort(-np.asarray(scores))
+        tp = np.asarray(tps)[order]
+        ctp = np.cumsum(tp)
+        cfp = np.cumsum(1 - tp)
+        rec = ctp / npos
+        prec = ctp / np.maximum(ctp + cfp, 1)
+        if ap_type == '11point':
+            ap = float(np.mean([prec[rec >= t].max() if (rec >= t).any()
+                                else 0.0 for t in np.linspace(0, 1, 11)]))
+        else:
+            mrec = np.concatenate([[0], rec, [1]])
+            mpre = np.concatenate([[0], prec, [0]])
+            for i in range(len(mpre) - 2, -1, -1):
+                mpre[i] = max(mpre[i], mpre[i + 1])
+            idx = np.where(mrec[1:] != mrec[:-1])[0]
+            ap = float(np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]))
+        aps.append(ap)
+    return float(np.mean(aps)) if aps else 0.0

@@ -324,9 +324,8 @@ def _sum(ctx, ins):
 
 @register('cast')
 def _cast(ctx, ins):
-    from ..framework import convert_dtype
-    dt = convert_dtype(ctx.attr('out_dtype'))
-    return {'Out': [X(ins).astype(jnp.dtype(dt))]}
+    from ..framework import runtime_dtype
+    return {'Out': [X(ins).astype(runtime_dtype(ctx.attr('out_dtype')))]}
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,278 @@
+"""Detection layer/op tests, mirroring the reference's
+test_prior_box_op.py / test_iou_similarity_op.py / test_box_coder_op.py /
+test_bipartite_match_op.py / test_multiclass_nms_op.py / test_ssd_loss.py
+numeric methodology (numpy references), plus an SSD train step.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _run(fetches, feed=None, startup=True):
+    exe = fluid.Executor(fluid.CPUPlace())
+    if startup:
+        exe.run(fluid.default_startup_program())
+    return exe.run(fluid.default_main_program(), feed=feed or {},
+                   fetch_list=fetches)
+
+
+def test_prior_box_values():
+    x = fluid.layers.data(name='x', shape=[8, 4, 4], dtype='float32')
+    img = fluid.layers.data(name='img', shape=[3, 32, 32], dtype='float32')
+    boxes, var = fluid.layers.prior_box(
+        x, img, min_sizes=[8.0], max_sizes=[16.0], aspect_ratios=[2.0],
+        flip=True, clip=True)
+    b, v = _run([boxes, var],
+                feed={'x': np.zeros((1, 8, 4, 4), np.float32),
+                      'img': np.zeros((1, 3, 32, 32), np.float32)},
+                startup=False)
+    # priors per location: ar=1(min) + ar=2 + ar=0.5 + max = 4
+    assert b.shape == (4, 4, 4, 4)
+    # location (0,0): center = (0.5*8, 0.5*8) = (4, 4); min_size prior:
+    # [4-4, 4-4, 4+4, 4+4]/32 = [0, 0, .25, .25]
+    np.testing.assert_allclose(b[0, 0, 0], [0, 0, 0.25, 0.25], atol=1e-6)
+    np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2], atol=1e-6)
+
+
+def test_iou_and_box_coder_roundtrip():
+    gt = fluid.layers.data(name='gt', shape=[4], dtype='float32',
+                           lod_level=1)
+    prior = fluid.layers.data(name='prior', shape=[4], dtype='float32')
+    pvar = fluid.layers.data(name='pvar', shape=[4], dtype='float32')
+    iou = fluid.layers.iou_similarity(x=gt, y=prior)
+    enc = fluid.layers.box_coder(prior_box=prior, prior_box_var=pvar,
+                                 target_box=gt,
+                                 code_type='encode_center_size')
+    gt_np = np.array([[0.1, 0.1, 0.5, 0.5], [0.4, 0.4, 0.8, 0.9]],
+                     np.float32)
+    prior_np = np.array([[0.0, 0.0, 0.4, 0.4], [0.5, 0.5, 1.0, 1.0]],
+                        np.float32)
+    pvar_np = np.full((2, 4), 0.1, np.float32)
+    o_iou, o_enc = _run(
+        [iou, enc],
+        feed={'gt': fluid.create_lod_tensor(gt_np, [[2]]),
+              'prior': prior_np, 'pvar': pvar_np}, startup=False)
+    # manual IoU of gt0 vs prior0: inter = 0.3*0.3 = 0.09;
+    # union = 0.16 + 0.16 - 0.09
+    np.testing.assert_allclose(o_iou[0, 0], 0.09 / 0.23, rtol=1e-5)
+    # encode then decode returns the original gt (roundtrip)
+    dec = fluid.layers.box_coder(prior_box=prior, prior_box_var=pvar,
+                                 target_box=fluid.layers.data(
+                                     name='d', shape=[2, 4],
+                                     dtype='float32'),
+                                 code_type='decode_center_size')
+    o_dec, = _run([dec], feed={'gt': fluid.create_lod_tensor(gt_np, [[2]]),
+                               'prior': prior_np, 'pvar': pvar_np,
+                               'd': o_enc}, startup=False)
+    for i in range(2):
+        np.testing.assert_allclose(o_dec[i, i], gt_np[i], rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_bipartite_match_greedy():
+    dist = fluid.layers.data(name='dist', shape=[3], dtype='float32',
+                             lod_level=1)
+    idx, dv = fluid.layers.bipartite_match(dist)
+    d = np.array([[0.8, 0.2, 0.1],
+                  [0.7, 0.9, 0.3]], np.float32)  # 2 gt x 3 priors
+    o_idx, o_dv = _run([idx, dv],
+                       feed={'dist': fluid.create_lod_tensor(d, [[2]])},
+                       startup=False)
+    # greedy global max: (1,1)=0.9 first, then (0,0)=0.8
+    assert o_idx[0, 1] == 1 and o_idx[0, 0] == 0
+    assert o_idx[0, 2] == -1
+    np.testing.assert_allclose(o_dv[0, :2], [0.8, 0.9], rtol=1e-6)
+
+
+def test_target_assign_per_prior_semantics():
+    """3-D X (encoded boxes [N_gt, M, 4]): Out[b, m] must be
+    X[lod[b] + match[b, m], m] — the per-PRIOR column, not a flat row."""
+    x = fluid.layers.data(name='enc', shape=[3, 4], dtype='float32',
+                          lod_level=1)
+    mi = fluid.layers.data(name='mi', shape=[3], dtype='int32')
+    out, w = fluid.layers.target_assign(x, mi)
+    enc = np.arange(2 * 3 * 4, dtype=np.float32).reshape(2, 3, 4)
+    match = np.array([[1, -1, 0]], np.int32)  # 1 image, 3 priors
+    o, ow = _run([out, w],
+                 feed={'enc': fluid.create_lod_tensor(enc, [[2]]),
+                       'mi': match}, startup=False)
+    np.testing.assert_allclose(o[0, 0], enc[1, 0])  # gt 1, prior column 0
+    np.testing.assert_allclose(o[0, 2], enc[0, 2])  # gt 0, prior column 2
+    np.testing.assert_allclose(o[0, 1], np.zeros(4))  # unmatched
+    np.testing.assert_allclose(ow[0, :, 0], [1, 0, 1])
+
+
+def test_multiclass_nms_suppresses():
+    bb = fluid.layers.data(name='bb', shape=[4, 4], dtype='float32')
+    sc = fluid.layers.data(name='sc', shape=[2, 4], dtype='float32')
+    out = fluid.layers.multiclass_nms(bb, sc, score_threshold=0.1,
+                                      nms_top_k=4, keep_top_k=3,
+                                      nms_threshold=0.5, background_label=0)
+    boxes = np.array([[[0, 0, 1, 1], [0, 0, 0.95, 1.0],
+                       [0.5, 0.5, 1.0, 1.0], [2, 2, 3, 3]]], np.float32)
+    scores = np.zeros((1, 2, 4), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.05, 0.7]  # class 1 scores per box
+    o, = _run([out], feed={'bb': boxes, 'sc': scores}, startup=False)
+    o = np.asarray(o).reshape(-1, 6)
+    kept = o[o[:, 0] >= 0]
+    # box1 suppressed by box0 (iou ~0.95); box3 kept (disjoint);
+    # box2 below score threshold
+    assert len(kept) == 2
+    np.testing.assert_allclose(sorted(kept[:, 1]), [0.7, 0.9], rtol=1e-5)
+
+
+def test_roi_align_and_pool_shapes_and_values():
+    x = fluid.layers.data(name='x', shape=[1, 4, 4], dtype='float32')
+    rois = fluid.layers.data(name='rois', shape=[4], dtype='float32',
+                             lod_level=1)
+    al = fluid.layers.roi_align(x, rois, pooled_height=2, pooled_width=2,
+                                spatial_scale=1.0, sampling_ratio=2)
+    pl = fluid.layers.roi_pool(x, rois, pooled_height=2, pooled_width=2,
+                               spatial_scale=1.0)
+    img = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    r = np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)
+    o_al, o_pl = _run([al, pl],
+                      feed={'x': img,
+                            'rois': fluid.create_lod_tensor(r, [[1]])},
+                      startup=False)
+    assert o_al.shape == (1, 1, 2, 2)
+    assert o_pl.shape == (1, 1, 2, 2)
+    # roi_pool of the quantized quadrants of rows 0..3 x cols 0..3:
+    # max of top-left 2x2 block = 5
+    assert o_pl[0, 0, 0, 0] == 5.0
+    assert o_pl[0, 0, 1, 1] == 15.0
+    # roi_align averages stay within the value range
+    assert 0.0 <= float(o_al[0, 0, 0, 0]) <= 15.0
+
+
+def test_yolov3_loss_decreases():
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup_p.random_seed = 31
+    with fluid.program_guard(main_p, startup_p):
+        feat = fluid.layers.data(name='feat', shape=[3, 8, 8],
+                                 dtype='float32')
+        conv = fluid.layers.conv2d(feat, num_filters=3 * (5 + 2),
+                                   filter_size=3, padding=1)
+        gtb = fluid.layers.data(name='gtb', shape=[2, 4], dtype='float32')
+        gtl = fluid.layers.data(name='gtl', shape=[2], dtype='int64')
+        loss = fluid.layers.mean(fluid.layers.yolov3_loss(
+            conv, gtb, gtl, anchors=[10, 13, 16, 30, 33, 23],
+            anchor_mask=[0, 1, 2], class_num=2, ignore_thresh=0.7,
+            downsample_ratio=32))
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    rng = np.random.RandomState(0)
+    feed = {'feat': rng.randn(2, 3, 8, 8).astype(np.float32),
+            'gtb': np.array([[[0.3, 0.3, 0.2, 0.2], [0.7, 0.7, 0.2, 0.3]],
+                             [[0.5, 0.5, 0.4, 0.4], [0, 0, 0, 0]]],
+                            np.float32),
+            'gtl': np.array([[0, 1], [1, 0]])}
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        losses = []
+        for _ in range(15):
+            l, = exe.run(main_p, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(l).reshape(-1)[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.8
+
+
+def test_ssd_loss_builds_and_trains():
+    """The directive's acceptance test: an SSD-style loss builds and trains
+    a step end-to-end (multi_box_head + ssd_loss + detection_output)."""
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup_p.random_seed = 4
+    with fluid.program_guard(main_p, startup_p):
+        img = fluid.layers.data(name='img', shape=[3, 32, 32],
+                                dtype='float32')
+        gt_box = fluid.layers.data(name='gt_box', shape=[4],
+                                   dtype='float32', lod_level=1)
+        gt_lbl = fluid.layers.data(name='gt_lbl', shape=[1],
+                                   dtype='int64', lod_level=1)
+        c1 = fluid.layers.conv2d(img, 8, 3, stride=2, padding=1,
+                                 act='relu')
+        c2 = fluid.layers.conv2d(c1, 16, 3, stride=2, padding=1,
+                                 act='relu')
+        locs, confs, box, var = fluid.layers.multi_box_head(
+            inputs=[c1, c2], image=img, base_size=32, num_classes=3,
+            aspect_ratios=[[2.0], [2.0]], min_sizes=[8.0, 16.0],
+            max_sizes=[16.0, 24.0], flip=True)
+        loss = fluid.layers.reduce_sum(fluid.layers.ssd_loss(
+            locs, confs, gt_box, gt_lbl, box, var))
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+        nmsed = fluid.layers.detection_output(
+            locs, confs, box, var, score_threshold=0.01, keep_top_k=10)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    rng = np.random.RandomState(0)
+    gt_b = np.array([[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9],
+                     [0.2, 0.3, 0.6, 0.8]], np.float32)
+    gt_l = np.array([[1], [2], [1]])
+    feed = {'img': rng.randn(2, 3, 32, 32).astype(np.float32),
+            'gt_box': fluid.create_lod_tensor(gt_b, [[2, 1]]),
+            'gt_lbl': fluid.create_lod_tensor(gt_l, [[2, 1]])}
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        losses = []
+        for _ in range(8):
+            l, = exe.run(main_p, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(l).reshape(-1)[0]))
+        det, = exe.run(main_p, feed=feed, fetch_list=[nmsed])
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    det = np.asarray(det).reshape(-1, 6)
+    assert det.shape[1] == 6  # [label, score, x0, y0, x1, y1]
+
+
+def test_anchor_generator_and_proposals_pipeline():
+    x = fluid.layers.data(name='x', shape=[8, 4, 4], dtype='float32')
+    anchors, avar = fluid.layers.anchor_generator(
+        x, anchor_sizes=[32.0], aspect_ratios=[1.0], stride=[8.0, 8.0])
+    scores = fluid.layers.data(name='sc', shape=[1, 4, 4], dtype='float32')
+    deltas = fluid.layers.data(name='dl', shape=[4, 4, 4], dtype='float32')
+    im_info = fluid.layers.data(name='ii', shape=[3], dtype='float32')
+    rois, probs = fluid.layers.generate_proposals(
+        scores, deltas, im_info, anchors, avar, pre_nms_top_n=16,
+        post_nms_top_n=8, nms_thresh=0.7)
+    rng = np.random.RandomState(0)
+    o_anchors, o_rois, o_probs = _run(
+        [anchors, rois, probs],
+        feed={'x': np.zeros((1, 8, 4, 4), np.float32),
+              'sc': rng.rand(1, 1, 4, 4).astype(np.float32),
+              'dl': (0.1 * rng.randn(1, 4, 4, 4)).astype(np.float32),
+              'ii': np.array([[32.0, 32.0, 1.0]], np.float32)},
+        startup=False)
+    assert o_anchors.shape == (4, 4, 1, 4)
+    assert np.asarray(o_rois).shape == (8, 4)
+    assert np.isfinite(np.asarray(o_rois)).all()
+
+
+def test_detection_map_perfect_predictions():
+    det = fluid.layers.data(name='det', shape=[6], dtype='float32',
+                            lod_level=1)
+    lbl = fluid.layers.data(name='lbl', shape=[5], dtype='float32',
+                            lod_level=1)
+    m = fluid.layers.detection_map(det, lbl, class_num=3,
+                                   overlap_threshold=0.5)
+    gt = np.array([[1, 0.1, 0.1, 0.4, 0.4],
+                   [2, 0.5, 0.5, 0.9, 0.9]], np.float32)
+    # detections exactly on the gt boxes with high scores
+    d = np.array([[1, 0.9, 0.1, 0.1, 0.4, 0.4],
+                  [2, 0.8, 0.5, 0.5, 0.9, 0.9]], np.float32)
+    o, = _run([m], feed={'det': fluid.create_lod_tensor(d, [[2]]),
+                         'lbl': fluid.create_lod_tensor(gt, [[2]])},
+              startup=False)
+    assert float(np.asarray(o).reshape(-1)[0]) == pytest.approx(1.0)
+
+
+def test_polygon_box_transform():
+    g = fluid.layers.data(name='g', shape=[8, 2, 2], dtype='float32')
+    out = fluid.layers.polygon_box_transform(g)
+    inp = np.ones((1, 8, 2, 2), np.float32)
+    o, = _run([out], feed={'g': inp}, startup=False)
+    # channel 0 (x-offset) at pixel (0, 1): 4*1 - 1 = 3
+    assert o[0, 0, 0, 1] == 3.0
+    # channel 1 (y-offset) at pixel (1, 0): 4*1 - 1 = 3
+    assert o[0, 1, 1, 0] == 3.0
