@@ -29,10 +29,23 @@ _DTYPE_ALIASES = {
 }
 
 
+# reference proto VarType.Type enum values (framework.proto:106) — dtype
+# attrs in reference-saved programs arrive as these ints
+_PROTO_DTYPE = {0: 'bool', 1: 'int16', 2: 'int32', 3: 'int64',
+                4: 'float16', 5: 'float32', 6: 'float64',
+                20: 'uint8', 21: 'int8'}
+PROTO_DTYPE_ENUM = {v: k for k, v in _PROTO_DTYPE.items()}
+
+
 def convert_dtype(dtype):
-    """Canonicalize a dtype spec (str / np.dtype / jnp dtype) to a string."""
+    """Canonicalize a dtype spec (str / np.dtype / jnp dtype / reference
+    VarType enum int) to a string."""
     if dtype is None:
         return None
+    if isinstance(dtype, int) and not isinstance(dtype, bool):
+        if dtype in _PROTO_DTYPE:
+            return _PROTO_DTYPE[dtype]
+        raise TypeError("unknown dtype enum %r" % (dtype,))
     if isinstance(dtype, str):
         s = _DTYPE_ALIASES.get(dtype, dtype)
     else:

@@ -1,0 +1,21 @@
+"""Inference stack (ref: paddle/fluid/inference/).
+
+- predictor: AnalysisPredictor-equivalent serving API (load -> jit -> run
+  with a warm compile cache; ref inference/api/analysis_predictor.cc).
+- ref_format: byte-level readers/writers for the reference's artifact
+  formats — `__model__` ProgramDesc protobuf (framework/framework.proto)
+  and SerializeToStream tensors (framework/lod_tensor.cc:245,
+  tensor_util.cc:372) — so models trained with the reference run here and
+  vice versa.
+The reference's analysis/TensorRT/MKLDNN pass zoo is subsumed by XLA:
+clone(for_test) freezes BN/dropout, XLA does the fusion.
+"""
+from .predictor import Config, Predictor, create_predictor
+from .ref_format import (load_reference_inference_model,
+                         save_reference_inference_model,
+                         load_reference_persistables)
+
+__all__ = ['Config', 'Predictor', 'create_predictor',
+           'load_reference_inference_model',
+           'save_reference_inference_model',
+           'load_reference_persistables']

@@ -1,0 +1,123 @@
+"""Predictor serving API (ref: inference/api/analysis_predictor.cc:77-153,
+paddle_api.h PaddlePredictor).
+
+TPU-native equivalent of the reference pipeline (load -> IR analysis ->
+NaiveExecutor): load -> prune to the feed/fetch subgraph -> jit. The
+reference's analysis passes (conv+bn fold, fc fuse, TensorRT subgraphs)
+are subsumed by XLA fusion; `clone(for_test)` semantics (BN/dropout in
+inference mode) are applied at load when the model was saved from a train
+program. The first run compiles (warmable via `warmup`); subsequent runs
+hit the executor's compiled-step cache, the NaiveExecutor analogue.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+class Config(object):
+    """AnalysisConfig equivalent: where the model lives + how to run it."""
+
+    def __init__(self, model_dir=None, prog_file=None, params_file=None):
+        self.model_dir = model_dir
+        self.prog_file = prog_file
+        self.params_file = params_file
+        self.ref_format = None   # None = autodetect, True/False to force
+        self._place = None
+
+    def set_model(self, model_dir, params_file=None):
+        self.model_dir = model_dir
+        self.params_file = params_file
+
+    def enable_tpu(self):
+        from ..framework import TPUPlace
+        self._place = TPUPlace()
+        return self
+
+    def disable_gpu(self):
+        from ..framework import CPUPlace
+        self._place = CPUPlace()
+        return self
+
+
+class Predictor(object):
+    def __init__(self, config):
+        from ..executor import Executor
+        from ..core.scope import Scope
+        from ..framework import TPUPlace
+        self._config = config
+        self._scope = Scope()
+        self._exe = Executor(config._place or TPUPlace())
+        self._program, self._feed_names, self._fetch_vars = self._load()
+
+    # -- loading -----------------------------------------------------------
+    def _load(self):
+        from ..core.scope import scope_guard
+        from .. import io as ptpu_io
+        from . import ref_format
+        cfg = self._config
+        dirname = cfg.model_dir
+        model_file = cfg.prog_file
+        ref = cfg.ref_format
+        if ref is None:
+            # autodetect: our save_inference_model writes JSON ('{' first);
+            # the reference writes protobuf
+            path = os.path.join(dirname, model_file or '__model__')
+            with open(path, 'rb') as f:
+                first = f.read(1)
+            ref = first != b'{'
+        with scope_guard(self._scope):
+            if ref:
+                return ref_format.load_reference_inference_model(
+                    dirname, self._exe, model_filename=model_file,
+                    params_filename=cfg.params_file, scope=self._scope)
+            return ptpu_io.load_inference_model(
+                dirname, self._exe, model_filename=model_file,
+                params_filename=cfg.params_file)
+
+    # -- serving -----------------------------------------------------------
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_output_names(self):
+        return [v.name for v in self._fetch_vars if v is not None]
+
+    def run(self, inputs):
+        """inputs: list (feed order) or dict name -> array/LoDTensor.
+        Returns list of numpy outputs."""
+        from ..core.scope import scope_guard
+        if isinstance(inputs, (list, tuple)):
+            if len(inputs) != len(self._feed_names):
+                raise ValueError(
+                    "predictor expects %d inputs (%s), got %d"
+                    % (len(self._feed_names), self._feed_names, len(inputs)))
+            feed = dict(zip(self._feed_names, inputs))
+        else:
+            feed = dict(inputs)
+        with scope_guard(self._scope):
+            outs = self._exe.run(self._program, feed=feed,
+                                 fetch_list=[v.name for v in
+                                             self._fetch_vars])
+        return [np.asarray(o) for o in outs]
+
+    def warmup(self, sample_inputs):
+        """Compile ahead of serving (the reference predictor's Prepare)."""
+        self.run(sample_inputs)
+        return self
+
+    def clone(self):
+        """A predictor sharing this one's weights (ref scope sharing for
+        multi-thread serving, analysis_predictor.cc Clone)."""
+        twin = Predictor.__new__(Predictor)
+        twin._config = self._config
+        twin._scope = self._scope           # shared weights
+        twin._exe = self._exe               # shared compiled cache
+        twin._program = self._program
+        twin._feed_names = self._feed_names
+        twin._fetch_vars = self._fetch_vars
+        return twin
+
+
+def create_predictor(config):
+    return Predictor(config)

@@ -216,6 +216,29 @@ def _encode_center_size(target, prior, pvar, normalized=True):
     return out
 
 
+def _encode_rows(target, prior, pvar=None, normalized=True):
+    """1:1 rowwise encode: target [K,4] against prior [K,4] -> [K,4]
+    (avoids the [N,M,4] matrix when each target has one known prior)."""
+    plen = 0.0 if normalized else 1.0
+    pw = prior[:, 2] - prior[:, 0] + plen
+    ph = prior[:, 3] - prior[:, 1] + plen
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    tw = target[:, 2] - target[:, 0] + plen
+    th = target[:, 3] - target[:, 1] + plen
+    tcx = target[:, 0] + tw * 0.5
+    tcy = target[:, 1] + th * 0.5
+    out = jnp.stack([(tcx - pcx) / jnp.maximum(pw, 1e-10),
+                     (tcy - pcy) / jnp.maximum(ph, 1e-10),
+                     jnp.log(jnp.maximum(tw / jnp.maximum(pw, 1e-10),
+                                         1e-10)),
+                     jnp.log(jnp.maximum(th / jnp.maximum(ph, 1e-10),
+                                         1e-10))], axis=-1)
+    if pvar is not None:
+        out = out / pvar
+    return out
+
+
 def _decode_center_size(target, prior, pvar, normalized=True):
     """target [N,M,4] (or [N,4] broadcast) deltas -> boxes [N,M,4]."""
     plen = 0.0 if normalized else 1.0
@@ -241,6 +264,16 @@ def _box_coder(ctx, ins):
     pvar = None
     if ins.get('PriorBoxVar') and ins['PriorBoxVar'][0] is not None:
         pvar = unwrap(ins['PriorBoxVar'][0]).reshape(-1, 4)
+    elif ctx.attr('variance'):
+        # variance as a 4-list attr broadcasts over priors (ref box_coder)
+        pvar = jnp.broadcast_to(
+            jnp.asarray([float(v) for v in ctx.attr('variance')],
+                        jnp.float32), (unwrap(ins['PriorBox'][0])
+                                       .reshape(-1, 4).shape[0], 4))
+    if int(ctx.attr('axis', 0)) != 0:
+        raise NotImplementedError(
+            "box_coder axis=1 (prior per batch row) is not supported; "
+            "tile the priors instead")
     target_in = ins['TargetBox'][0]
     target = unwrap(target_in)
     code_type = ctx.attr('code_type', 'encode_center_size')
@@ -444,7 +477,9 @@ def _multiclass_nms(ctx, ins):
     keep_top_k = int(ctx.attr('keep_top_k', 200))
     B, C, M = scores.shape
     nms_top_k = min(nms_top_k if nms_top_k > 0 else M, M)
-    keep_top_k = keep_top_k if keep_top_k > 0 else C * nms_top_k
+    n_fg_classes = C - (1 if 0 <= bg < C else 0)
+    cap = n_fg_classes * nms_top_k
+    keep_top_k = min(keep_top_k, cap) if keep_top_k > 0 else cap
 
     def one_image(boxes, sc):
         rows = []
@@ -678,9 +713,8 @@ def _rpn_target_assign(ctx, ins):
         tgt_lbl.append(lbl)
         fg_clip = jnp.where(fg_valid, fg_sel, 0)
         gsel = jnp.take(best_gt, fg_clip)
-        tb = _encode_center_size(
-            jnp.take(g, gsel, axis=0), anchors, None)[
-            jnp.arange(n_fg), fg_clip]
+        tb = _encode_rows(jnp.take(g, gsel, axis=0),
+                          jnp.take(anchors, fg_clip, axis=0))
         tgt_bbox.append(jnp.where(fg_valid[:, None], tb, 0.0))
         in_w = fg_valid.astype(jnp.float32)[:, None] * jnp.ones((1, 4))
         bbox_iw.append(in_w)
@@ -708,6 +742,7 @@ def _generate_proposals(ctx, ins):
     min_size = float(ctx.attr('min_size', 0.1))
     N = scores.shape[0]
     K = anchors.shape[0]
+    post_n = min(post_n, K)  # lod rows must match actual capacity
     # layout: [N, A*4, H, W] -> [N, H, W, A, 4] -> [N, K, 4]
     A4 = deltas.shape[1]
     A = A4 // 4
@@ -794,9 +829,7 @@ def _generate_proposal_labels(ctx, ins):
         lbl = jnp.take(gc, jnp.take(best_gt, selc))
         isfg = jnp.arange(bs) < n_fg
         lbl = jnp.where(isfg & valid, lbl, 0)
-        tgt = _encode_center_size(
-            jnp.take(g, jnp.take(best_gt, selc), axis=0), rs, None)[
-            jnp.arange(bs), jnp.arange(bs)]
+        tgt = _encode_rows(jnp.take(g, jnp.take(best_gt, selc), axis=0), rs)
         # expand to per-class targets (ref bbox_targets [bs, 4*class_nums])
         tgt_full = jnp.zeros((bs, 4 * class_nums), tgt.dtype)
         colbase = jnp.clip(lbl, 0, class_nums - 1) * 4
@@ -964,9 +997,13 @@ def _yolov3_loss(ctx, ins):
 @register('detection_map', no_grad=True, lod='aware')
 def _detection_map(ctx, ins):
     """ref detection_map_op: per-batch mAP over detections vs labeled gt.
-    Static design: detections arrive as multiclass_nms fixed-capacity rows
-    (label -1 = padding). Accumulator inputs (PosCount etc.) are summed in
-    like the reference's accumulative mode."""
+
+    Pure-XLA formulation (TPU has no host callbacks): detections arrive as
+    multiclass_nms fixed-capacity rows (label -1 = padding); per class,
+    detections sorted by score greedily claim the best unclaimed gt of the
+    same class+image via a fori_loop over the static detection count, then
+    AP is the integral/11-point precision-recall sweep in masked cumsums.
+    """
     det_in = ins['DetectRes'][0]
     det = unwrap(det_in).reshape(-1, 6)     # [label, score, x0,y0,x1,y1]
     lbl_in = ins['Label'][0]
@@ -980,75 +1017,64 @@ def _detection_map(ctx, ins):
     l_off = np.asarray(lbl_in.lod[0], np.int64) \
         if isinstance(lbl_in, LoDArray) and lbl_in.nlevels \
         else np.asarray([0, lbl.shape[0]], np.int64)
-    # host-side AP via pure_callback (the op is an eval metric; the
-    # reference computes it on CPU too) — under jit the detections are
-    # tracers, so the numpy mAP runs as a host callback
-    def _host_map(detv, lblv):
-        detv = np.asarray(detv)
-        lblv = np.asarray(lblv)
-        return np.asarray([_ap_sweep(detv, lblv)], np.float32)
+    D, G = det.shape[0], lbl.shape[0]
+    d_img = jnp.asarray(np.repeat(np.arange(len(d_off) - 1),
+                                  (d_off[1:] - d_off[:-1])).astype(np.int32))
+    g_img = jnp.asarray(np.repeat(np.arange(len(l_off) - 1),
+                                  (l_off[1:] - l_off[:-1])).astype(np.int32))
+    d_cls = det[:, 0].astype(jnp.int32)
+    d_score = det[:, 1]
+    g_cls = lbl[:, 0].astype(jnp.int32)
+    iou = _iou_matrix(det[:, 2:6], lbl[:, 1:5])          # [D, G]
+    same = (d_img[:, None] == g_img[None, :]) & \
+        (d_cls[:, None] == g_cls[None, :]) & \
+        (d_cls[:, None] >= 0)
+    iou = jnp.where(same, iou, -1.0)
+    order = jnp.argsort(-jnp.where(d_cls >= 0, d_score, -jnp.inf))
 
-    m_ap_arr = jax.pure_callback(
-        _host_map, jax.ShapeDtypeStruct((1,), jnp.float32), det, lbl)
+    def claim(i, carry):
+        used, tp = carry
+        di = order[i]
+        row = jnp.where(used, -1.0, iou[di])
+        j = jnp.argmax(row)
+        hit = (row[j] >= overlap) & (d_cls[di] >= 0)
+        used = used.at[j].set(used[j] | hit)
+        tp = tp.at[di].set(hit)
+        return used, tp
+
+    used0 = jnp.zeros((G,), bool)
+    tp0 = jnp.zeros((D,), bool)
+    _, tp = jax.lax.fori_loop(0, D, claim, (used0, tp0))
+
+    # per-class AP via masked score-ordered cumsums
+    def class_ap(c):
+        mask = (d_cls == c)
+        npos = jnp.sum((g_cls == c).astype(jnp.float32))
+        sc = jnp.where(mask, d_score, -jnp.inf)
+        o = jnp.argsort(-sc)
+        tpo = jnp.take(tp & mask, o).astype(jnp.float32)
+        valid = jnp.isfinite(jnp.take(sc, o)).astype(jnp.float32)
+        ctp = jnp.cumsum(tpo)
+        cnt = jnp.cumsum(valid)
+        rec = ctp / jnp.maximum(npos, 1.0)
+        prec = ctp / jnp.maximum(cnt, 1.0)
+        if ap_type == '11point':
+            ts = jnp.linspace(0.0, 1.0, 11)
+            pmax = jnp.max(jnp.where((rec[None, :] >= ts[:, None])
+                                     & (valid[None, :] > 0), prec[None, :],
+                                     0.0), axis=1)
+            ap = jnp.mean(pmax)
+        else:
+            prev_rec = jnp.concatenate([jnp.zeros((1,)), rec[:-1]])
+            ap = jnp.sum(jnp.where(valid > 0, (rec - prev_rec) * prec, 0.0))
+        has = (npos > 0).astype(jnp.float32)
+        return ap * has, has
+
+    aps, present = jax.vmap(class_ap)(jnp.arange(class_num))
+    m_ap = jnp.sum(aps) / jnp.maximum(jnp.sum(present), 1.0)
     z = jnp.zeros((1,), jnp.int32)
-
-    def _ap_sweep(detv, lblv):
-        return _detection_ap(detv, lblv, d_off, l_off, class_num, overlap,
-                             ap_type)
-
-    return {'MAP': [m_ap_arr],
+    return {'MAP': [m_ap.reshape(1).astype(jnp.float32)],
             'AccumPosCount': [z], 'AccumTruePos': [jnp.zeros((1, 2))],
             'AccumFalsePos': [jnp.zeros((1, 2))]}
 
 
-def _detection_ap(detv, lblv, d_off, l_off, class_num, overlap, ap_type):
-    aps = []
-    for c in range(class_num):
-        scores, tps, npos = [], [], 0
-        for b in range(len(d_off) - 1):
-            g = lblv[int(l_off[b]):int(l_off[b + 1])]
-            g = g[g[:, 0] == c][:, 1:5]
-            npos += len(g)
-            d = detv[int(d_off[b]):int(d_off[b + 1])]
-            d = d[d[:, 0] == c]
-            d = d[np.argsort(-d[:, 1])]
-            used = np.zeros(len(g), bool)
-            for row in d:
-                scores.append(row[1])
-                if len(g) == 0:
-                    tps.append(0)
-                    continue
-                x0 = np.maximum(row[2], g[:, 0])
-                y0 = np.maximum(row[3], g[:, 1])
-                x1 = np.minimum(row[4], g[:, 2])
-                y1 = np.minimum(row[5], g[:, 3])
-                inter = np.maximum(x1 - x0, 0) * np.maximum(y1 - y0, 0)
-                ua = ((row[4] - row[2]) * (row[5] - row[3])
-                      + (g[:, 2] - g[:, 0]) * (g[:, 3] - g[:, 1]) - inter)
-                iou = np.where(ua > 0, inter / ua, 0)
-                j = int(np.argmax(iou))
-                if iou[j] >= overlap and not used[j]:
-                    tps.append(1)
-                    used[j] = True
-                else:
-                    tps.append(0)
-        if npos == 0 or not scores:
-            continue
-        order = np.argsort(-np.asarray(scores))
-        tp = np.asarray(tps)[order]
-        ctp = np.cumsum(tp)
-        cfp = np.cumsum(1 - tp)
-        rec = ctp / npos
-        prec = ctp / np.maximum(ctp + cfp, 1)
-        if ap_type == '11point':
-            ap = float(np.mean([prec[rec >= t].max() if (rec >= t).any()
-                                else 0.0 for t in np.linspace(0, 1, 11)]))
-        else:
-            mrec = np.concatenate([[0], rec, [1]])
-            mpre = np.concatenate([[0], prec, [0]])
-            for i in range(len(mpre) - 2, -1, -1):
-                mpre[i] = max(mpre[i], mpre[i + 1])
-            idx = np.where(mrec[1:] != mrec[:-1])[0]
-            ap = float(np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]))
-        aps.append(ap)
-    return float(np.mean(aps)) if aps else 0.0

@@ -1,0 +1,151 @@
+"""Inference stack tests: predictor serving + reference byte formats.
+
+The reference-format roundtrip is the SURVEY hard-part #6 acceptance: a
+model written in the reference's `__model__` protobuf + SerializeToStream
+params must load and serve here (and our artifacts must parse back).
+"""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _train_small(tmp):
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup_p.random_seed = 12
+    with fluid.program_guard(main_p, startup_p):
+        x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+        h = fluid.layers.fc(x, size=16, act='relu')
+        y = fluid.layers.fc(h, size=3, act='softmax')
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        xs = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        want, = exe.run(main_p, feed={'x': xs}, fetch_list=[y])
+    return main_p, startup_p, scope, x, y, xs, want, exe
+
+
+def test_reference_format_roundtrip(tmp_path):
+    """Write the reference byte formats, read them back, get identical
+    outputs."""
+    d = str(tmp_path / 'ref_model')
+    main_p, startup_p, scope, x, y, xs, want, exe = _train_small(d)
+    from paddle_tpu.inference import (save_reference_inference_model,
+                                      load_reference_inference_model)
+    with fluid.scope_guard(scope):
+        save_reference_inference_model(d, ['x'], [y], exe,
+                                       main_program=main_p)
+    # the __model__ must be protobuf, not our JSON
+    with open(os.path.join(d, '__model__'), 'rb') as f:
+        head = f.read(1)
+    assert head != b'{'
+    # param files carry the tensor-stream magic (u32 version 0)
+    pfiles = [f for f in os.listdir(d) if f != '__model__']
+    assert pfiles
+    with open(os.path.join(d, pfiles[0]), 'rb') as f:
+        assert struct.unpack('<I', f.read(4))[0] == 0
+
+    scope2 = fluid.core.Scope()
+    with fluid.scope_guard(scope2):
+        prog, feeds, fetches = load_reference_inference_model(d, exe,
+                                                              scope=scope2)
+        assert feeds == ['x']
+        got, = exe.run(prog, feed={'x': xs},
+                       fetch_list=[fetches[0].name])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_reference_combined_params(tmp_path):
+    d = str(tmp_path / 'ref_combined')
+    main_p, startup_p, scope, x, y, xs, want, exe = _train_small(d)
+    from paddle_tpu.inference import (save_reference_inference_model,
+                                      load_reference_inference_model)
+    with fluid.scope_guard(scope):
+        save_reference_inference_model(d, ['x'], [y], exe,
+                                       main_program=main_p,
+                                       params_filename='__params__')
+    assert set(os.listdir(d)) == {'__model__', '__params__'}
+    scope2 = fluid.core.Scope()
+    with fluid.scope_guard(scope2):
+        prog, feeds, fetches = load_reference_inference_model(
+            d, exe, params_filename='__params__', scope=scope2)
+        got, = exe.run(prog, feed={'x': xs},
+                       fetch_list=[fetches[0].name])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_serves_both_formats(tmp_path):
+    dref = str(tmp_path / 'm_ref')
+    dnat = str(tmp_path / 'm_nat')
+    main_p, startup_p, scope, x, y, xs, want, exe = _train_small(dref)
+    from paddle_tpu.inference import (save_reference_inference_model,
+                                      Config, create_predictor)
+    with fluid.scope_guard(scope):
+        save_reference_inference_model(dref, ['x'], [y], exe,
+                                       main_program=main_p)
+        fluid.save_inference_model(dnat, ['x'], [y], exe,
+                                   main_program=main_p)
+    for d in (dref, dnat):
+        cfg = Config(model_dir=d)
+        cfg.disable_gpu()
+        pred = create_predictor(cfg).warmup([xs])
+        assert pred.get_input_names() == ['x']
+        out, = pred.run([xs])
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+        # clone shares weights and serves identically
+        out2, = pred.clone().run({'x': xs})
+        np.testing.assert_allclose(out2, want, rtol=1e-6)
+
+
+def test_dtype_enum_attrs_roundtrip(tmp_path):
+    """dtype-valued attrs (cast out_dtype, fill_constant dtype) travel as
+    VarType enum INTS in the reference format and must run after reload."""
+    d = str(tmp_path / 'dtype_model')
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup_p):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        xi = fluid.layers.cast(x, 'int32')
+        y = fluid.layers.cast(xi, 'float32') + fluid.layers.fill_constant(
+            shape=[1], dtype='float32', value=2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    from paddle_tpu.inference import (save_reference_inference_model,
+                                      load_reference_inference_model)
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        save_reference_inference_model(d, ['x'], [y], exe,
+                                       main_program=main_p)
+        prog, feeds, fetches = load_reference_inference_model(d, exe,
+                                                              scope=scope)
+        # the reloaded cast op carries the enum int, not our string
+        casts = [op for op in prog.global_block().ops if op.type == 'cast']
+        assert casts and isinstance(casts[0].attrs['out_dtype'], int)
+        xs = np.array([[1.7, -2.3, 0.5, 3.9]], np.float32)
+        got, = exe.run(prog, feed={'x': xs},
+                       fetch_list=[fetches[0].name])
+    np.testing.assert_allclose(got, np.trunc(xs) + 2.0, rtol=1e-6)
+
+
+def test_lod_tensor_stream_roundtrip(tmp_path):
+    from paddle_tpu.inference.ref_format import (write_tensor_stream,
+                                                 read_tensor_stream)
+    arr = np.random.RandomState(1).randn(6, 3).astype(np.float32)
+    lod = [np.array([0, 2, 6], np.int64)]
+    p = tmp_path / 't.bin'
+    with open(p, 'wb') as f:
+        write_tensor_stream(f, arr, lod)
+    with open(p, 'rb') as f:
+        arr2, lod2 = read_tensor_stream(f)
+    np.testing.assert_allclose(arr2, arr)
+    np.testing.assert_array_equal(lod2[0], lod[0])
+    # int64 tensors survive too
+    ids = np.arange(10, dtype=np.int64).reshape(5, 2)
+    with open(p, 'wb') as f:
+        write_tensor_stream(f, ids, None, with_lod=False)
+    with open(p, 'rb') as f:
+        ids2, _ = read_tensor_stream(f, has_lod=False)
+    np.testing.assert_array_equal(ids2, ids)
