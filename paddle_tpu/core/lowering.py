@@ -89,9 +89,12 @@ class Tracer(object):
         self.env = {}
         self.fetches = []
         self.written = set()
-        # static (host) side-channel: e.g. sequence_pad records the per-seq
-        # lengths so sequence_unpad can rebuild a static lod
+        # static (host) side-channels: sequence_pad records per-seq lengths
+        # so sequence_unpad can rebuild a static lod; assign_value records
+        # its host constant so ops needing trace-time values (e.g.
+        # sequence_slice offsets) can read them even under jit
         self.static_lengths = {}
+        self.host_consts = {}
 
     def read(self, name, op):
         if name in self.env:

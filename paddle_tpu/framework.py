@@ -166,7 +166,18 @@ class Operator(object):
             return []
         if isinstance(v, (Variable, str)):
             v = [v]
-        return [x.name if isinstance(x, Variable) else x for x in v]
+        out = []
+        for x in v:
+            if isinstance(x, Variable):
+                out.append(x.name)
+            elif isinstance(x, str):
+                out.append(x)
+            else:
+                raise TypeError(
+                    "op inputs/outputs must be Variables or names, got %r "
+                    "(wrap constants with layers.assign first)"
+                    % (type(x).__name__,))
+        return out
 
     def __init__(self, block, type, inputs=None, outputs=None, attrs=None):
         self.block = block

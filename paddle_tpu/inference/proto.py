@@ -106,11 +106,10 @@ ATTR_INTS, ATTR_FLOATS, ATTR_STRINGS = 3, 4, 5
 ATTR_BOOLEAN, ATTR_BOOLEANS, ATTR_BLOCK = 6, 7, 8
 ATTR_LONG, ATTR_BLOCKS, ATTR_LONGS = 9, 10, 11
 
-# VarType.Type enum (framework.proto:106)
-DTYPE_BY_ENUM = {0: 'bool', 1: 'int16', 2: 'int32', 3: 'int64',
-                 4: 'float16', 5: 'float32', 6: 'float64',
-                 20: 'uint8', 21: 'int8'}
-ENUM_BY_DTYPE = {v: k for k, v in DTYPE_BY_ENUM.items()}
+# VarType.Type enum (framework.proto:106) — single source of truth lives in
+# framework.py (convert_dtype consumes the same table)
+from ..framework import _PROTO_DTYPE as DTYPE_BY_ENUM
+from ..framework import PROTO_DTYPE_ENUM as ENUM_BY_DTYPE
 VT_LOD_TENSOR, VT_SELECTED_ROWS, VT_FEED, VT_FETCH = 7, 8, 9, 10
 VT_STEP_SCOPES, VT_RANK_TABLE, VT_TENSOR_ARRAY, VT_READER = 11, 12, 13, 15
 VT_RAW = 17
@@ -237,8 +236,6 @@ def parse_op_desc(buf):
             (out['inputs'] if f == 1 else out['outputs'])[slot] = args
         elif f == 4:
             name, atype, value = parse_attr(v)
-            if atype == ATTR_BLOCK:
-                name = 'sub_block' if name == 'sub_block' else name
             out['attrs'][name] = value
     return out
 

@@ -126,13 +126,17 @@ def program_to_desc_bytes(program):
 
 # -- inference model dirs ----------------------------------------------------
 def _feed_fetch_from_program(program):
-    feed_names, fetch_names = [], []
+    feeds, fetches = [], []
     for op in program.global_block().ops:
         if op.type == 'feed':
-            feed_names.append(op.outputs['Out'][0])
+            feeds.append((int(op.attrs.get('col', 0)),
+                          op.outputs['Out'][0]))
         elif op.type == 'fetch':
-            fetch_names.append(op.inputs['X'][0])
-    return feed_names, fetch_names
+            fetches.append((int(op.attrs.get('col', 0)),
+                            op.inputs['X'][0]))
+    # block order of prepended feed ops is reversed; 'col' is authoritative
+    return ([n for _, n in sorted(feeds)],
+            [n for _, n in sorted(fetches)])
 
 
 def load_reference_inference_model(dirname, executor=None,
@@ -208,7 +212,12 @@ def save_reference_inference_model(dirname, feeded_var_names, target_vars,
             for name in persistables:
                 val = scope.get(name)
                 if val is None:
-                    continue
+                    # the combined stream is positional: a silent skip would
+                    # shift every later var's bytes onto the wrong weight
+                    raise ValueError(
+                        "persistable %r has no value in the scope; run the "
+                        "startup program (or load a checkpoint) before "
+                        "saving a combined-params model" % name)
                 arr, lod = _split(val)
                 write_tensor_stream(f, arr, lod)
     else:

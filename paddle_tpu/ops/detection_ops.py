@@ -1035,16 +1035,21 @@ def _detection_map(ctx, ins):
     def claim(i, carry):
         used, tp = carry
         di = order[i]
-        row = jnp.where(used, -1.0, iou[di])
+        # reference semantics (detection_map_op.h:379-403): argmax over ALL
+        # same-class gts; if that gt is already claimed, the det is an FP —
+        # it does NOT fall through to its second-best gt
+        row = iou[di]
         j = jnp.argmax(row)
-        hit = (row[j] >= overlap) & (d_cls[di] >= 0)
+        hit = (row[j] >= overlap) & (d_cls[di] >= 0) & ~used[j]
         used = used.at[j].set(used[j] | hit)
         tp = tp.at[di].set(hit)
         return used, tp
 
-    used0 = jnp.zeros((G,), bool)
-    tp0 = jnp.zeros((D,), bool)
-    _, tp = jax.lax.fori_loop(0, D, claim, (used0, tp0))
+    if G == 0:
+        tp = jnp.zeros((D,), bool)
+    else:
+        _, tp = jax.lax.fori_loop(
+            0, D, claim, (jnp.zeros((G,), bool), jnp.zeros((D,), bool)))
 
     # per-class AP via masked score-ordered cumsums
     def class_ap(c):

@@ -442,7 +442,10 @@ def _bpr_loss(ctx, ins):
     label = ins['Label'][0]
     lab = label.reshape(-1).astype(jnp.int32)
     pos = jnp.take_along_axis(x, lab[:, None], axis=1)
-    diff = -(x - pos)
+    # -log sigmoid(x_pos - x_neg) = log1p(exp(x_neg - x_pos)), averaged over
+    # the negatives (ref bpr_loss_op.h:72 sums -log(1+exp(neg-pos)) and
+    # negates/normalizes)
+    diff = x - pos
     # exclude the positive column itself
     mask = jnp.ones_like(x, dtype=bool).at[jnp.arange(x.shape[0]), lab].set(False)
     loss = jnp.where(mask, jnp.log1p(jnp.exp(diff)), 0.0)

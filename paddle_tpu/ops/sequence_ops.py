@@ -169,7 +169,12 @@ def _sequence_concat(ctx, ins):
         prior = prior + lens[k].astype(jnp.int32)
     if xs[0].is_traced:
         return {'Out': [LoDArray.traced(out, [out_off])]}
-    return {'Out': [LoDArray(out, (np.asarray(out_off),))]}
+    # static: host offsets (jnp values are tracers under jit)
+    host_off = np.zeros(n + 1, np.int64)
+    for x in xs:
+        o = np.asarray(x.lod[0], np.int64)
+        host_off[1:] += o[1:] - o[:-1]
+    return {'Out': [LoDArray(out, (np.cumsum(host_off),))]}
 
 
 @register('sequence_reshape', lod='aware')
@@ -178,10 +183,13 @@ def _sequence_reshape(ctx, ins):
     new_dim = ctx.attr('new_dim')
     d = x.data.shape[1]
     out = x.data.reshape(-1, new_dim)
-    new_off = (x.off_t(0) * d) // new_dim
     if x.is_traced:
-        return {'Out': [LoDArray.traced(out, [new_off])]}
-    return {'Out': [LoDArray(out, (np.asarray(new_off),))]}
+        return {'Out': [LoDArray.traced(out, [(x.off_t(0) * d)
+                                              // new_dim])]}
+    # static mode: offsets stay HOST numpy (under jit every jnp value is a
+    # tracer, even "constants")
+    new_off = (np.asarray(x.lod[0], np.int64) * d) // new_dim
+    return {'Out': [LoDArray(out, (new_off,))]}
 
 
 @register('sequence_reverse', lod='aware')
@@ -204,10 +212,25 @@ def _sequence_reverse(ctx, ins):
 
 @register('sequence_slice', lod='aware')
 def _sequence_slice(ctx, ins):
-    # output rows = sum(Length) -> content-dependent: static mode only
+    # output rows = sum(Length) -> content-dependent: Offset/Length must be
+    # trace-time constants (assign_value host side-channel, or fed numpy
+    # when running eagerly)
     x = ins['X'][0]
-    offset = np.asarray(unwrap(ins['Offset'][0])).reshape(-1)
-    length = np.asarray(unwrap(ins['Length'][0])).reshape(-1)
+
+    def _const(slot):
+        name = ctx.op.inputs[slot][0]
+        if name in ctx.tracer.host_consts:
+            return np.asarray(ctx.tracer.host_consts[name]).reshape(-1)
+        try:
+            return np.asarray(unwrap(ins[slot][0])).reshape(-1)
+        except Exception:
+            raise TypeError(
+                "sequence_slice %s must be a trace-time constant (use "
+                "layers.assign of a numpy array); a fed/computed tensor "
+                "would make the output shape dynamic" % slot)
+
+    offset = _const('Offset')
+    length = _const('Length')
     off = _off(x, 0)
     starts = off[:-1] + offset.astype(np.int64)
     lens = length.astype(np.int64)
@@ -277,17 +300,29 @@ def _row_conv(ctx, ins):
 
 @register('sequence_erase', lod='aware', no_grad=True)
 def _sequence_erase(ctx, ins):
-    # output rows = count of kept tokens -> content-dependent: static mode,
-    # and the DATA must be a trace-time constant (reference erases by value)
-    x = ins['X'][0]
-    tokens = set(ctx.attr('tokens', []))
-    data = np.asarray(unwrap(x))
-    off = _off(x)
-    keep = ~np.isin(data.reshape(-1), list(tokens))
-    seg = segment_ids_from_offsets(off, data.shape[0])
-    lens = np.bincount(np.asarray(seg)[keep], minlength=len(off) - 1)
-    out = jnp.asarray(data.reshape(-1)[keep].reshape(-1, 1))
-    return {'Out': [LoDArray(out, (np.concatenate([[0], np.cumsum(lens)]),))]}
+    """Remove listed tokens from each sequence. The reference compacts rows
+    (dynamic shape); the static-shape formulation keeps the lod and
+    left-aligns survivors within each row span, -1 after — the same
+    convention as ctc_greedy_decoder, which downstream edit_distance /
+    chunk_eval understand."""
+    x = _la(ins['X'][0], 'sequence_erase')
+    tokens = list(ctx.attr('tokens', []))
+    flat = unwrap(x).reshape(-1)
+    T = flat.shape[0]
+    off = x.off_t()
+    seg = seg_ids_t(off, T)
+    segc = jnp.minimum(seg, x.nseq_of() - 1)
+    keep = valid_rows_t(off, T)
+    for tok in tokens:
+        keep &= flat != tok
+    csum = jnp.cumsum(keep.astype(jnp.int32))
+    off32 = off.astype(jnp.int32)
+    seq_base = jnp.take(jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), csum]), jnp.take(off32, segc))
+    rank = csum - 1 - seq_base
+    tgt = jnp.where(keep, jnp.take(off32, segc) + rank, T)
+    out = jnp.full((T,), -1, flat.dtype).at[tgt].set(flat, mode='drop')
+    return {'Out': [x.with_lod_of(out.reshape(-1, 1))]}
 
 
 # ---------------------------------------------------------------------------

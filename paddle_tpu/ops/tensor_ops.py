@@ -78,7 +78,11 @@ def _assign_value(ctx, ins):
         vals = ctx.attr('int32_values') or ctx.attr('int64_values')
     else:
         vals = ctx.attr('fp32_values')
-    return {'Out': [jnp.asarray(vals, dtype=dt).reshape(shape)]}
+    host = np.asarray(vals, dtype=dt).reshape(shape)
+    # host side-channel: trace-time consumers (sequence_slice offsets etc.)
+    # can read the constant even though the jnp value is a tracer under jit
+    ctx.tracer.host_consts[ctx.op.outputs['Out'][0]] = host
+    return {'Out': [jnp.asarray(host)]}
 
 
 @register('shape', no_grad=True)
@@ -563,7 +567,8 @@ def _hash_op(ctx, ins):
     outs = []
     flat = x.reshape(x.shape[0], -1)
     for i in range(num_hash):
-        h = flat * jnp.uint32(2654435761) + jnp.uint32(0x9E3779B9 * (i + 1))
+        h = flat * jnp.uint32(2654435761) + jnp.uint32(
+            (0x9E3779B9 * (i + 1)) & 0xFFFFFFFF)
         h = h ^ (h >> 16)
         h = h * jnp.uint32(0x85EBCA6B)
         h = h ^ (h >> 13)

@@ -101,6 +101,34 @@ def test_predictor_serves_both_formats(tmp_path):
         np.testing.assert_allclose(out2, want, rtol=1e-6)
 
 
+def test_multi_input_feed_order_preserved(tmp_path):
+    """Feed ops are prepended in reverse block order; the 'col' attr is the
+    authoritative ordering and must drive get_input_names."""
+    d = str(tmp_path / 'two_inputs')
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup_p):
+        a = fluid.layers.data(name='a', shape=[2], dtype='float32')
+        b = fluid.layers.data(name='b', shape=[2], dtype='float32')
+        y = a * 2.0 + b
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    from paddle_tpu.inference import (save_reference_inference_model,
+                                      load_reference_inference_model,
+                                      Config, create_predictor)
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        save_reference_inference_model(d, ['a', 'b'], [y], exe,
+                                       main_program=main_p)
+        prog, feeds, fetches = load_reference_inference_model(d, exe,
+                                                              scope=scope)
+    assert feeds == ['a', 'b']
+    av = np.array([[1.0, 2.0]], np.float32)
+    bv = np.array([[10.0, 20.0]], np.float32)
+    pred = create_predictor(Config(model_dir=d))
+    out, = pred.run([av, bv])
+    np.testing.assert_allclose(out, av * 2 + bv, rtol=1e-6)
+
+
 def test_dtype_enum_attrs_roundtrip(tmp_path):
     """dtype-valued attrs (cast out_dtype, fill_constant dtype) travel as
     VarType enum INTS in the reference format and must run after reload."""

@@ -1,0 +1,417 @@
+"""OpTest matrix, part 2: optimizer update math, random ops, and the
+remaining nn/sequence/detection tail — completing at-least-one-check
+coverage of the registered op library (VERDICT r2 directive 5).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import OpTest
+from test_op_matrix import _run_spec, _forward_only, _x
+
+
+# ---------------------------------------------------------------------------
+# optimizer update rules vs numpy (ref operators/optimizers/*)
+# ---------------------------------------------------------------------------
+def _opt_run(op, ins, attrs, outs):
+    t = OpTest()
+    t.op_type = op
+    t.inputs = ins
+    t.attrs = attrs
+    t.outputs = outs
+    t.check_output(atol=1e-5, rtol=1e-5,
+                   no_check_set=[n for n, v in outs.items() if v is None])
+
+
+def test_optimizer_updates_match_numpy():
+    p = _x((4,), seed=1)
+    g = _x((4,), seed=2)
+    lr = np.array([0.1], np.float32)
+
+    # adadelta (ref adadelta_op.h)
+    avg_sq_g = np.abs(_x((4,), seed=3))
+    avg_sq_u = np.abs(_x((4,), seed=4))
+    rho, eps = 0.95, 1e-6
+    nsg = rho * avg_sq_g + (1 - rho) * g * g
+    upd = -np.sqrt((avg_sq_u + eps) / (nsg + eps)) * g
+    nsu = rho * avg_sq_u + (1 - rho) * upd * upd
+    _opt_run('adadelta',
+             {'Param': p, 'Grad': g, 'AvgSquaredGrad': avg_sq_g,
+              'AvgSquaredUpdate': avg_sq_u},
+             {'rho': rho, 'epsilon': eps},
+             {'ParamOut': p + upd, 'AvgSquaredGradOut': nsg,
+              'AvgSquaredUpdateOut': nsu})
+
+    # adamax (ref adamax_op.h)
+    m = _x((4,), seed=5)
+    inf = np.abs(_x((4,), seed=6)) + 0.5
+    b1p = np.array([0.9], np.float32)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    m_out = b1 * m + (1 - b1) * g
+    inf_out = np.maximum(b2 * inf, np.abs(g))
+    p_out = p - (0.1 / (1 - b1p)) * (m_out / (inf_out + eps))
+    _opt_run('adamax',
+             {'Param': p, 'Grad': g, 'LearningRate': lr, 'Moment': m,
+              'InfNorm': inf, 'Beta1Pow': b1p},
+             {'beta1': b1, 'beta2': b2, 'epsilon': eps},
+             {'ParamOut': p_out.astype(np.float32), 'MomentOut': m_out,
+              'InfNormOut': inf_out})
+
+    # decayed_adagrad (ref decayed_adagrad_op.h)
+    mom = np.abs(_x((4,), seed=7))
+    decay, eps = 0.95, 1e-6
+    mo = decay * mom + (1 - decay) * g * g
+    _opt_run('decayed_adagrad',
+             {'Param': p, 'Grad': g, 'LearningRate': lr, 'Moment': mom},
+             {'decay': decay, 'epsilon': eps},
+             {'ParamOut': p - 0.1 * g / (np.sqrt(mo) + eps),
+              'MomentOut': mo})
+
+    # rmsprop (ref rmsprop_op.h, centered=False)
+    ms = np.abs(_x((4,), seed=8))
+    mom2 = _x((4,), seed=9)
+    rho, eps2, mu = 0.95, 1e-6, 0.9
+    ms_out = rho * ms + (1 - rho) * g * g
+    mom_out = mu * mom2 + 0.1 * g / np.sqrt(ms_out + eps2)
+    _opt_run('rmsprop',
+             {'Param': p, 'Grad': g, 'LearningRate': lr,
+              'MeanSquare': ms, 'Moment': mom2},
+             {'decay': rho, 'epsilon': eps2, 'momentum': mu},
+             {'ParamOut': p - mom_out, 'MeanSquareOut': ms_out,
+              'MomentOut': mom_out, 'MeanGradOut': None})
+
+    # proximal_gd (ref proximal_gd_op.h)
+    l1, l2 = 0.01, 0.01
+    prox = p - 0.1 * g
+    po = (np.sign(prox) * np.maximum(np.abs(prox) - 0.1 * l1, 0)
+          / (1 + 0.1 * l2))
+    _opt_run('proximal_gd', {'Param': p, 'Grad': g, 'LearningRate': lr},
+             {'l1': l1, 'l2': l2}, {'ParamOut': po.astype(np.float32)})
+
+
+def test_lars_ftrl_proximal_adagrad_run_and_descend():
+    """Update rules with more intricate accumulators: check they run and
+    step in a descent direction."""
+    p = _x((4,), lo=0.5, hi=1.0, seed=10)
+    g = np.abs(_x((4,), seed=11)) + 0.1
+    lr = np.array([0.1], np.float32)
+    outs = _forward_only('lars_momentum',
+                         {'Param': p, 'Grad': g, 'LearningRate': lr,
+                          'Velocity': np.zeros(4, np.float32)},
+                         {'mu': 0.9, 'lars_coeff': 0.001,
+                          'lars_weight_decay': 0.0005},
+                         outs=('ParamOut', 'VelocityOut'))
+    assert (np.asarray(outs[0]) < p).all()  # positive grad -> param down
+    outs = _forward_only('ftrl',
+                         {'Param': p, 'Grad': g, 'LearningRate': lr,
+                          'SquaredAccumulator': np.zeros(4, np.float32),
+                          'LinearAccumulator': np.zeros(4, np.float32)},
+                         {'l1': 0.0, 'l2': 0.0, 'lr_power': -0.5},
+                         outs=('ParamOut', 'SquaredAccumOut',
+                               'LinearAccumOut'))
+    assert np.isfinite(np.asarray(outs[0])).all()
+    outs = _forward_only('proximal_adagrad',
+                         {'Param': p, 'Grad': g, 'LearningRate': lr,
+                          'Moment': np.zeros(4, np.float32) + 0.1},
+                         {'l1': 0.0, 'l2': 0.0},
+                         outs=('ParamOut', 'MomentOut'))
+    assert (np.asarray(outs[0]) < p).all()
+
+
+def test_average_accumulates():
+    p = _x((4,), seed=12)
+    outs = _forward_only(
+        'average_accumulates',
+        {'param': p,
+         'in_sum_1': np.zeros(4, np.float32),
+         'in_sum_2': np.zeros(4, np.float32),
+         'in_sum_3': np.zeros(4, np.float32),
+         'in_num_accumulates': np.array([0], np.int32),
+         'in_old_num_accumulates': np.array([0], np.int32),
+         'in_num_updates': np.array([0], np.int32)},
+        {'average_window': 10, 'max_average_window': 20,
+         'min_average_window': 5},
+        outs=('out_sum_1', 'out_num_accumulates'))
+    np.testing.assert_allclose(np.asarray(outs[0]), p, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# random ops: shape + statistics
+# ---------------------------------------------------------------------------
+def test_random_ops_statistics():
+    for op, attrs, check in [
+        ('uniform_random', {'shape': [500], 'min': -1.0, 'max': 1.0,
+                            'dtype': 'float32'},
+         lambda v: (-1 <= v).all() and (v <= 1).all() and abs(v.mean()) < 0.2),
+        ('gaussian_random', {'shape': [500], 'mean': 2.0, 'std': 0.5,
+                             'dtype': 'float32'},
+         lambda v: abs(v.mean() - 2.0) < 0.2 and abs(v.std() - 0.5) < 0.2),
+        ('truncated_gaussian_random', {'shape': [500], 'mean': 0.0,
+                                       'std': 1.0, 'dtype': 'float32'},
+         lambda v: (np.abs(v) <= 2.01).all()),
+    ]:
+        v, = _forward_only(op, {}, attrs)
+        assert check(np.asarray(v)), op
+    v, = _forward_only('randperm', {}, {'n': 16, 'dtype': 'int64'})
+    assert sorted(np.asarray(v).tolist()) == list(range(16))
+    probs = np.array([[0.0, 1.0, 0.0]] * 4, np.float32)
+    v, = _forward_only('sampling_id', {'X': probs}, {})
+    assert (np.asarray(v) == 1).all()
+    img = np.arange(36, dtype=np.float32).reshape(1, 1, 6, 6)
+    v, = _forward_only('random_crop', {'X': img}, {'shape': [4, 4]})
+    assert np.asarray(v).shape == (1, 1, 4, 4)
+
+
+# ---------------------------------------------------------------------------
+# nn tail
+# ---------------------------------------------------------------------------
+def test_pad2d_and_pad_constant_like():
+    x = _x((1, 1, 2, 2), seed=13)
+    v, = _forward_only('pad2d', {'X': x},
+                       {'paddings': [1, 1, 1, 1], 'mode': 'constant',
+                        'pad_value': 0.0})
+    assert np.asarray(v).shape == (1, 1, 4, 4)
+    big = np.zeros((3, 4), np.float32)
+    small = _x((2, 3), seed=14)
+    v, = _forward_only('pad_constant_like', {'X': big, 'Y': small},
+                       {'pad_value': 9.0})
+    v = np.asarray(v)
+    assert v.shape == (3, 4)
+    np.testing.assert_allclose(v[:2, :3], small)
+    assert (v[2:, :] == 9.0).all()
+
+
+def test_prelu_and_selu():
+    x = _x((2, 3), away_from=0.0, seed=15)
+    alpha = np.array([0.25], np.float32)
+    _run_spec('prelu', {'X': x, 'Alpha': alpha}, {'mode': 'all'},
+              {'Out': np.where(x > 0, x, 0.25 * x)}, grads=['X'])
+    scale, a = 1.0507009873554805, 1.6732632423543772
+    _run_spec('selu', {'X': x}, {'scale': scale, 'alpha': a},
+              {'Out': np.where(x > 0, scale * x,
+                               scale * a * (np.exp(x) - 1))
+               .astype(np.float32)})
+
+
+def test_log_softmax_and_mean_iou():
+    x = _x((2, 4), seed=16)
+    want = x - np.log(np.exp(x).sum(1, keepdims=True))
+    _run_spec('log_softmax', {'X': x}, {'axis': -1}, {'Out': want},
+              atol=1e-5, rtol=1e-4)
+    pred = np.array([0, 1, 1, 2], np.int32)
+    lab = np.array([0, 1, 2, 2], np.int32)
+    outs = _forward_only('mean_iou',
+                         {'Predictions': pred, 'Labels': lab},
+                         {'num_classes': 3},
+                         outs=('OutMeanIou', 'OutWrong', 'OutCorrect'))
+    # ious: c0 1/1; c1 1/2; c2 1/2 -> mean 2/3
+    np.testing.assert_allclose(np.asarray(outs[0]).reshape(-1)[0],
+                               2.0 / 3.0, rtol=1e-5)
+
+
+def test_grid_and_affine():
+    theta = np.tile(np.array([[[1.0, 0, 0], [0, 1.0, 0]]], np.float32),
+                    (1, 1, 1))
+    grid, = _forward_only('affine_grid', {'Theta': theta},
+                          {'output_shape': [1, 1, 3, 3]},
+                          outs=('Output',))
+    grid = np.asarray(grid)
+    assert grid.shape == (1, 3, 3, 2)
+    # identity affine: corners at +-1
+    np.testing.assert_allclose(grid[0, 0, 0], [-1, -1], atol=1e-5)
+    x = np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3)
+    out, = _forward_only('grid_sampler', {'X': x, 'Grid': grid},
+                         {}, outs=('Output',))
+    np.testing.assert_allclose(np.asarray(out), x, atol=1e-4)
+
+
+def test_data_norm_and_hash():
+    x = _x((4, 3), seed=17)
+    bsize = np.full((3,), 4.0, np.float32)
+    bsum = x.sum(0)
+    bsq = (x * x).sum(0) + 1e-4
+    outs = _forward_only('data_norm',
+                         {'X': x, 'BatchSize': bsize, 'BatchSum': bsum,
+                          'BatchSquareSum': bsq},
+                         {'epsilon': 1e-4}, outs=('Y',))
+    means = bsum / bsize
+    scales = np.sqrt(bsize / bsq)
+    np.testing.assert_allclose(np.asarray(outs[0]), (x - means) * scales,
+                               rtol=1e-4)
+    ids = np.array([[1], [7]], np.int64)
+    v, = _forward_only('hash', {'X': ids},
+                       {'num_hash': 2, 'mod_by': 100})
+    v = np.asarray(v)
+    assert v.shape[-2:] == (2, 1) or v.shape == (2, 2, 1)
+    assert (0 <= v).all() and (v < 100).all()
+
+
+def test_similarity_focus_and_im2sequence():
+    x = np.abs(_x((1, 2, 2, 2), seed=18))
+    v, = _forward_only('similarity_focus', {'X': x},
+                       {'axis': 1, 'indexes': [0]})
+    assert np.asarray(v).shape == x.shape
+    img = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    v, = _forward_only('im2sequence', {'X': img},
+                       {'kernels': [2, 2], 'strides': [2, 2],
+                        'paddings': [0, 0, 0, 0]})
+    v = np.asarray(v)
+    assert v.shape == (4, 4)
+    np.testing.assert_allclose(v[0], [0, 1, 4, 5])
+
+
+def test_conv3d_transpose_shape():
+    x = _x((1, 2, 2, 2, 2), seed=19)
+    w = _x((2, 1, 2, 2, 2), seed=20)
+    v, = _forward_only('conv3d_transpose', {'Input': x, 'Filter': w},
+                       {'strides': [1, 1, 1], 'paddings': [0, 0, 0],
+                        'dilations': [1, 1, 1], 'groups': 1},
+                       outs=('Output',))
+    assert np.asarray(v).shape == (1, 1, 3, 3, 3)
+
+
+# ---------------------------------------------------------------------------
+# the *2 variants + fill/is_empty/lod_reset
+# ---------------------------------------------------------------------------
+def test_shape2_variants_and_fill():
+    x = _x((2, 6), seed=21)
+    for op, attrs, want, outs in [
+        ('reshape2', {'shape': [3, 4]}, x.reshape(3, 4), ('Out', 'XShape')),
+        ('transpose2', {'axis': [1, 0]}, x.T, ('Out', 'XShape')),
+        ('flatten2', {'axis': 1}, x, ('Out', 'XShape')),
+        ('squeeze2', {'axes': []}, x, ('Out', 'XShape')),
+        ('unsqueeze2', {'axes': [0]}, x[None], ('Out', 'XShape')),
+    ]:
+        got = _forward_only(op, {'X': x}, attrs, outs=outs)
+        np.testing.assert_allclose(np.asarray(got[0]), want, rtol=1e-6,
+                                   err_msg=op)
+    v, = _forward_only('fill_zeros_like', {'X': x}, {})
+    assert (np.asarray(v) == 0).all()
+    v, = _forward_only('fill_any_like', {'X': x}, {'value': 3.5})
+    assert (np.asarray(v) == 3.5).all()
+    v, = _forward_only('is_empty', {'X': x}, {})
+    assert not bool(np.asarray(v).reshape(-1)[0])
+
+
+def test_sequence_tail_ops():
+    data = np.arange(12, dtype=np.float32).reshape(6, 2)
+    lod = fluid.create_lod_tensor(data, [[2, 4]])
+    x = fluid.layers.data(name='x', shape=[2], dtype='float32', lod_level=1)
+    outs = [fluid.layers.sequence_reshape(x, new_dim=4),
+            fluid.layers.sequence_slice(
+                x,
+                offset=fluid.layers.assign(np.array([[0], [1]], np.int32)),
+                length=fluid.layers.assign(np.array([[1], [2]], np.int32))),
+            fluid.layers.sequence_concat([x, x])]
+    exe = fluid.Executor(fluid.CPUPlace())
+    rs = exe.run(feed={'x': lod}, fetch_list=outs, return_numpy=False)
+    assert np.asarray(rs[0].data).shape == (3, 4)
+    np.testing.assert_allclose(np.asarray(rs[1].data),
+                               data[[0, 3, 4]], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(rs[2].data)[:4],
+                               np.vstack([data[:2], data[:2]]), rtol=1e-6)
+
+    ids = fluid.create_lod_tensor(
+        np.array([[1], [2], [3]], np.int64), [[3]])
+    xi = fluid.layers.data(name='xi', shape=[1], dtype='int64', lod_level=1)
+    enum = fluid.layers.sequence_enumerate(xi, win_size=2, pad_value=0)
+    er = fluid.layers.sequence_erase(xi, tokens=[2])
+    r2 = exe.run(feed={'x': lod, 'xi': ids}, fetch_list=[enum, er],
+                 return_numpy=False)
+    np.testing.assert_array_equal(np.asarray(r2[0].data),
+                                  [[1, 2], [2, 3], [3, 0]])
+    # static-shape erase: survivors left-aligned, -1 padding after
+    np.testing.assert_array_equal(np.asarray(r2[1].data).reshape(-1),
+                                  [1, 3, -1])
+
+    # sequence_scatter: add updates at (seq row, id) positions
+    base = np.zeros((2, 5), np.float32)
+    xb = fluid.layers.data(name='xb', shape=[5], dtype='float32')
+    sid = fluid.layers.data(name='sid', shape=[1], dtype='int64',
+                            lod_level=1)
+    upd = fluid.layers.data(name='upd', shape=[1], dtype='float32',
+                            lod_level=1)
+    out = fluid.layers.sequence_scatter(xb, sid, upd)
+    got, = exe.run(feed={
+        'x': lod, 'xi': ids,
+        'xb': base,
+        'sid': fluid.create_lod_tensor(np.array([[1], [3]], np.int64),
+                                       [[1, 1]]),
+        'upd': fluid.create_lod_tensor(np.array([[2.0], [5.0]],
+                                                np.float32), [[1, 1]])},
+        fetch_list=[out])
+    want = base.copy()
+    want[0, 1] = 2.0
+    want[1, 3] = 5.0
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_density_prior_box_and_psroi():
+    x = fluid.layers.data(name='x', shape=[4, 2, 2], dtype='float32')
+    img = fluid.layers.data(name='img', shape=[3, 16, 16], dtype='float32')
+    boxes, var = fluid.layers.density_prior_box(
+        x, img, densities=[2], fixed_sizes=[4.0], fixed_ratios=[1.0])
+    exe = fluid.Executor(fluid.CPUPlace())
+    b, = exe.run(feed={'x': np.zeros((1, 4, 2, 2), np.float32),
+                       'img': np.zeros((1, 3, 16, 16), np.float32)},
+                 fetch_list=[boxes])
+    assert np.asarray(b).shape == (2, 2, 4, 4)  # density^2 = 4 priors
+
+    feat = fluid.layers.data(name='feat', shape=[8, 4, 4], dtype='float32')
+    rois = fluid.layers.data(name='rois', shape=[4], dtype='float32',
+                             lod_level=1)
+    pool = fluid.layers.psroi_pool(feat, rois, output_channels=2,
+                                   spatial_scale=1.0, pooled_height=2,
+                                   pooled_width=2)
+    v, = exe.run(feed={
+        'x': np.zeros((1, 4, 2, 2), np.float32),
+        'img': np.zeros((1, 3, 16, 16), np.float32),
+        'feat': np.random.RandomState(0).randn(1, 8, 4, 4)
+        .astype(np.float32),
+        'rois': fluid.create_lod_tensor(
+            np.array([[0, 0, 3, 3]], np.float32), [[1]])},
+        fetch_list=[pool])
+    assert np.asarray(v).shape == (1, 2, 2, 2)
+
+
+def test_rpn_target_assign_and_proposal_labels_shapes():
+    anchors = fluid.layers.data(name='an', shape=[4], dtype='float32')
+    gt = fluid.layers.data(name='gt', shape=[4], dtype='float32',
+                           lod_level=1)
+    bbox_pred = fluid.layers.data(name='bp', shape=[16, 4],
+                                  dtype='float32')
+    cls_logits = fluid.layers.data(name='cl', shape=[16, 1],
+                                   dtype='float32')
+    pred_loc, pred_score, tgt_bbox, tgt_lbl, iw = \
+        fluid.layers.rpn_target_assign(
+            bbox_pred, cls_logits, anchors, anchors, gt,
+            rpn_batch_size_per_im=8, rpn_fg_fraction=0.5)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    an = np.abs(rng.rand(16, 4).astype(np.float32))
+    an[:, 2:] = an[:, :2] + 0.5
+    gtb = np.array([[0.1, 0.1, 0.6, 0.6]], np.float32)
+    outs = exe.run(feed={'an': an,
+                         'gt': fluid.create_lod_tensor(gtb, [[1]]),
+                         'bp': rng.randn(1, 16, 4).astype(np.float32),
+                         'cl': rng.randn(1, 16, 1).astype(np.float32)},
+                   fetch_list=[pred_loc, tgt_bbox, tgt_lbl, iw])
+    # 1:1 pairing between predicted locations and bbox targets
+    assert np.asarray(outs[0]).shape == np.asarray(outs[1]).shape
+    assert np.asarray(outs[2]).shape[0] == 8  # batch_size_per_im
+    assert set(np.asarray(outs[2]).reshape(-1)) <= {-1, 0, 1}
+
+
+def test_roi_perspective_transform_shape():
+    x = fluid.layers.data(name='x', shape=[1, 8, 8], dtype='float32')
+    rois = fluid.layers.data(name='r', shape=[8], dtype='float32',
+                             lod_level=1)
+    out = fluid.layers.roi_perspective_transform(x, rois, 4, 4, 1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    quad = np.array([[1, 1, 6, 1, 6, 6, 1, 6]], np.float32)
+    v, = exe.run(feed={'x': np.random.RandomState(0)
+                       .randn(1, 1, 8, 8).astype(np.float32),
+                       'r': fluid.create_lod_tensor(quad, [[1]])},
+                 fetch_list=[out])
+    assert np.asarray(v).shape == (1, 1, 4, 4)
+    assert np.isfinite(np.asarray(v)).all()
