@@ -19,6 +19,8 @@ from .framework import (Program, Block, Operator, Variable, Parameter,
                         switch_startup_program, convert_dtype,
                         CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace)
 from .executor import Executor, global_scope, scope_guard, Scope
+from .async_executor import AsyncExecutor, DataFeedDesc
+from . import recordio
 from .backward import append_backward, calc_gradient
 from . import layers
 from . import initializer

@@ -1,0 +1,244 @@
+"""AsyncExecutor: multithreaded host ingest feeding the compiled TPU step
+(ref: framework/async_executor.cc:236 RunFromFile,
+executor_thread_worker.cc, framework/data_feed.cc MultiSlotDataFeed,
+python/paddle/fluid/async_executor.py).
+
+Architectural inversion: the reference runs one CPU interpreter per thread
+over a shared param scope (Hogwild); on TPU there is ONE compiled step and
+the host's job is to keep it fed. So thread_num here parallelizes the
+INGEST — file reading + MultiSlot text parsing (native C++ parser when
+built) — into a bounded batch queue drained by the device train loop.
+Throughput-equivalent for the CTR workload, deterministic by
+construction (single optimizer stream, no lock-free races).
+"""
+from __future__ import annotations
+
+import glob as _glob
+import queue as _queue
+import threading
+
+import numpy as np
+
+from .framework import Program, default_main_program
+from .executor import Executor
+from .core.scope import global_scope
+from .lod_tensor import create_lod_tensor
+
+
+class DataFeedDesc(object):
+    """Minimal reader of the reference's data_feed.proto prototxt
+    (fluid.DataFeedDesc): batch_size + multi_slot_desc.slots with
+    name/type/is_dense/is_used."""
+
+    def __init__(self, proto_file_or_text):
+        try:
+            with open(proto_file_or_text) as f:
+                text = f.read()
+        except (OSError, ValueError):
+            text = proto_file_or_text
+        self.batch_size = 32
+        self.slots = []   # dicts: name, type, is_dense, is_used
+        # tokenize so both one-line and multi-line prototxt parse
+        import re
+        toks = re.findall(r'[A-Za-z_][A-Za-z_0-9]*|"[^"]*"|[{}:]|[-0-9.]+',
+                          text)
+        cur = None
+        i = 0
+        while i < len(toks):
+            t = toks[i]
+            if t == 'batch_size' and i + 2 < len(toks):
+                self.batch_size = int(toks[i + 2])
+                i += 3
+            elif t == 'slots':
+                cur = {'name': '', 'type': 'uint64', 'is_dense': False,
+                       'is_used': True}
+                self.slots.append(cur)
+                i += 1
+            elif cur is not None and t in ('name', 'type', 'is_dense',
+                                           'is_used') \
+                    and i + 2 < len(toks) and toks[i + 1] == ':':
+                v = toks[i + 2].strip('"')
+                if t in ('is_dense', 'is_used'):
+                    cur[t] = v.lower() == 'true'
+                else:
+                    cur[t] = v
+                i += 3
+            else:
+                i += 1
+
+    def set_batch_size(self, bs):
+        self.batch_size = int(bs)
+
+    def set_use_slots(self, names):
+        for s in self.slots:
+            s['is_used'] = s['name'] in names
+
+    def set_dense_slots(self, names):
+        for s in self.slots:
+            s['is_dense'] = s['name'] in names
+
+    def desc(self):
+        return self.__dict__
+
+
+def parse_multislot_lines(text, slots):
+    """Parse MultiSlot lines -> per-slot (values list, lengths list).
+    Uses the native C++ parser when built; numpy-python fallback."""
+    from . import recordio as _rio
+    lib = _rio._native()
+    n = len(slots)
+    if lib is not None and not hasattr(lib, '_ms_ready'):
+        import ctypes
+        lib.multislot_parse.restype = ctypes.c_int64
+        lib.multislot_parse.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.multislot_free.argtypes = [
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),
+            ctypes.c_uint32]
+        lib._ms_ready = True
+    if lib is not None:
+        import ctypes
+        buf = text.encode() if isinstance(text, str) else text
+        types = (ctypes.c_uint8 * n)(*[0 if s['type'] != 'float' else 1
+                                       for s in slots])
+        vals = (ctypes.POINTER(ctypes.c_double) * n)()
+        lens = (ctypes.POINTER(ctypes.c_uint64) * n)()
+        counts = (ctypes.c_uint64 * n)()
+        lines = ctypes.c_uint64()
+        rc = lib.multislot_parse(buf, len(buf), n, types, vals, lens,
+                                 counts, ctypes.byref(lines))
+        if rc < 0:
+            raise ValueError("malformed MultiSlot line %d" % (-rc))
+        out = []
+        for i in range(n):
+            v = np.ctypeslib.as_array(vals[i], shape=(counts[i],)).copy()
+            if slots[i]['type'] != 'float':
+                # int64 bits traveled in the double buffer (full precision)
+                v = v.view(np.int64)
+            l = np.ctypeslib.as_array(lens[i],
+                                      shape=(lines.value,)).copy()
+            out.append((v, l.astype(np.int64)))
+        lib.multislot_free(vals, lens, n)
+        return out, int(lines.value)
+    # fallback: python parse
+    per_vals = [[] for _ in range(n)]
+    per_lens = [[] for _ in range(n)]
+    lines = 0
+    for line in (text.splitlines() if isinstance(text, str)
+                 else text.decode().splitlines()):
+        toks = line.split()
+        if not toks:
+            continue
+        pos = 0
+        for i in range(n):
+            cnt = int(toks[pos])
+            pos += 1
+            if slots[i]['type'] != 'float':
+                per_vals[i].extend(int(t) for t in toks[pos:pos + cnt])
+            else:
+                per_vals[i].extend(float(t) for t in toks[pos:pos + cnt])
+            per_lens[i].append(cnt)
+            pos += cnt
+        lines += 1
+    return [(np.asarray(v, np.int64 if s['type'] != 'float'
+                        else np.float64), np.asarray(l, np.int64))
+            for (v, l), s in zip(zip(per_vals, per_lens), slots)], lines
+
+
+class AsyncExecutor(object):
+    """run(program, data_feed, filelist, thread_num, fetch, ...) — the
+    reference's file-driven train loop, with threads on ingest."""
+
+    def __init__(self, place=None):
+        self._exe = Executor(place)
+
+    def run(self, program, data_feed, filelist, thread_num, fetch=None,
+            mode='', debug=False, epochs=1, scope=None):
+        program = program or default_main_program()
+        scope = scope or global_scope()
+        if isinstance(filelist, str):
+            filelist = sorted(_glob.glob(filelist))
+        if not filelist:
+            raise ValueError("AsyncExecutor.run: empty filelist")
+        # parse ALL slots (the file contains every slot), feed only is_used
+        # ones — reference MultiSlotDataFeed semantics
+        slots = list(data_feed.slots)
+        bs = data_feed.batch_size
+        fetch = fetch or []
+        fetch_names = [f if isinstance(f, str) else f.name for f in fetch]
+
+        batches = _queue.Queue(maxsize=max(2 * thread_num, 4))
+        stop = object()
+        errors = []
+
+        def ingest(paths):
+            try:
+                for path in paths:
+                    with open(path, 'rb') as f:
+                        parsed, nlines = parse_multislot_lines(f.read(),
+                                                               slots)
+                    # slice into batches
+                    offs = [np.concatenate([[0], np.cumsum(l)])
+                            for _, l in parsed]
+                    for start in range(0, nlines, bs):
+                        end = min(start + bs, nlines)
+                        feed = {}
+                        for (vals, lens), off, slot in zip(parsed, offs,
+                                                           slots):
+                            if not slot['is_used']:
+                                continue
+                            seg = vals[off[start]:off[end]]
+                            seg_lens = lens[start:end]
+                            if slot['type'] == 'float':
+                                arr = seg.astype(np.float32)
+                            else:
+                                arr = seg.astype(np.int64)
+                            if slot['is_dense']:
+                                feed[slot['name']] = arr.reshape(
+                                    end - start, -1)
+                            else:
+                                feed[slot['name']] = create_lod_tensor(
+                                    arr.reshape(-1, 1), [list(seg_lens)])
+                        batches.put(feed)
+            except Exception as e:  # propagate to the train loop
+                errors.append(e)
+
+        results = []
+        from .core.scope import scope_guard
+        for _epoch in range(max(1, int(epochs))):
+            shards = [filelist[i::thread_num] for i in range(thread_num)]
+            threads = [threading.Thread(target=ingest, args=(s,),
+                                        daemon=True)
+                       for s in shards if s]
+
+            def closer(ts=threads):
+                for t in ts:
+                    t.join()
+                batches.put(stop)
+
+            for t in threads:
+                t.start()
+            threading.Thread(target=closer, daemon=True).start()
+
+            with scope_guard(scope):
+                while True:
+                    feed = batches.get()
+                    if feed is stop:
+                        break
+                    outs = self._exe.run(program, feed=feed,
+                                         fetch_list=fetch_names)
+                    if fetch_names:
+                        results.append([np.asarray(o) for o in outs])
+                        if debug:
+                            print('AsyncExecutor:',
+                                  {n: np.asarray(o).reshape(-1)[:3]
+                                   for n, o in zip(fetch_names, outs)})
+            if errors:
+                raise errors[0]
+        return results

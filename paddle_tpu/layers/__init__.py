@@ -15,7 +15,9 @@ from .tensor import (create_tensor, create_parameter, create_global_var,  # noqa
                      ones, zeros, zeros_like, reverse, has_inf, has_nan,
                      isfinite, tensor_array_to_tensor, range)
 from .io import (data, read_file, load, py_reader,  # noqa: F401
-                 create_py_reader_by_data, double_buffer, batch, shuffle)
+                 create_py_reader_by_data, double_buffer, batch,
+                 shuffle, open_files, random_data_generator,
+                 Preprocessor)
 from .sequence import (sequence_pool, sequence_first_step,  # noqa: F401
                        sequence_last_step, sequence_softmax, sequence_conv,
                        sequence_expand, sequence_expand_as, sequence_concat,

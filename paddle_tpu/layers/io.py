@@ -29,6 +29,16 @@ def data(name, shape, append_batch_size=True, dtype='float32', lod_level=0,
     return var
 
 
+
+
+def _register_reader(reader):
+    program = default_main_program()
+    if not hasattr(program, '_py_readers'):
+        program._py_readers = []
+    program._py_readers.append(reader)
+    return reader
+
+
 def read_file(reader):
     """Pops one batch worth of variables from a pipeline reader."""
     return reader.read()
@@ -52,22 +62,14 @@ def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
             dtype=dtype, lod_level=lod, stop_gradient=True, is_data=True)
         feed_vars.append(v)
     reader = PyReader(feed_vars, capacity, use_double_buffer)
-    program = default_main_program()
-    if not hasattr(program, '_py_readers'):
-        program._py_readers = []
-    program._py_readers.append(reader)
-    return reader
+    return _register_reader(reader)
 
 
 def create_py_reader_by_data(capacity, feed_list, name=None,
                              use_double_buffer=True):
     from ..reader.pipeline import PyReader
     reader = PyReader(list(feed_list), capacity, use_double_buffer)
-    program = default_main_program()
-    if not hasattr(program, '_py_readers'):
-        program._py_readers = []
-    program._py_readers.append(reader)
-    return reader
+    return _register_reader(reader)
 
 
 def double_buffer(reader, place=None, name=None):
@@ -88,3 +90,113 @@ def load(out, file_path, load_as_fp16=None):
     helper = LayerHelper('load')
     helper.append_op(type='load', inputs={}, outputs={'Out': [out]},
                      attrs={'file_path': file_path})
+
+
+def open_files(filenames, shapes, lod_levels, dtypes, thread_num=1,
+               buffer_size=None, pass_num=1, is_test=None):
+    """Reader over RecordIO files (ref io.py:825 open_files +
+    operators/reader/create_recordio_file_reader_op.cc). Each record holds
+    one serialized LoDTensor per slot (the reference's WriteToRecordIO
+    framing); decoded through the reference-format tensor stream codec."""
+    import io as _io
+    import numpy as np
+    from ..reader.pipeline import PyReader
+    from ..inference.ref_format import read_tensor_stream
+    from .. import recordio as _rio
+    from ..lod_tensor import create_lod_tensor
+    from .. import unique_name
+
+    helper = LayerHelper('open_files')
+    base = unique_name.generate('open_files')
+    feed_vars = []
+    for i, (shape, dtype, lod) in enumerate(zip(shapes, dtypes, lod_levels)):
+        v = helper.block.create_var(
+            name='%s_slot_%d' % (base, i), shape=list(shape), dtype=dtype,
+            lod_level=lod, stop_gradient=True, is_data=True)
+        feed_vars.append(v)
+    reader = PyReader(feed_vars, capacity=buffer_size or 64,
+                      use_double_buffer=True)
+
+    def gen():
+        for _ in range(pass_num):
+            for path in ([filenames] if isinstance(filenames, str)
+                         else filenames):
+                for rec in _rio.Scanner(path):
+                    buf = _io.BytesIO(rec)
+                    vals = []
+                    for shape, lod in zip(shapes, lod_levels):
+                        arr, lod_info = read_tensor_stream(buf)
+                        if lod and lod_info:
+                            lens = [list(np.diff(l)) for l in lod_info]
+                            vals.append(create_lod_tensor(arr, lens))
+                        else:
+                            vals.append(arr)
+                    yield vals
+
+    reader.decorate_tensor_provider(gen)
+    return _register_reader(reader)
+
+
+def random_data_generator(low, high, shapes, lod_levels=None):
+    """Synthetic uniform-batch reader (ref io.py random_data_generator /
+    create_random_data_generator_op.cc) — reader-chain testing without
+    files."""
+    import numpy as np
+    from ..reader.pipeline import PyReader
+    from .. import unique_name
+    helper = LayerHelper('random_data_generator')
+    base = unique_name.generate('rand_reader')
+    feed_vars = []
+    for i, shape in enumerate(shapes):
+        v = helper.block.create_var(
+            name='%s_slot_%d' % (base, i), shape=list(shape),
+            dtype='float32', lod_level=(lod_levels or [0] * len(shapes))[i],
+            stop_gradient=True, is_data=True)
+        feed_vars.append(v)
+    reader = PyReader(feed_vars, capacity=8, use_double_buffer=True)
+    rng = np.random.RandomState(0)
+
+    def gen():
+        while True:
+            yield [rng.uniform(low, high, [abs(s) for s in shape])
+                   .astype(np.float32) for shape in shapes]
+
+    reader.decorate_tensor_provider(gen)
+    return _register_reader(reader)
+
+
+class Preprocessor(object):
+    """Host-side reader transform (ref io.py Preprocessor). The reference
+    splices a preprocessing sub-block into the reader chain; here the
+    transform runs in the feeding thread:
+
+        p = Preprocessor(reader)
+        @p.transform
+        def _(imgs, labels):
+            return (imgs - mean) / std, labels
+    """
+
+    def __init__(self, reader, name=None):
+        self._reader = reader
+        self._fn = None
+
+    def transform(self, fn):
+        self._fn = fn
+        base = self._reader._feeder_fn
+        if base is None:
+            raise ValueError("decorate the reader with a provider before "
+                             "attaching a Preprocessor transform")
+        names = self._reader.var_names
+
+        def wrapped():
+            for feed in base():
+                out = self._fn(*[feed[n] for n in names])
+                if not isinstance(out, (tuple, list)):
+                    out = [out]
+                yield dict(zip(names, out))
+
+        self._reader._feeder_fn = wrapped
+        return fn
+
+    def __getattr__(self, item):
+        return getattr(self._reader, item)

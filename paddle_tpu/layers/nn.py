@@ -1423,3 +1423,33 @@ def get_tensor_from_selected_rows(x, name=None):
     helper.append_op(type='get_tensor_from_selected_rows', inputs={'X': x},
                      outputs={'Out': out})
     return out
+
+
+# ---------------------------------------------------------------------------
+# py_func (ref nn.py py_func / operators/py_func_op.cc): run arbitrary host
+# python inside the graph
+# ---------------------------------------------------------------------------
+_PY_FUNC_REGISTRY = []
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-python op via jax.pure_callback: `func` receives numpy arrays
+    and must return arrays matching `out`'s declared shape/dtype.
+    backward_func receives (inputs + outputs + output grads) minus any
+    vars listed in skip_vars_in_backward_input, and returns the input
+    grads — reference py_func semantics (operators/py_func_op.cc).
+    Requires a backend with host callbacks (CPU; the axon TPU tunnel does
+    not support them)."""
+    helper = LayerHelper('py_func')
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    skip = skip_vars_in_backward_input or []
+    skip = skip if isinstance(skip, (list, tuple)) else [skip]
+    skip_names = {v.name if hasattr(v, 'name') else v for v in skip}
+    _PY_FUNC_REGISTRY.append((func, backward_func, skip_names))
+    helper.append_op(
+        type='py_func', inputs={'X': list(xs)},
+        outputs={'Out': list(outs)},
+        attrs={'func_id': len(_PY_FUNC_REGISTRY) - 1},
+        infer_shape=False)
+    return outs if isinstance(out, (list, tuple)) else outs[0]

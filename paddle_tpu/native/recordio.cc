@@ -1,0 +1,243 @@
+// Native runtime: RecordIO codec + MultiSlot text parsing.
+//
+// Byte format re-derived from the reference (recordio/header.cc:40-55,
+// chunk.cc:79-118): a chunk is a 5-field little-endian u32 header
+// [magic 0x01020304, num_records, crc32(payload), compressor,
+// payload_size] followed by the (optionally deflate-compressed) payload of
+// records, each [u32 size][bytes]. Compressor: 0 none, 2 gzip (zlib).
+//
+// The MultiSlot parser is the AsyncExecutor ingest hot path
+// (framework/data_feed.cc MultiSlotDataFeed): text lines of
+// "<n> v1..vn" per slot, parsed here with no Python in the loop.
+//
+// Exposed as a C ABI consumed via ctypes (paddle_tpu/recordio.py); the
+// Python side falls back to a pure-Python codec when the .so is absent.
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <zlib.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x01020304;
+
+struct Writer {
+  FILE* f;
+  std::vector<std::string> records;
+  size_t pending_bytes;
+  size_t max_chunk_bytes;
+  uint32_t compressor;
+};
+
+struct Scanner {
+  FILE* f;
+  std::vector<std::string> records;
+  size_t cursor;
+};
+
+bool write_chunk(Writer* w) {
+  if (w->records.empty()) return true;
+  std::string payload;
+  payload.reserve(w->pending_bytes + 4 * w->records.size());
+  for (const auto& r : w->records) {
+    uint32_t sz = static_cast<uint32_t>(r.size());
+    payload.append(reinterpret_cast<const char*>(&sz), 4);
+    payload.append(r);
+  }
+  std::string out;
+  if (w->compressor == 2) {  // gzip/deflate
+    uLongf bound = compressBound(payload.size());
+    out.resize(bound);
+    if (compress(reinterpret_cast<Bytef*>(&out[0]), &bound,
+                 reinterpret_cast<const Bytef*>(payload.data()),
+                 payload.size()) != Z_OK)
+      return false;
+    out.resize(bound);
+  } else {
+    out = payload;
+  }
+  uint32_t crc = static_cast<uint32_t>(
+      crc32(crc32(0, nullptr, 0), reinterpret_cast<const Bytef*>(out.data()),
+            out.size()));
+  uint32_t hdr[5] = {kMagic, static_cast<uint32_t>(w->records.size()), crc,
+                     w->compressor, static_cast<uint32_t>(out.size())};
+  if (fwrite(hdr, 4, 5, w->f) != 5) return false;
+  if (fwrite(out.data(), 1, out.size(), w->f) != out.size()) return false;
+  w->records.clear();
+  w->pending_bytes = 0;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* rio_writer_open(const char* path, uint32_t compressor,
+                      uint64_t max_chunk_bytes) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  auto* w = new Writer{f, {}, 0, max_chunk_bytes ? max_chunk_bytes : (1u << 20),
+                       compressor};
+  return w;
+}
+
+int rio_writer_append(void* h, const char* data, uint64_t len) {
+  auto* w = static_cast<Writer*>(h);
+  w->records.emplace_back(data, len);
+  w->pending_bytes += len;
+  if (w->pending_bytes >= w->max_chunk_bytes) {
+    return write_chunk(w) ? 0 : -1;
+  }
+  return 0;
+}
+
+int rio_writer_close(void* h) {
+  auto* w = static_cast<Writer*>(h);
+  bool ok = write_chunk(w);
+  fclose(w->f);
+  delete w;
+  return ok ? 0 : -1;
+}
+
+void* rio_scanner_open(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  return new Scanner{f, {}, 0};
+}
+
+// returns record length and sets *data to an internal buffer valid until
+// the next call; -1 = EOF, -2 = corrupt
+int64_t rio_scanner_next(void* h, const char** data) {
+  auto* s = static_cast<Scanner*>(h);
+  while (s->cursor >= s->records.size()) {
+    uint32_t hdr[5];
+    if (fread(hdr, 4, 5, s->f) != 5) return -1;  // EOF
+    if (hdr[0] != kMagic) return -2;
+    std::string raw(hdr[4], '\0');
+    if (fread(&raw[0], 1, raw.size(), s->f) != raw.size()) return -2;
+    uint32_t crc = static_cast<uint32_t>(
+        crc32(crc32(0, nullptr, 0),
+              reinterpret_cast<const Bytef*>(raw.data()), raw.size()));
+    if (crc != hdr[2]) return -2;
+    std::string payload;
+    if (hdr[3] == 2) {
+      // deflate payloads don't record the raw size; grow until it fits
+      uLongf cap = raw.size() * 4 + 1024;
+      for (;;) {
+        payload.resize(cap);
+        uLongf got = cap;
+        int rc = uncompress(reinterpret_cast<Bytef*>(&payload[0]), &got,
+                            reinterpret_cast<const Bytef*>(raw.data()),
+                            raw.size());
+        if (rc == Z_OK) { payload.resize(got); break; }
+        if (rc != Z_BUF_ERROR) return -2;
+        cap *= 2;
+      }
+    } else if (hdr[3] == 0) {
+      payload.swap(raw);
+    } else {
+      return -2;  // snappy not supported in the native codec
+    }
+    s->records.clear();
+    s->cursor = 0;
+    size_t pos = 0;
+    for (uint32_t i = 0; i < hdr[1]; ++i) {
+      if (pos + 4 > payload.size()) return -2;
+      uint32_t sz;
+      memcpy(&sz, payload.data() + pos, 4);
+      pos += 4;
+      if (pos + sz > payload.size()) return -2;
+      s->records.emplace_back(payload.data() + pos, sz);
+      pos += sz;
+    }
+    if (s->records.empty()) continue;  // empty chunk: read the next one
+  }
+  const std::string& r = s->records[s->cursor++];
+  *data = r.data();
+  return static_cast<int64_t>(r.size());
+}
+
+void rio_scanner_close(void* h) {
+  auto* s = static_cast<Scanner*>(h);
+  fclose(s->f);
+  delete s;
+}
+
+// ---------------------------------------------------------------------------
+// MultiSlot text parsing (ref framework/data_feed.cc MultiSlotDataFeed):
+// line = for each slot: "<n> v1 ... vn" whitespace-separated. Parses a
+// whole buffer of lines into per-slot value + per-line length arrays.
+// slot_types: 0 = int64, 1 = float32.
+// ---------------------------------------------------------------------------
+int64_t multislot_parse(const char* buf, uint64_t len, uint32_t num_slots,
+                        const uint8_t* slot_types,
+                        double** out_vals,     // [num_slots] malloc'd
+                        uint64_t** out_lens,   // [num_slots] malloc'd
+                        uint64_t* out_counts,  // values per slot
+                        uint64_t* out_lines) {
+  std::vector<std::vector<double>> vals(num_slots);
+  std::vector<std::vector<uint64_t>> lens(num_slots);
+  const char* p = buf;
+  const char* end = buf + len;
+  uint64_t lines = 0;
+  while (p < end) {
+    const char* eol = static_cast<const char*>(memchr(p, '\n', end - p));
+    if (!eol) eol = end;
+    const char* q = p;
+    bool any = false;
+    for (uint32_t s = 0; s < num_slots; ++s) {
+      char* next = nullptr;
+      long n = strtol(q, &next, 10);
+      if (next == q || n < 0 || next > eol) {
+        if (s == 0 && !any) break;  // blank line
+        return -(int64_t)(lines + 1);  // malformed line number
+      }
+      any = true;
+      q = next;
+      for (long i = 0; i < n; ++i) {
+        double v;
+        if (slot_types[s] == 0) {
+          // integer ids: full 64-bit precision (ref data_feed parses
+          // uint64 slots with strtoull); the bits travel in the double
+          // buffer and are reinterpreted on the Python side
+          unsigned long long u = strtoull(q, &next, 10);
+          if (next == q || next > eol) return -(int64_t)(lines + 1);
+          int64_t iv = static_cast<int64_t>(u);
+          memcpy(&v, &iv, 8);
+        } else {
+          v = strtod(q, &next);
+          if (next == q || next > eol) return -(int64_t)(lines + 1);
+        }
+        vals[s].push_back(v);
+        q = next;
+      }
+      lens[s].push_back(static_cast<uint64_t>(n));
+    }
+    if (any) ++lines;
+    p = eol + 1;
+  }
+  for (uint32_t s = 0; s < num_slots; ++s) {
+    out_counts[s] = vals[s].size();
+    out_vals[s] = static_cast<double*>(malloc(sizeof(double) *
+                                              (vals[s].size() + 1)));
+    memcpy(out_vals[s], vals[s].data(), sizeof(double) * vals[s].size());
+    out_lens[s] = static_cast<uint64_t*>(malloc(sizeof(uint64_t) *
+                                                (lens[s].size() + 1)));
+    memcpy(out_lens[s], lens[s].data(), sizeof(uint64_t) * lens[s].size());
+  }
+  *out_lines = lines;
+  return static_cast<int64_t>(lines);
+}
+
+void multislot_free(double** vals, uint64_t** lens, uint32_t num_slots) {
+  for (uint32_t s = 0; s < num_slots; ++s) {
+    free(vals[s]);
+    free(lens[s]);
+  }
+}
+
+}  // extern "C"

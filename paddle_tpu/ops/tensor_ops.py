@@ -616,3 +616,72 @@ def _label_smooth(ctx, ins):
     else:
         out = (1.0 - eps) * x + eps / x.shape[-1]
     return {'Out': [out]}
+
+
+@register('py_func', lod='none', diff_inputs=('X',))
+def _py_func(ctx, ins):
+    """Host callback op (ref operators/py_func_op.cc). Output shapes/dtypes
+    come from the declared out vars; jax.pure_callback bridges the trace."""
+    from ..layers.nn import _PY_FUNC_REGISTRY
+    func, backward_func, skip_names = \
+        _PY_FUNC_REGISTRY[int(ctx.attr('func_id'))]
+    xs = [v for v in ins['X'] if v is not None]
+    in_names = (ctx.op.inputs.get('X')
+                or ctx.attr('_fwd_inputs', {}).get('X', []))
+    # under the generic-vjp grad replay, ctx wraps the GRAD op: the forward
+    # output names live in its _fwd_outputs attr
+    out_names = (ctx.op.outputs.get('Out')
+                 or ctx.attr('_fwd_outputs')['Out'])
+    shapes = []
+    for n in out_names:
+        v = ctx.var(n)
+        if v is None or v.shape is None or any(
+                s is None or int(s) < 0 for s in (v.shape or [-1])):
+            raise ValueError(
+                "py_func output %r needs a fully static declared shape" % n)
+        from ..framework import runtime_dtype
+        shapes.append(jax.ShapeDtypeStruct(
+            tuple(int(s) for s in v.shape), runtime_dtype(v.dtype)))
+
+    def host(*arrs):
+        res = func(*[np.asarray(a) for a in arrs])
+        if not isinstance(res, (tuple, list)):
+            res = [res]
+        return tuple(np.asarray(r, dtype=s.dtype).reshape(s.shape)
+                     for r, s in zip(res, shapes))
+
+    if backward_func is None:
+        outs = jax.pure_callback(host, tuple(shapes), *xs)
+        return {'Out': list(outs)}
+
+    @jax.custom_vjp
+    def f(*args):
+        return jax.pure_callback(host, tuple(shapes), *args)
+
+    def f_fwd(*args):
+        outs = f(*args)
+        return outs, (args, outs)
+
+    def f_bwd(res, cots):
+        args, outs = res
+        in_shapes = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                          for a in args)
+        # reference backward contract: (inputs + outputs + out grads),
+        # minus skip_vars_in_backward_input
+        bwd_args = [a for a, n in zip(args, in_names)
+                    if n not in skip_names]
+        bwd_args += [o for o, n in zip(outs, out_names)
+                     if n not in skip_names]
+        bwd_args += list(cots)
+
+        def host_bwd(*arrs):
+            grads = backward_func(*[np.asarray(a) for a in arrs])
+            if not isinstance(grads, (tuple, list)):
+                grads = [grads]
+            return tuple(np.asarray(g, dtype=s.dtype).reshape(s.shape)
+                         for g, s in zip(grads, in_shapes))
+        return jax.pure_callback(host_bwd, in_shapes, *bwd_args)
+
+    f.defvjp(f_fwd, f_bwd)
+    outs = f(*xs)
+    return {'Out': list(outs)}

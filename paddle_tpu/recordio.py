@@ -1,0 +1,200 @@
+"""RecordIO reader/writer (ref: paddle/fluid/recordio/ — chunked record
+files with crc32 + optional compression; byte format per header.cc:40-55,
+chunk.cc:79-118).
+
+Two engines, same bytes:
+- native C++ codec (paddle_tpu/native/recordio.cc via ctypes), built on
+  demand with `make`;
+- pure-Python fallback (struct + zlib) when no toolchain is available.
+
+Compressor ids match the reference: 0 none, 2 gzip; snappy (1) is not
+supported (the reference's snappy dependency is vendored; gzip covers the
+compression capability).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import zlib
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), 'native')
+_LIB_PATH = os.path.join(_NATIVE_DIR, 'libptpu_native.so')
+_MAGIC = 0x01020304
+
+_lib = None
+_lib_tried = False
+
+
+def _native():
+    """Load (building if needed) the native codec; None if unavailable."""
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    if not os.path.exists(_LIB_PATH):
+        try:
+            subprocess.run(['make', '-C', _NATIVE_DIR], check=True,
+                           capture_output=True, timeout=120)
+        except Exception:
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.rio_writer_open.restype = ctypes.c_void_p
+    lib.rio_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_uint32,
+                                    ctypes.c_uint64]
+    lib.rio_writer_append.restype = ctypes.c_int
+    lib.rio_writer_append.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_uint64]
+    lib.rio_writer_close.restype = ctypes.c_int
+    lib.rio_writer_close.argtypes = [ctypes.c_void_p]
+    lib.rio_scanner_open.restype = ctypes.c_void_p
+    lib.rio_scanner_open.argtypes = [ctypes.c_char_p]
+    lib.rio_scanner_next.restype = ctypes.c_int64
+    lib.rio_scanner_next.argtypes = [ctypes.c_void_p,
+                                     ctypes.POINTER(ctypes.c_char_p)]
+    lib.rio_scanner_close.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+class Writer(object):
+    """Append records; chunks flush at max_chunk_bytes and on close."""
+
+    def __init__(self, path, compressor=0, max_chunk_bytes=1 << 20):
+        if compressor not in (0, 2):
+            raise ValueError("compressor must be 0 (none) or 2 (gzip)")
+        self._native = _native()
+        self._compressor = compressor
+        if self._native is not None:
+            self._h = self._native.rio_writer_open(
+                path.encode(), compressor, max_chunk_bytes)
+            if not self._h:
+                raise IOError("cannot open %r for writing" % path)
+        else:
+            self._f = open(path, 'wb')
+            self._records = []
+            self._pending = 0
+            self._max = max_chunk_bytes
+
+    def append(self, data):
+        if isinstance(data, str):
+            data = data.encode()
+        if self._native is not None:
+            if self._native.rio_writer_append(self._h, data, len(data)):
+                raise IOError("recordio append failed")
+            return
+        self._records.append(bytes(data))
+        self._pending += len(data)
+        if self._pending >= self._max:
+            self._flush()
+
+    def _flush(self):
+        if not self._records:
+            return
+        payload = b''.join(struct.pack('<I', len(r)) + r
+                           for r in self._records)
+        out = zlib.compress(payload) if self._compressor == 2 else payload
+        self._f.write(struct.pack('<IIIII', _MAGIC, len(self._records),
+                                  zlib.crc32(out) & 0xFFFFFFFF,
+                                  self._compressor, len(out)))
+        self._f.write(out)
+        self._records = []
+        self._pending = 0
+
+    def close(self):
+        if self._native is not None:
+            if self._native.rio_writer_close(self._h):
+                raise IOError("recordio close failed")
+            self._h = None
+            return
+        self._flush()
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+class Scanner(object):
+    """Iterate the records of a recordio file."""
+
+    def __init__(self, path):
+        self._native = _native()
+        if self._native is not None:
+            self._h = self._native.rio_scanner_open(path.encode())
+            if not self._h:
+                raise IOError("cannot open %r" % path)
+        else:
+            self._f = open(path, 'rb')
+            self._buf = []
+            self._i = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._native is not None:
+            data = ctypes.c_char_p()
+            n = self._native.rio_scanner_next(self._h,
+                                              ctypes.byref(data))
+            if n == -1:
+                raise StopIteration
+            if n < 0:
+                raise IOError("corrupt recordio chunk")
+            return ctypes.string_at(data, n)
+        while self._i >= len(self._buf):
+            hdr = self._f.read(20)
+            if len(hdr) < 20:
+                raise StopIteration
+            magic, nrec, crc, comp, size = struct.unpack('<IIIII', hdr)
+            if magic != _MAGIC:
+                raise IOError("bad recordio magic")
+            raw = self._f.read(size)
+            if (zlib.crc32(raw) & 0xFFFFFFFF) != crc:
+                raise IOError("recordio crc mismatch")
+            if comp == 2:
+                raw = zlib.decompress(raw)
+            elif comp != 0:
+                raise IOError("unsupported compressor %d" % comp)
+            self._buf = []
+            pos = 0
+            for _ in range(nrec):
+                (sz,) = struct.unpack_from('<I', raw, pos)
+                pos += 4
+                self._buf.append(raw[pos:pos + sz])
+                pos += sz
+            self._i = 0
+        r = self._buf[self._i]
+        self._i += 1
+        return r
+
+    def close(self):
+        if self._native is not None:
+            if self._h:
+                self._native.rio_scanner_close(self._h)
+                self._h = None
+        else:
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+def write_recordio(path, records, compressor=0):
+    with Writer(path, compressor=compressor) as w:
+        for r in records:
+            w.append(r)
+
+
+def read_recordio(path):
+    with Scanner(path) as s:
+        return list(s)
