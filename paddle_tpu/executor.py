@@ -171,14 +171,18 @@ class Executor(object):
             rng = jax.random.key_data(
                 jax.random.fold_in(jax.random.key(seed), step))
 
-        if _config.get_flag('check_nan_inf'):
-            # reference FLAGS_check_nan_inf scans every op output
-            # (operator.cc:896-905); jax.debug_nans re-runs the step
-            # un-jitted on a nan/inf and pinpoints the producing op
-            with jax.debug_nans(True):
+        from . import profiler as _profiler
+        prof_ctx = (_profiler.record_event('executor_run#%d' % program._uid)
+                    if _profiler.is_profiling() else _nullcontext())
+        with prof_ctx:
+            if _config.get_flag('check_nan_inf'):
+                # reference FLAGS_check_nan_inf scans every op output
+                # (operator.cc:896-905); jax.debug_nans re-runs the step
+                # un-jitted on a nan/inf and pinpoints the producing op
+                with jax.debug_nans(True):
+                    fetches, new_state = fn(state, feed_vals, rng)
+            else:
                 fetches, new_state = fn(state, feed_vals, rng)
-        else:
-            fetches, new_state = fn(state, feed_vals, rng)
         for name, val in new_state.items():
             scope.set(name, val)
 

@@ -121,17 +121,19 @@ def open_files(filenames, shapes, lod_levels, dtypes, thread_num=1,
         for _ in range(pass_num):
             for path in ([filenames] if isinstance(filenames, str)
                          else filenames):
-                for rec in _rio.Scanner(path):
-                    buf = _io.BytesIO(rec)
-                    vals = []
-                    for shape, lod in zip(shapes, lod_levels):
-                        arr, lod_info = read_tensor_stream(buf)
-                        if lod and lod_info:
-                            lens = [list(np.diff(l)) for l in lod_info]
-                            vals.append(create_lod_tensor(arr, lens))
-                        else:
-                            vals.append(arr)
-                    yield vals
+                with _rio.Scanner(path) as scanner:
+                    for rec in scanner:
+                        buf = _io.BytesIO(rec)
+                        vals = []
+                        for shape, lod in zip(shapes, lod_levels):
+                            arr, lod_info = read_tensor_stream(buf)
+                            if lod and lod_info:
+                                lens = [list(np.diff(l))
+                                        for l in lod_info]
+                                vals.append(create_lod_tensor(arr, lens))
+                            else:
+                                vals.append(arr)
+                        yield vals
 
     reader.decorate_tensor_provider(gen)
     return _register_reader(reader)

@@ -1453,3 +1453,17 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
         attrs={'func_id': len(_PY_FUNC_REGISTRY) - 1},
         infer_shape=False)
     return outs if isinstance(out, (list, tuple)) else outs[0]
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """Distillation CTR loss (ref nn.py teacher_student_sigmoid_loss)."""
+    helper = LayerHelper('teacher_student_sigmoid_loss')
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type='teacher_student_sigmoid_loss',
+        inputs={'X': input, 'Label': label}, outputs={'Y': out},
+        attrs={'soft_max_up_bound': soft_max_up_bound,
+               'soft_max_lower_bound': soft_max_lower_bound},
+        infer_shape=False)
+    return out

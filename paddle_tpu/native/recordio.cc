@@ -188,12 +188,20 @@ int64_t multislot_parse(const char* buf, uint64_t len, uint32_t num_slots,
     const char* eol = static_cast<const char*>(memchr(p, '\n', end - p));
     if (!eol) eol = end;
     const char* q = p;
+    // blank line = only whitespace; anything else must parse fully
+    const char* probe = p;
+    while (probe < eol && (*probe == ' ' || *probe == '\t' ||
+                           *probe == '\r'))
+      ++probe;
+    if (probe == eol) {
+      p = eol + 1;
+      continue;
+    }
     bool any = false;
     for (uint32_t s = 0; s < num_slots; ++s) {
       char* next = nullptr;
       long n = strtol(q, &next, 10);
       if (next == q || n < 0 || next > eol) {
-        if (s == 0 && !any) break;  // blank line
         return -(int64_t)(lines + 1);  // malformed line number
       }
       any = true;

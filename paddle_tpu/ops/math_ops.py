@@ -530,3 +530,24 @@ def _norm(ctx, ins):
     eps = ctx.attr('epsilon', 1e-10)
     norm = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True) + eps)
     return {'Out': [x / norm], 'Norm': [norm]}
+
+
+@register('teacher_student_sigmoid_loss', diff_inputs=('X',))
+def _teacher_student_sigmoid_loss(ctx, ins):
+    """ref teacher_student_sigmoid_loss_op.h: BCE on the click bit plus BCE
+    on the teacher score, encoded in one label:
+      label < -1: clk=0, no teacher;  label in [-1,0): clk=1, no teacher;
+      label in [0,1): clk=0, teacher=label;  label >= 1: clk=1,
+      teacher=label-1."""
+    x = X(ins).reshape(-1)
+    lab = ins['Label'][0].reshape(-1)
+    bce = lambda z: jnp.maximum(x, 0.0) - x * z + jnp.log1p(
+        jnp.exp(-jnp.abs(x)))
+    clk = jnp.where(lab < -1.0, 0.0,
+                    jnp.where(lab < 0.0, 1.0,
+                              jnp.where(lab < 1.0, 0.0, 1.0)))
+    teacher = jnp.where(lab < 0.0, 0.0,
+                        jnp.where(lab < 1.0, lab, lab - 1.0))
+    has_teacher = lab >= 0.0
+    loss = bce(clk) + jnp.where(has_teacher, bce(teacher), 0.0)
+    return {'Y': [loss.reshape(-1, 1)]}

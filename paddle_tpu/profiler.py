@@ -1,19 +1,34 @@
-"""Profiler surface (ref: python/paddle/fluid/profiler.py).
+"""Profiler surface (ref: python/paddle/fluid/profiler.py,
+platform/profiler.cc event tables, tools/timeline.py Chrome export).
 
-The reference aggregates per-op host events + CUPTI device spans
-(platform/profiler.cc, device_tracer.cc). TPU-native equivalent: the whole
-step is one XLA program, so per-op host timing is meaningless — we wrap runs
-in jax.profiler traces (viewable in TensorBoard/Perfetto, which subsumes
-tools/timeline.py) and keep the same context-manager API.
+TPU-native split of responsibilities:
+- DEVICE time: the whole step is one XLA program; jax.profiler traces
+  capture per-kernel spans for TensorBoard/Perfetto (subsuming the
+  reference's CUPTI DeviceTracer).
+- HOST time: RecordEvent-style spans (`record_event`, plus per-run events
+  the Executor emits while profiling is on) aggregate into the reference's
+  min/max/avg/total report at stop_profiler, and export to Chrome
+  tracing JSON via `export_chrome_tracing` — the tools/timeline.py
+  capability without the proto intermediary.
 """
 from __future__ import annotations
 
 import contextlib
+import json
 import os
+import threading
 import time
 
 _trace_dir = None
-_events = []
+_events = []            # (name, start_s, dur_s, tid)
+_active = False
+# single consistent epoch for every event timestamp (chrome traces need
+# one time base regardless of when profiling starts)
+_EPOCH = time.perf_counter()
+
+
+def is_profiling():
+    return _active
 
 
 @contextlib.contextmanager
@@ -22,18 +37,58 @@ def cuda_profiler(output_file, output_mode=None, config=None):
 
 
 def start_profiler(state='All', tracer_option=None):
-    global _trace_dir
+    global _trace_dir, _active
     import jax
     _trace_dir = os.environ.get('PTPU_PROFILE_DIR', '/tmp/paddle_tpu_profile')
     os.makedirs(_trace_dir, exist_ok=True)
     jax.profiler.start_trace(_trace_dir)
+    _active = True
 
 
 def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
+    global _active
     import jax
     jax.profiler.stop_trace()
-    print("[paddle_tpu.profiler] trace written to %s "
-          "(open with TensorBoard / Perfetto)" % _trace_dir)
+    _active = False
+    _print_report(sorted_key)
+    print("[paddle_tpu.profiler] device trace written to %s "
+          "(open with TensorBoard / Perfetto); host events: "
+          "export_chrome_tracing(path)" % _trace_dir)
+
+
+def _print_report(sorted_key=None):
+    """Aggregate host events like the reference's profiler report
+    (platform/profiler.cc PrintProfiler: calls/total/min/max/avg)."""
+    agg = {}
+    for name, _start, dur, _tid in _events:
+        a = agg.setdefault(name, [0, 0.0, float('inf'), 0.0])
+        a[0] += 1
+        a[1] += dur
+        a[2] = min(a[2], dur)
+        a[3] = max(a[3], dur)
+    if not agg:
+        return
+    rows = sorted(agg.items(), key=lambda kv: kv[1][1], reverse=True)
+    if sorted_key == 'calls':
+        rows = sorted(agg.items(), key=lambda kv: kv[1][0], reverse=True)
+    print("%-40s %8s %12s %12s %12s %12s" %
+          ('Event', 'Calls', 'Total(ms)', 'Min(ms)', 'Max(ms)', 'Avg(ms)'))
+    for name, (calls, total, mn, mx) in rows:
+        print("%-40s %8d %12.3f %12.3f %12.3f %12.3f" %
+              (name[:40], calls, total * 1e3, mn * 1e3, mx * 1e3,
+               total * 1e3 / calls))
+
+
+def export_chrome_tracing(path):
+    """Write recorded host events as Chrome tracing JSON
+    (chrome://tracing / Perfetto; ref tools/timeline.py:115)."""
+    trace = {'traceEvents': [
+        {'name': name, 'ph': 'X', 'pid': 0, 'tid': tid,
+         'ts': start * 1e6, 'dur': dur * 1e6, 'cat': 'host'}
+        for name, start, dur, tid in _events]}
+    with open(path, 'w') as f:
+        json.dump(trace, f)
+    return path
 
 
 def reset_profiler():
@@ -59,4 +114,5 @@ def record_event(name):
     t0 = time.perf_counter()
     with jax.profiler.TraceAnnotation(name):
         yield
-    _events.append((name, time.perf_counter() - t0))
+    _events.append((name, t0 - _EPOCH, time.perf_counter() - t0,
+                    threading.get_ident() % 10000))

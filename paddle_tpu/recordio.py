@@ -188,6 +188,15 @@ class Scanner(object):
     def __exit__(self, *a):
         self.close()
 
+    def __del__(self):
+        # the native handle owns a FILE* — don't leak fds when callers
+        # iterate without close()
+        try:
+            if self._native is not None and getattr(self, '_h', None):
+                self.close()
+        except Exception:
+            pass
+
 
 def write_recordio(path, records, compressor=0):
     with Writer(path, compressor=compressor) as w:
