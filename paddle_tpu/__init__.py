@@ -47,6 +47,7 @@ from .io import (save_vars, save_params, save_persistables, load_vars,
                  load_inference_model)
 from . import core
 from . import contrib
+from . import imperative
 from . import inference
 from .parallel.parallel_executor import ParallelExecutor
 from .parallel.compiler import CompiledProgram, BuildStrategy, ExecutionStrategy

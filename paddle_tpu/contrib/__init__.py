@@ -9,8 +9,9 @@ Shipped submodules:
 """
 from . import mixed_precision
 from . import gradient_merge
+from . import quantize
 from .memory_usage_calc import memory_usage
 from .op_frequence import op_freq_statistic
 
-__all__ = ['mixed_precision', 'gradient_merge', 'memory_usage',
-           'op_freq_statistic']
+__all__ = ['mixed_precision', 'gradient_merge', 'quantize',
+           'memory_usage', 'op_freq_statistic']

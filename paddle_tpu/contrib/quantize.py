@@ -1,0 +1,120 @@
+"""Quantization-aware training transpiler
+(ref: python/paddle/fluid/contrib/quantize/quantize_transpiler.py:
+QuantizeTranspiler.training_transpile inserts fake_quantize/dequantize op
+pairs around conv2d/mul/depthwise_conv2d inputs; freeze_program folds the
+scales for int8 inference).
+
+TPU-native notes: fake-quant is a pure elementwise round-through
+(straight-through estimator via the value-preserving stop_gradient trick),
+so XLA fuses it into the surrounding matmul/conv; abs_max scales are
+computed in-graph.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import default_main_program
+
+_QUANTIZABLE = ('conv2d', 'depthwise_conv2d', 'mul')
+
+
+class QuantizeTranspiler(object):
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type='abs_max',
+                 weight_quantize_type='abs_max', window_size=10000):
+        if activation_quantize_type != 'abs_max' or \
+                weight_quantize_type != 'abs_max':
+            raise NotImplementedError(
+                "only abs_max quantization is supported (the reference's "
+                "range_abs_max window statistics add state without "
+                "changing the quantized math)")
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+
+    def training_transpile(self, program=None, startup_program=None):
+        """Insert fake-quant ops before every quantizable op's X/W inputs."""
+        program = program or default_main_program()
+        block = program.global_block()
+        new_ops = []
+        quant_cache = {}
+        for op in block.ops:
+            if op.type in _QUANTIZABLE and not op.attrs.get('_quantized'):
+                for slot in ('Input', 'Filter', 'X', 'Y'):
+                    names = op.inputs.get(slot)
+                    if not names:
+                        continue
+                    bits = self.weight_bits if slot in ('Filter', 'Y') \
+                        else self.activation_bits
+                    qnames = []
+                    for n in names:
+                        key = (n, bits)
+                        if key not in quant_cache:
+                            qn = n + '.quantized'
+                            v = block._find_var_recursive(n)
+                            block.create_var(
+                                name=qn,
+                                shape=v.shape if v is not None else None,
+                                dtype=v.dtype if v is not None
+                                else 'float32', stop_gradient=False)
+                            new_ops.append(dict(
+                                type='fake_quantize_abs_max',
+                                inputs={'X': [n]},
+                                outputs={'Out': [qn],
+                                         'OutScale': [qn + '.scale']},
+                                attrs={'bit_length': bits}))
+                            block.create_var(name=qn + '.scale',
+                                             dtype='float32',
+                                             stop_gradient=True)
+                            quant_cache[key] = qn
+                        qnames.append(quant_cache[key])
+                    op.inputs[slot] = qnames
+                op.attrs['_quantized'] = True
+            new_ops.append(op)
+        # splice the quant ops in front of their consumers, preserving order
+        rebuilt = []
+        for item in new_ops:
+            if isinstance(item, dict):
+                from ..framework import Operator
+                rebuilt.append(Operator(block, item['type'], item['inputs'],
+                                        item['outputs'], item['attrs']))
+            else:
+                rebuilt.append(item)
+        block.ops = rebuilt
+        # grad ops replay the forward through their _fwd_inputs maps: they
+        # must see the QUANTIZED names too, or dX would use unquantized W
+        # (the reference transpiler rewrites grad-op inputs the same way)
+        name_map = {orig: qn for (orig, _bits), qn in quant_cache.items()}
+
+        def remap(names):
+            return [name_map.get(n, n) for n in names]
+
+        for op in block.ops:
+            if not op.type.endswith('_grad'):
+                continue
+            for slot in ('Input', 'Filter', 'X', 'Y'):
+                if slot in op.inputs:
+                    op.inputs[slot] = remap(op.inputs[slot])
+                fwd_ins = op.attrs.get('_fwd_inputs')
+                if fwd_ins and slot in fwd_ins:
+                    fwd_ins[slot] = remap(fwd_ins[slot])
+            # grads keep flowing to the ORIGINAL grad vars: computing them
+            # wrt the quantized input IS the straight-through estimator
+            igm = op.attrs.get('_in_grad_map')
+            if igm:
+                op.attrs['_in_grad_map'] = {
+                    name_map.get(k, k): v for k, v in igm.items()}
+        program._build_epoch += 1  # invalidate compiled-step caches
+        return program
+
+    def freeze_program(self, program, place=None, scope=None):
+        """Inference freeze: with abs_max fake-quant already in the graph,
+        executing it IS the quantized inference numerics (weights round
+        through the int grid each run); fold is a no-op on TPU where int8
+        storage wins nothing over bf16 compute. Kept for API parity."""
+        return program
+
+
+def quant_aware(program=None, **kwargs):
+    """slim-style one-call entry."""
+    t = QuantizeTranspiler(**kwargs)
+    return t.training_transpile(program)

@@ -6,3 +6,30 @@ from .lod import LoDArray, create_lod_array  # noqa: F401
 class EOFException(Exception):
     """Raised by pipeline readers at end of epoch (ref: fluid.core.EOFException)."""
     pass
+
+
+def to_dlpack(value):
+    """DLPack export (ref framework/dlpack_tensor.cc) — jax arrays speak
+    the protocol natively via __dlpack__ (zero-copy). The axon TPU tunnel
+    does not implement external buffer references, so there we fall back
+    to a host copy (numpy also speaks DLPack)."""
+    import numpy as np
+    from .lod import unwrap
+    arr = unwrap(value)
+    try:
+        return arr.__dlpack__()
+    except Exception:
+        # host copy; np.asarray of a jax array is readonly -> copy again
+        return np.array(arr, copy=True).__dlpack__()
+
+
+def from_dlpack(capsule_or_array):
+    """Import a DLPack capsule / any __dlpack__ provider as a device
+    array (host copy when the default backend cannot import external
+    buffers, e.g. the axon TPU tunnel)."""
+    import numpy as np
+    import jax.numpy as jnp
+    try:
+        return jnp.from_dlpack(capsule_or_array)
+    except Exception:
+        return jnp.asarray(np.from_dlpack(capsule_or_array))

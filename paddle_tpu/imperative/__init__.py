@@ -1,0 +1,17 @@
+"""Imperative (proto-dygraph) mode
+(ref: paddle/fluid/imperative/ — Tracer/VarBase/OpBase autograd engine —
+and python/paddle/fluid/imperative/: base.guard, to_variable, Layer,
+Conv2D/Pool2D/FC).
+
+TPU-native re-design: eager values ARE jax arrays; every differentiable
+primitive application records a tape node (fn, parents), and
+`VarBase.backward()` replays the tape in reverse with jax.vjp per node —
+the functional equivalent of the reference's OpBase grad graph. Hot layers
+still hit XLA because the primitive fns are jit-compiled per signature.
+"""
+from .base import guard, to_variable, enabled
+from .layers import Layer, PyLayer
+from .nn import Conv2D, Pool2D, FC
+
+__all__ = ['guard', 'to_variable', 'enabled', 'Layer', 'PyLayer',
+           'Conv2D', 'Pool2D', 'FC']

@@ -1,0 +1,167 @@
+"""Imperative core: VarBase values + the autograd tape
+(ref: imperative/layer.h VarBase:97 / OpBase:156, imperative/tracer.cc).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+_state = {'enabled': False}
+
+
+def enabled():
+    return _state['enabled']
+
+
+@contextlib.contextmanager
+def guard():
+    """Enter imperative mode (ref imperative/base.py:28)."""
+    prev = _state['enabled']
+    _state['enabled'] = True
+    try:
+        yield
+    finally:
+        _state['enabled'] = prev
+
+
+class VarBase(object):
+    """An eager value: jax array + tape linkage (ref layer.h VarBase)."""
+
+    __slots__ = ('value', 'stop_gradient', '_node', '_grad')
+
+    def __init__(self, value, stop_gradient=False, node=None):
+        import jax.numpy as jnp
+        self.value = value if hasattr(value, 'dtype') else jnp.asarray(value)
+        self.stop_gradient = stop_gradient
+        self._node = node      # (vjp_fn, parent VarBases) or None (leaf)
+        self._grad = None
+
+    # -- numpy-ish surface --------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    def numpy(self):
+        return np.asarray(self.value)
+
+    def _numpy(self):  # reference proto-dygraph name
+        return self.numpy()
+
+    def gradient(self):
+        return None if self._grad is None else np.asarray(self._grad)
+
+    def _gradient(self):
+        return self.gradient()
+
+    def clear_gradient(self):
+        self._grad = None
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self):
+        """Reverse the tape from this var (ref imperative/engine.cc):
+        topological walk accumulating cotangents, then deposit leaf grads."""
+        import jax.numpy as jnp
+        order, leaves, seen = [], [], set()
+
+        def visit(v):
+            if id(v) in seen:
+                return
+            seen.add(id(v))
+            if v._node is None:
+                leaves.append(v)
+                return
+            for p in v._node[1]:
+                visit(p)
+            order.append(v)
+
+        visit(self)
+        cots = {id(self): jnp.ones_like(self.value)}
+        for v in reversed(order):
+            cot = cots.pop(id(v), None)
+            if cot is None:
+                continue
+            vjp_fn, parents = v._node
+            for p, g in zip(parents, vjp_fn(cot)):
+                if p.stop_gradient or g is None:
+                    continue
+                cots[id(p)] = cots[id(p)] + g if id(p) in cots else g
+        for p in leaves:
+            g = cots.get(id(p))
+            if g is not None:
+                p._grad = g if p._grad is None else p._grad + g
+
+    # -- operator sugar -----------------------------------------------------
+    def __add__(self, other):
+        return apply(lambda a, b: a + b, self, _wrap(other))
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return apply(lambda a, b: a - b, self, _wrap(other))
+
+    def __mul__(self, other):
+        return apply(lambda a, b: a * b, self, _wrap(other))
+
+    __rmul__ = __mul__
+
+    def __repr__(self):
+        return 'VarBase(shape=%s, dtype=%s)' % (self.shape, self.dtype)
+
+
+def _wrap(v):
+    return v if isinstance(v, VarBase) else VarBase(v, stop_gradient=True)
+
+
+def to_variable(value, block=None):
+    """numpy -> VarBase (ref imperative/base.py:38)."""
+    return VarBase(np.asarray(value))
+
+
+def apply(fn, *vars_, **kw):
+    """Apply a jax function to VarBases, recording a tape node. Non-float
+    outputs and stop_gradient-only inputs skip recording."""
+    import jax
+    vals = [v.value for v in vars_]
+    diffable = [i for i, v in enumerate(vars_) if not v.stop_gradient
+                and np.issubdtype(v.value.dtype, np.floating)]
+    if not enabled() or not diffable:
+        return VarBase(fn(*vals, **kw), stop_gradient=True)
+
+    def partial(*diff_vals):
+        full = list(vals)
+        for i, dv in zip(diffable, diff_vals):
+            full[i] = dv
+        return fn(*full, **kw)
+
+    out, vjp = jax.vjp(partial, *[vals[i] for i in diffable])
+
+    def node_vjp(cot):
+        gs = vjp(cot)
+        full = [None] * len(vars_)
+        for i, g in zip(diffable, gs):
+            full[i] = g
+        return full
+
+    return VarBase(out, node=(node_vjp, list(vars_)))
+
+
+def apply_custom(fwd, bwd, *vars_):
+    """Tape node with a USER-DEFINED backward: bwd(*inputs, out_grad) ->
+    per-input grads (PyLayer contract, ref imperative PyLayer)."""
+    vals = [v.value for v in vars_]
+    out = fwd(*vals)
+
+    def node_vjp(cot):
+        gs = bwd(*vals, cot)
+        if not isinstance(gs, (tuple, list)):
+            gs = [gs]
+        return list(gs) + [None] * (len(vars_) - len(gs))
+
+    if not enabled():
+        return VarBase(out, stop_gradient=True)
+    return VarBase(out, node=(node_vjp, list(vars_)))

@@ -1,0 +1,76 @@
+"""Imperative Layer base (ref: python/paddle/fluid/imperative/layers.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import VarBase, apply
+
+
+class Layer(object):
+    """Holds parameters (VarBases) and composes via forward()."""
+
+    def __init__(self, name_scope=None, dtype='float32'):
+        self._parameters = {}
+        self._sub_layers = {}
+        self._dtype = dtype
+
+    def create_parameter(self, name, shape, initializer):
+        import jax.numpy as jnp
+        p = VarBase(jnp.asarray(initializer(tuple(shape))
+                                .astype(self._dtype)))
+        self._parameters[name] = p
+        return p
+
+    def add_sublayer(self, name, layer):
+        self._sub_layers[name] = layer
+        return layer
+
+    def parameters(self):
+        out = list(self._parameters.values())
+        for sub in self._sub_layers.values():
+            out.extend(sub.parameters())
+        return out
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Layer):
+            object.__getattribute__(self, '_sub_layers')[name] = value
+        object.__setattr__(self, name, value)
+
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def apply_gradients(self, lr):
+        """Plain SGD over the layer's parameters (proto-dygraph era has no
+        imperative optimizer surface; this is the minimal update)."""
+        for p in self.parameters():
+            if p._grad is not None:
+                p.value = p.value - lr * p._grad
+
+
+class PyLayer(object):
+    """Static-method forward/backward pair (ref imperative PyLayer).
+    backward(*inputs, dout) returns the input grads — it is HONORED (the
+    point of PyLayer is a custom/surrogate gradient), not re-derived."""
+
+    @staticmethod
+    def forward(*inputs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(*args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *inputs):
+        from .base import apply_custom
+        return apply_custom(cls.forward, cls.backward, *inputs)
+
+    def __call__(self, *inputs):
+        return type(self).apply(*inputs)

@@ -1,0 +1,150 @@
+"""Imperative layers: Conv2D / Pool2D / FC
+(ref: python/paddle/fluid/imperative/nn.py — the proto-dygraph trio)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import apply
+from .layers import Layer
+
+
+_init_counter = [0]
+
+
+def _xavier(shape):
+    # fresh stream per parameter: same-shape layers must NOT start
+    # byte-identical
+    _init_counter[0] += 1
+    fan_in = int(np.prod(shape[1:])) if len(shape) > 1 else shape[0]
+    fan_out = shape[0]
+    limit = np.sqrt(6.0 / (fan_in + fan_out))
+    return np.random.RandomState(1000 + _init_counter[0]).uniform(
+        -limit, limit, shape)
+
+
+class Conv2D(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, use_cudnn=True, act=None,
+                 param_attr=None, bias_attr=None, dtype='float32'):
+        super().__init__(dtype=dtype)
+        k = filter_size if isinstance(filter_size, (list, tuple)) \
+            else (filter_size, filter_size)
+        self._stride = stride if isinstance(stride, (list, tuple)) \
+            else (stride, stride)
+        self._padding = padding if isinstance(padding, (list, tuple)) \
+            else (padding, padding)
+        self._dilation = dilation if isinstance(dilation, (list, tuple)) \
+            else (dilation, dilation)
+        self._groups = groups or 1
+        self._act = act
+        self.weight = self.create_parameter(
+            'w', [num_filters, num_channels // self._groups, k[0], k[1]],
+            _xavier)
+        self.bias = self.create_parameter(
+            'b', [num_filters], lambda s: np.zeros(s))
+
+    def forward(self, x):
+        import jax
+
+        def conv(xv, wv, bv):
+            out = jax.lax.conv_general_dilated(
+                xv, wv, window_strides=self._stride,
+                padding=[(self._padding[0], self._padding[0]),
+                         (self._padding[1], self._padding[1])],
+                rhs_dilation=self._dilation,
+                feature_group_count=self._groups,
+                dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+            return out + bv.reshape(1, -1, 1, 1)
+
+        out = apply(conv, x, self.weight, self.bias)
+        return _activate(out, self._act)
+
+
+class Pool2D(Layer):
+    def __init__(self, pool_size=-1, pool_type='max', pool_stride=1,
+                 pool_padding=0, global_pooling=False, use_cudnn=True,
+                 ceil_mode=False, exclusive=True, dtype='float32'):
+        super().__init__(dtype=dtype)
+        self._size = pool_size if isinstance(pool_size, (list, tuple)) \
+            else (pool_size, pool_size)
+        self._stride = pool_stride if isinstance(pool_stride, (list, tuple)) \
+            else (pool_stride, pool_stride)
+        self._padding = pool_padding if isinstance(pool_padding,
+                                                   (list, tuple)) \
+            else (pool_padding, pool_padding)
+        self._type = pool_type
+        self._global = global_pooling
+        self._exclusive = exclusive
+        if ceil_mode:
+            raise NotImplementedError("Pool2D ceil_mode is not supported")
+
+    def forward(self, x):
+        import jax
+        import jax.numpy as jnp
+
+        def pool(xv):
+            if self._global:
+                return jnp.mean(xv, axis=(2, 3), keepdims=True) \
+                    if self._type == 'avg' else \
+                    jnp.max(xv, axis=(2, 3), keepdims=True)
+            dims = (1, 1) + tuple(self._size)
+            strides = (1, 1) + tuple(self._stride)
+            pads = [(0, 0), (0, 0),
+                    (self._padding[0], self._padding[0]),
+                    (self._padding[1], self._padding[1])]
+            if self._type == 'max':
+                return jax.lax.reduce_window(xv, -jnp.inf, jax.lax.max,
+                                             dims, strides, pads)
+            s = jax.lax.reduce_window(xv, 0.0, jax.lax.add, dims, strides,
+                                      pads)
+            if self._exclusive:
+                # Paddle exclusive=True: average over VALID (unpadded)
+                # elements only
+                ones = jnp.ones_like(xv)
+                cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims,
+                                            strides, pads)
+                return s / jnp.maximum(cnt, 1.0)
+            return s / (self._size[0] * self._size[1])
+
+        return apply(pool, x)
+
+
+class FC(Layer):
+    def __init__(self, size, param_attr=None, bias_attr=None,
+                 num_flatten_dims=1, dtype='float32', act=None):
+        super().__init__(dtype=dtype)
+        self._size = size
+        self._nfd = num_flatten_dims
+        self._act = act
+        self.weight = None
+        self.bias = None
+
+    def forward(self, x):
+        import numpy as np
+
+        if self.weight is None:  # lazy build on first input (ref FC)
+            in_dim = int(np.prod(x.shape[self._nfd:]))
+            self.weight = self.create_parameter('w', [in_dim, self._size],
+                                                _xavier)
+            self.bias = self.create_parameter(
+                'b', [self._size], lambda s: np.zeros(s))
+
+        nfd = self._nfd
+
+        def fc(xv, wv, bv):
+            import jax.numpy as jnp
+            lead = int(np.prod(xv.shape[:nfd]))
+            return jnp.matmul(xv.reshape(lead, -1), wv) + bv
+
+        out = apply(fc, x, self.weight, self.bias)
+        return _activate(out, self._act)
+
+
+def _activate(v, act):
+    import jax
+    if act is None:
+        return v
+    fns = {'relu': jax.nn.relu, 'sigmoid': jax.nn.sigmoid,
+           'tanh': jax.numpy.tanh,
+           'softmax': lambda x: jax.nn.softmax(x, axis=-1)}
+    return apply(fns[act], v)

@@ -685,3 +685,25 @@ def _py_func(ctx, ins):
     f.defvjp(f_fwd, f_bwd)
     outs = f(*xs)
     return {'Out': list(outs)}
+
+
+@register('fake_quantize_abs_max', diff_inputs=('X',))
+def _fake_quantize_abs_max(ctx, ins):
+    """ref fake_quantize_op.cc FakeQuantizeAbsMax: scale = max|x|, round x
+    onto the (2^(bits-1) - 1)-step grid; straight-through estimator for the
+    gradient (value-preserving stop_gradient trick)."""
+    x = X(ins)
+    bits = int(ctx.attr('bit_length', 8))
+    levels = float((1 << (bits - 1)) - 1)
+    scale = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
+    q = jnp.round(x / scale * levels) / levels * scale
+    out = x + jax.lax.stop_gradient(q - x)   # STE
+    return {'Out': [out], 'OutScale': [scale.reshape(1)]}
+
+
+@register('fake_dequantize_max_abs', diff_inputs=('X',))
+def _fake_dequantize_max_abs(ctx, ins):
+    x = X(ins)
+    scale = ins['Scale'][0].reshape(())
+    max_range = float(ctx.attr('max_range', 127))
+    return {'Out': [x * scale / max_range]}

@@ -1,0 +1,168 @@
+"""Imperative (proto-dygraph) mode + quantization-aware training."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def test_imperative_fc_trains():
+    """The reference's proto-dygraph test shape: layers compose eagerly,
+    loss.backward() fills parameter gradients, manual SGD learns."""
+    from paddle_tpu import imperative
+    rng = np.random.RandomState(0)
+    xs = rng.randn(16, 8).astype(np.float32)
+    w_true = rng.randn(8, 1).astype(np.float32)
+    ys = xs @ w_true
+
+    with imperative.guard():
+        fc1 = imperative.FC(size=16, act='relu')
+        fc2 = imperative.FC(size=1)
+        losses = []
+        for step in range(30):
+            x = imperative.to_variable(xs)
+            y = imperative.to_variable(ys)
+            pred = fc2(fc1(x))
+            diff = pred - y
+            loss_v = (diff * diff)
+            from paddle_tpu.imperative.base import apply
+            loss = apply(lambda d: d.mean(), loss_v)
+            loss.backward()
+            for lyr in (fc1, fc2):
+                lyr.apply_gradients(0.05)
+                lyr.clear_gradients()
+            losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.3
+    assert fc1.weight.gradient() is None  # cleared
+
+
+def test_imperative_conv_pool_forward_backward():
+    from paddle_tpu import imperative
+    with imperative.guard():
+        conv = imperative.Conv2D(num_channels=1, num_filters=2,
+                                 filter_size=3, padding=1)
+        pool = imperative.Pool2D(pool_size=2, pool_type='max',
+                                 pool_stride=2)
+        x = imperative.to_variable(
+            np.random.RandomState(1).randn(2, 1, 8, 8).astype(np.float32))
+        out = pool(conv(x))
+        assert out.shape == (2, 2, 4, 4)
+        from paddle_tpu.imperative.base import apply
+        loss = apply(lambda v: v.sum(), out)
+        loss.backward()
+        g = conv.weight.gradient()
+        assert g is not None and g.shape == (2, 1, 3, 3)
+        assert np.abs(g).sum() > 0
+
+
+def test_imperative_grad_accumulates_shared_param():
+    from paddle_tpu import imperative
+    from paddle_tpu.imperative.base import apply, to_variable
+    with imperative.guard():
+        w = to_variable(np.ones(3, np.float32))
+        a = apply(lambda v: (v * 2.0).sum(), w)
+        b = apply(lambda v: (v * 3.0).sum(), w)
+        s = a + b
+        s.backward()
+        np.testing.assert_allclose(w.gradient(), np.full(3, 5.0), rtol=1e-6)
+
+
+def test_quantize_transpiler_trains_and_quantizes():
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup_p.random_seed = 5
+    with fluid.program_guard(main_p, startup_p):
+        x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+        lab = fluid.layers.data(name='lab', shape=[1], dtype='int64')
+        h = fluid.layers.fc(x, size=16, act='relu')
+        logits = fluid.layers.fc(h, size=4)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            logits=logits, label=lab))
+        fluid.optimizer.Adam(5e-2).minimize(loss)
+    t = fluid.contrib.quantize.QuantizeTranspiler(weight_bits=8,
+                                                  activation_bits=8)
+    t.training_transpile(main_p, startup_p)
+    ops = [op.type for op in main_p.global_block().ops]
+    assert 'fake_quantize_abs_max' in ops
+    # every mul's inputs are now quantized vars
+    for op in main_p.global_block().ops:
+        if op.type == 'mul' and not op.attrs.get('op_role', 0):
+            assert all(n.endswith('.quantized') for n in op.inputs['X'])
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    rng = np.random.RandomState(2)
+    xs = rng.randn(32, 8).astype(np.float32)
+    labs = rng.randint(0, 4, (32, 1))
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        losses = []
+        for _ in range(25):
+            l, = exe.run(main_p, feed={'x': xs, 'lab': labs},
+                         fetch_list=[loss])
+            losses.append(float(np.asarray(l).reshape(-1)[0]))
+    # quantization-aware training still converges (STE gradients)
+    assert losses[-1] < losses[0] * 0.6
+
+
+def test_fake_quant_grid():
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    helper_out = fluid.default_main_program().global_block().create_var(
+        name='q', dtype='float32', stop_gradient=False)
+    scale_out = fluid.default_main_program().global_block().create_var(
+        name='qs', dtype='float32', stop_gradient=True)
+    fluid.default_main_program().global_block().append_op(
+        type='fake_quantize_abs_max', inputs={'X': ['x']},
+        outputs={'Out': ['q'], 'OutScale': ['qs']},
+        attrs={'bit_length': 8}, infer_shape=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xs = np.array([[0.1, -0.5, 0.25, 1.0]], np.float32)
+    q, s = exe.run(feed={'x': xs}, fetch_list=['q', 'qs'])
+    assert float(np.asarray(s)[0]) == pytest.approx(1.0)
+    # values land on the 127-step grid
+    np.testing.assert_allclose(np.asarray(q) * 127,
+                               np.round(np.asarray(q) * 127), atol=1e-4)
+    np.testing.assert_allclose(q, xs, atol=1.0 / 127)
+
+
+def test_pylayer_custom_backward_honored():
+    from paddle_tpu import imperative
+    from paddle_tpu.imperative import PyLayer
+
+    class TripleGrad(PyLayer):
+        @staticmethod
+        def forward(x):
+            return x * 1.0
+
+        @staticmethod
+        def backward(x, dout):
+            return dout * 3.0   # surrogate gradient
+
+    with imperative.guard():
+        w = imperative.to_variable(np.ones(2, np.float32))
+        from paddle_tpu.imperative.base import apply
+        out = TripleGrad.apply(w)
+        loss = apply(lambda v: v.sum(), out)
+        loss.backward()
+    np.testing.assert_allclose(w.gradient(), np.full(2, 3.0), rtol=1e-6)
+
+
+def test_pool2d_exclusive_avg_padding():
+    from paddle_tpu import imperative
+    with imperative.guard():
+        pool = imperative.Pool2D(pool_size=2, pool_type='avg',
+                                 pool_stride=2, pool_padding=1)
+        x = imperative.to_variable(np.ones((1, 1, 2, 2), np.float32))
+        out = pool(x)
+    # exclusive=True: padded border windows average only valid elements
+    np.testing.assert_allclose(out.numpy(), np.ones((1, 1, 2, 2)),
+                               rtol=1e-6)
+
+
+def test_dlpack_bridge():
+    import jax.numpy as jnp
+    import paddle_tpu as fluid
+    import torch
+    x = jnp.asarray(np.arange(6, dtype=np.float32))
+    t = torch.from_dlpack(fluid.core.to_dlpack(x))
+    np.testing.assert_allclose(t.numpy(), np.arange(6, dtype=np.float32))
+    back = fluid.core.from_dlpack(torch.arange(4).float())
+    np.testing.assert_allclose(np.asarray(back), np.arange(4))
