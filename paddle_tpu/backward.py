@@ -103,6 +103,16 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
         op = block.ops[i]
         d = registry.get(op.type)
         if d is not None and d.no_grad:
+            if op.type == 'while' and any(
+                    grads.get(o) for o in op.output_arg_names()):
+                # the reference while_op HAS a grad (controlflow/
+                # while_op.cc); here grads flow through the scan-based RNN
+                # ops instead — fail loudly rather than silently stopping
+                raise ValueError(
+                    "gradients do not flow through the `while` op: use "
+                    "StaticRNN/DynamicRNN (lax.scan lowering, "
+                    "differentiable) for trainable loops; `while` is for "
+                    "inference-time decode loops (beam search)")
             continue
 
         # resolve/merge output grads

@@ -49,7 +49,9 @@ class QuantizeTranspiler(object):
                     for n in names:
                         key = (n, bits)
                         if key not in quant_cache:
-                            qn = n + '.quantized'
+                            # bit width in the name: one var quantized at
+                            # two widths must not collide
+                            qn = n + '.quantized.%d' % bits
                             v = block._find_var_recursive(n)
                             block.create_var(
                                 name=qn,
@@ -89,7 +91,10 @@ class QuantizeTranspiler(object):
             return [name_map.get(n, n) for n in names]
 
         for op in block.ops:
-            if not op.type.endswith('_grad'):
+            # only grad ops of the QUANTIZED op types replay a quantized
+            # forward; other consumers of the same var keep the original
+            if not op.type.endswith('_grad') \
+                    or op.type[:-5] not in _QUANTIZABLE:
                 continue
             for slot in ('Input', 'Filter', 'X', 'Y'):
                 if slot in op.inputs:

@@ -29,6 +29,9 @@ def from_dlpack(capsule_or_array):
     buffers, e.g. the axon TPU tunnel)."""
     import numpy as np
     import jax.numpy as jnp
+    if not hasattr(capsule_or_array, '__dlpack__'):
+        # raw capsules are single-use: no fallback retry possible
+        return jnp.from_dlpack(capsule_or_array)
     try:
         return jnp.from_dlpack(capsule_or_array)
     except Exception:

@@ -66,20 +66,24 @@ class VarBase(object):
         """Reverse the tape from this var (ref imperative/engine.cc):
         topological walk accumulating cotangents, then deposit leaf grads."""
         import jax.numpy as jnp
+        # iterative post-order DFS: deep tapes (long unrolled loops) must
+        # not hit Python's recursion limit
         order, leaves, seen = [], [], set()
-
-        def visit(v):
+        stack = [(self, False)]
+        while stack:
+            v, expanded = stack.pop()
+            if expanded:
+                order.append(v)
+                continue
             if id(v) in seen:
-                return
+                continue
             seen.add(id(v))
             if v._node is None:
                 leaves.append(v)
-                return
+                continue
+            stack.append((v, True))
             for p in v._node[1]:
-                visit(p)
-            order.append(v)
-
-        visit(self)
+                stack.append((p, False))
         cots = {id(self): jnp.ones_like(self.value)}
         for v in reversed(order):
             cot = cots.pop(id(v), None)

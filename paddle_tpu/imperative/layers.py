@@ -26,9 +26,18 @@ class Layer(object):
         return layer
 
     def parameters(self):
-        out = list(self._parameters.values())
+        # dedupe by identity: a sublayer registered under two names (e.g.
+        # add_sublayer + attribute assignment) must not double its params
+        out, seen = [], set()
+        for p in self._parameters.values():
+            if id(p) not in seen:
+                seen.add(id(p))
+                out.append(p)
         for sub in self._sub_layers.values():
-            out.extend(sub.parameters())
+            for p in sub.parameters():
+                if id(p) not in seen:
+                    seen.add(id(p))
+                    out.append(p)
         return out
 
     def clear_gradients(self):
@@ -36,8 +45,12 @@ class Layer(object):
             p.clear_gradient()
 
     def __setattr__(self, name, value):
-        if isinstance(value, Layer):
-            object.__getattribute__(self, '_sub_layers')[name] = value
+        subs = self.__dict__.get('_sub_layers')
+        if subs is not None:
+            if isinstance(value, Layer):
+                subs[name] = value
+            elif name in subs:
+                del subs[name]   # reassignment drops the stale sublayer
         object.__setattr__(self, name, value)
 
     def __call__(self, *args, **kwargs):

@@ -85,7 +85,7 @@ def test_quantize_transpiler_trains_and_quantizes():
     # every mul's inputs are now quantized vars
     for op in main_p.global_block().ops:
         if op.type == 'mul' and not op.attrs.get('op_role', 0):
-            assert all(n.endswith('.quantized') for n in op.inputs['X'])
+            assert all('.quantized.' in n for n in op.inputs['X'])
 
     exe = fluid.Executor(fluid.CPUPlace())
     scope = fluid.core.Scope()
@@ -160,7 +160,7 @@ def test_pool2d_exclusive_avg_padding():
 def test_dlpack_bridge():
     import jax.numpy as jnp
     import paddle_tpu as fluid
-    import torch
+    torch = pytest.importorskip('torch')
     x = jnp.asarray(np.arange(6, dtype=np.float32))
     t = torch.from_dlpack(fluid.core.to_dlpack(x))
     np.testing.assert_allclose(t.numpy(), np.arange(6, dtype=np.float32))
