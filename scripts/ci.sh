@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# CI entry (ref: paddle/scripts/paddle_build.sh) — build native components,
+# run the test suite on the 8-device virtual CPU mesh, gate the public API
+# surface, and smoke the benchmark in a tiny configuration.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== native components =="
+make -C paddle_tpu/native
+
+echo "== api surface =="
+python tools/print_signatures.py --check API.spec
+
+echo "== tests (8-device virtual cpu mesh) =="
+python -m pytest tests/ -q
+
+echo "== bench smoke (tiny config) =="
+PTPU_BENCH_ONLY=resnet PTPU_BENCH_BATCH=16 PTPU_BENCH_STEPS=3 \
+PTPU_PLATFORM=cpu python bench.py
+
+echo "CI OK"

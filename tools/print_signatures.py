@@ -1,0 +1,129 @@
+"""Print the public API surface as stable one-line signatures
+(ref: tools/print_signatures.py, which generates paddle/fluid/API.spec —
+the frozen API checklist CI diffs against).
+
+Usage:
+    python tools/print_signatures.py > API.spec
+    python tools/print_signatures.py --check API.spec   # CI gate
+"""
+from __future__ import annotations
+
+import argparse
+import inspect
+import sys
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MODULES = [
+    'paddle_tpu',
+    'paddle_tpu.layers',
+    'paddle_tpu.layers.detection',
+    'paddle_tpu.optimizer',
+    'paddle_tpu.initializer',
+    'paddle_tpu.regularizer',
+    'paddle_tpu.clip',
+    'paddle_tpu.metrics',
+    'paddle_tpu.evaluator',
+    'paddle_tpu.io',
+    'paddle_tpu.nets',
+    'paddle_tpu.profiler',
+    'paddle_tpu.recordio',
+    'paddle_tpu.inference',
+    'paddle_tpu.imperative',
+    'paddle_tpu.contrib.mixed_precision',
+    'paddle_tpu.contrib.gradient_merge',
+    'paddle_tpu.contrib.quantize',
+    'paddle_tpu.parallel',
+]
+
+
+def _sig(obj):
+    try:
+        return str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return '(...)'
+
+
+def _member_entry(modname, cls_name, mname, raw):
+    """One spec line per class member, unwrapping descriptors explicitly so
+    the output is identical across Python versions (staticmethod became
+    callable only in 3.10) and covers classmethods/properties."""
+    if isinstance(raw, staticmethod) or isinstance(raw, classmethod):
+        return '%s.%s.%s %s' % (modname, cls_name, mname,
+                                _sig(raw.__func__))
+    if isinstance(raw, property):
+        return '%s.%s.%s <property>' % (modname, cls_name, mname)
+    if callable(raw):
+        return '%s.%s.%s %s' % (modname, cls_name, mname, _sig(raw))
+    return None
+
+
+def collect():
+    import importlib
+    lines = []
+    seen_objs = set()
+    for modname in MODULES:
+        mod = importlib.import_module(modname)
+        names = getattr(mod, '__all__', None)
+        if names is None:
+            names = [n for n in dir(mod) if not n.startswith('_')]
+        for n in sorted(names):
+            obj = getattr(mod, n, None)
+            if obj is None or inspect.ismodule(obj):
+                continue
+            # one canonical entry per object: re-exports (Variable under
+            # paddle_tpu AND paddle_tpu.layers ...) would multiply drift
+            # noise in the spec
+            try:
+                key = id(obj)
+            except TypeError:
+                key = None
+            if key is not None and key in seen_objs:
+                continue
+            if key is not None:
+                seen_objs.add(key)
+            if inspect.isclass(obj):
+                lines.append('%s.%s.__init__ %s'
+                             % (modname, n, _sig(obj.__init__)))
+                for mname, raw in sorted(vars(obj).items()):
+                    if mname.startswith('_'):
+                        continue
+                    entry = _member_entry(modname, n, mname, raw)
+                    if entry:
+                        lines.append(entry)
+            elif callable(obj):
+                lines.append('%s.%s %s' % (modname, n, _sig(obj)))
+    return lines
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--check', metavar='SPEC',
+                    help='diff against a frozen spec; nonzero exit on drift')
+    args = ap.parse_args()
+    lines = collect()
+    if args.check:
+        with open(args.check) as f:
+            frozen = [l.rstrip('\n') for l in f if l.strip()]
+        cur = set(lines)
+        old = set(frozen)
+        removed = sorted(old - cur)
+        added = sorted(cur - old)
+        if removed or added:
+            for l in removed:
+                print('- %s' % l)
+            for l in added:
+                print('+ %s' % l)
+            print('API drift: %d removed, %d added (regenerate API.spec '
+                  'if intentional)' % (len(removed), len(added)))
+            sys.exit(1)
+        print('API surface matches %s (%d symbols)'
+              % (args.check, len(frozen)))
+        return
+    for l in lines:
+        print(l)
+
+
+if __name__ == '__main__':
+    main()
