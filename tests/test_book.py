@@ -1,0 +1,151 @@
+"""Book-style end-to-end tests (ref: python/paddle/fluid/tests/book/ —
+train a canonical model a few iterations, save an inference model, reload
+it, and check the served outputs match the trained program's).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _train_save_infer(build_fn, feeds_fn, dirname, steps=8, converge=0.9):
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup_p.random_seed = 42
+    with fluid.program_guard(main_p, startup_p):
+        feed_names, fetch_var, loss = build_fn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        losses = []
+        for feed in feeds_fn(steps):
+            l, = exe.run(main_p, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(l).reshape(-1)[0]))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * converge, losses
+        # save -> reload -> serve
+        infer_prog = main_p.clone(for_test=True)
+        fluid.save_inference_model(dirname, feed_names, [fetch_var], exe,
+                                   main_program=infer_prog)
+        feed = next(iter(feeds_fn(1)))
+        # the un-pruned test clone still holds the loss ops: feed all vars
+        want, = exe.run(infer_prog, feed=feed, fetch_list=[fetch_var])
+    scope2 = fluid.core.Scope()
+    with fluid.scope_guard(scope2):
+        prog, fnames, fvars = fluid.load_inference_model(dirname, exe)
+        got, = exe.run(prog, feed={k: feed[k] for k in fnames},
+                       fetch_list=[f.name for f in fvars])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+    return losses
+
+
+def test_book_recognize_digits_mlp(tmp_path):
+    """test_recognize_digits.py (MLP flavor) on synthetic mnist."""
+    from paddle_tpu.dataset import mnist
+
+    def build():
+        img = fluid.layers.data(name='img', shape=[784], dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        h = fluid.layers.fc(img, size=128, act='relu')
+        probs = fluid.layers.fc(h, size=10, act='softmax')
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(input=probs,
+                                                            label=label))
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+        return ['img'], probs, loss
+
+    reader = fluid.layers.batch(mnist.train(), 64)
+
+    def feeds(n):
+        it = reader()
+        for _ in range(n):
+            batch = next(it)
+            imgs = np.stack([b[0] for b in batch]).reshape(-1, 784)
+            labs = np.asarray([b[1] for b in batch]).reshape(-1, 1)
+            yield {'img': imgs.astype(np.float32), 'label': labs}
+
+    _train_save_infer(build, feeds, str(tmp_path / 'mlp'), steps=12)
+
+
+def test_book_image_classification_cnn(tmp_path):
+    """test_image_classification.py flavor: conv net on synthetic cifar."""
+    def build():
+        img = fluid.layers.data(name='img', shape=[3, 32, 32],
+                                dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        c = fluid.nets.simple_img_conv_pool(
+            input=img, num_filters=8, filter_size=3, pool_size=2,
+            pool_stride=2, act='relu')
+        probs = fluid.layers.fc(c, size=10, act='softmax')
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(input=probs,
+                                                            label=label))
+        fluid.optimizer.Adam(2e-3).minimize(loss)
+        return ['img'], probs, loss
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(64, 3, 32, 32).astype(np.float32)
+    labs = rng.randint(0, 10, (64, 1))
+
+    def feeds(n):
+        for _ in range(n):
+            yield {'img': xs, 'label': labs}
+
+    _train_save_infer(build, feeds, str(tmp_path / 'cnn'), steps=10)
+
+
+def test_book_understand_sentiment_lstm(tmp_path):
+    """test_understand_sentiment.py flavor: embedding + dynamic LSTM over
+    LoD token sequences."""
+    def build():
+        words = fluid.layers.data(name='words', shape=[1], dtype='int64',
+                                  lod_level=1)
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        emb = fluid.layers.embedding(words, size=[200, 32])
+        fc = fluid.layers.fc(emb, size=64)
+        lstm, _ = fluid.layers.dynamic_lstm(input=fc, size=64)
+        last = fluid.layers.sequence_pool(lstm, 'last')
+        probs = fluid.layers.fc(last, size=2, act='softmax')
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(input=probs,
+                                                            label=label))
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+        return ['words'], probs, loss
+
+    rng = np.random.RandomState(1)
+    lens = [7, 5, 9, 6]
+    toks = np.concatenate([
+        rng.randint(0, 100, lens[i]) if i % 2 == 0
+        else rng.randint(100, 200, lens[i]) for i in range(4)])
+    words = fluid.create_lod_tensor(toks.reshape(-1, 1).astype(np.int64),
+                                    [lens])
+    labs = np.array([[0], [1], [0], [1]])
+
+    def feeds(n):
+        for _ in range(n):
+            yield {'words': words, 'label': labs}
+
+    _train_save_infer(build, feeds, str(tmp_path / 'lstm'), steps=15,
+                      converge=0.95)
+
+
+def test_book_fit_a_line(tmp_path):
+    """test_fit_a_line.py: linear regression on uci-housing shapes."""
+    def build():
+        x = fluid.layers.data(name='x', shape=[13], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.01).minimize(loss)
+        return ['x'], pred, loss
+
+    rng = np.random.RandomState(2)
+    xs = rng.randn(64, 13).astype(np.float32)
+    w = rng.randn(13, 1).astype(np.float32)
+    ys = xs @ w
+
+    def feeds(n):
+        for _ in range(n):
+            yield {'x': xs, 'y': ys}
+
+    _train_save_infer(build, feeds, str(tmp_path / 'line'), steps=20,
+                      converge=0.5)

@@ -69,20 +69,22 @@ def collect():
         if names is None:
             names = [n for n in dir(mod) if not n.startswith('_')]
         for n in sorted(names):
-            obj = getattr(mod, n, None)
+            try:
+                obj = getattr(mod, n)
+            except AttributeError:
+                # a broken __all__ export must FAIL the gate, not vanish
+                raise SystemExit(
+                    "broken export: %s.__all__ lists %r but the attribute "
+                    "does not exist" % (modname, n))
             if obj is None or inspect.ismodule(obj):
                 continue
             # one canonical entry per object: re-exports (Variable under
             # paddle_tpu AND paddle_tpu.layers ...) would multiply drift
             # noise in the spec
-            try:
-                key = id(obj)
-            except TypeError:
-                key = None
-            if key is not None and key in seen_objs:
+            key = id(obj)
+            if key in seen_objs:
                 continue
-            if key is not None:
-                seen_objs.add(key)
+            seen_objs.add(key)
             if inspect.isclass(obj):
                 lines.append('%s.%s.__init__ %s'
                              % (modname, n, _sig(obj.__init__)))
@@ -94,6 +96,10 @@ def collect():
                         lines.append(entry)
             elif callable(obj):
                 lines.append('%s.%s %s' % (modname, n, _sig(obj)))
+            else:
+                # constants/singletons are part of the surface too
+                lines.append('%s.%s <constant:%s>'
+                             % (modname, n, type(obj).__name__))
     return lines
 
 
