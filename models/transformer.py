@@ -10,6 +10,9 @@ whole block onto the MXU; bf16 AMP applies via contrib.mixed_precision.
 """
 from __future__ import annotations
 
+import os
+import warnings
+
 import paddle_tpu as fluid
 
 
@@ -25,7 +28,7 @@ def _merge_heads(x, n_head, d_model, seq):
 
 
 def multi_head_attention(q_in, kv_in, n_head, d_model, q_len, kv_len,
-                         mask=None, dropout=0.0):
+                         mask=None, dropout=0.0, causal=False):
     q = fluid.layers.fc(q_in, size=d_model, num_flatten_dims=2,
                         bias_attr=False)
     k = fluid.layers.fc(kv_in, size=d_model, num_flatten_dims=2,
@@ -36,14 +39,38 @@ def multi_head_attention(q_in, kv_in, n_head, d_model, q_len, kv_len,
     k = _split_heads(k, n_head, d_model, kv_len)
     v = _split_heads(v, n_head, d_model, kv_len)
     scale = (d_model // n_head) ** -0.5
-    scores = fluid.layers.matmul(q, k, transpose_y=True, alpha=scale)
-    if mask is not None:
-        scores = scores + mask  # [S, S] broadcast over [B, H, S, S]
-    weights = fluid.layers.softmax(scores)
-    if dropout:
-        weights = fluid.layers.dropout(weights, dropout_prob=dropout,
-                                       dropout_implementation='upscale_in_train')
-    ctxv = fluid.layers.matmul(weights, v)
+    use_flash = os.environ.get('PTPU_FLASH_ATTN', '0') not in ('', '0')
+    if use_flash and dropout > 0.0:
+        warnings.warn("PTPU_FLASH_ATTN is set but attention dropout > 0 "
+                      "forces the unfused path; build with dropout=0.0 to "
+                      "engage flash attention")
+    if use_flash and dropout == 0.0 and (mask is None or causal):
+        # opt-in fused path (Pallas flash attention, O(S) memory). Measured
+        # on the v5e tunnel it LOSES to XLA's fused softmax-matmul at seq
+        # 256-1024 (45k vs 120k tok/s @1024), so XLA composition is the
+        # default; flash matters for sequences whose [B,H,S,S] scores
+        # don't fit, where the O(S^2) memory wall, not speed, decides
+        ctxv = fluid.layers.fused_multihead_attention(q, k, v,
+                                                      causal=causal,
+                                                      scale=scale)
+    else:
+        scores = fluid.layers.matmul(q, k, transpose_y=True, alpha=scale)
+        if mask is not None:
+            scores = scores + mask  # [S, S] broadcast over [B, H, S, S]
+        elif causal:
+            # causal must mean the same thing on BOTH paths
+            pos = fluid.layers.range(0, q_len, 1, 'int32')
+            row = fluid.layers.reshape(pos, shape=[q_len, 1])
+            col = fluid.layers.reshape(pos, shape=[1, q_len])
+            above = fluid.layers.cast(
+                fluid.layers.greater_than(col, row), 'float32')
+            scores = scores + above * -1e9
+        weights = fluid.layers.softmax(scores)
+        if dropout:
+            weights = fluid.layers.dropout(
+                weights, dropout_prob=dropout,
+                dropout_implementation='upscale_in_train')
+        ctxv = fluid.layers.matmul(weights, v)
     out = _merge_heads(ctxv, n_head, d_model, q_len)
     return fluid.layers.fc(out, size=d_model, num_flatten_dims=2,
                            bias_attr=False)
@@ -72,7 +99,8 @@ def decoder_layer(x, enc_out, n_head, d_model, d_ff, trg_len, src_len,
                   causal_mask, dropout):
     x = _residual_ln(x, multi_head_attention(x, x, n_head, d_model, trg_len,
                                              trg_len, mask=causal_mask,
-                                             dropout=dropout), dropout)
+                                             dropout=dropout, causal=True),
+                     dropout)
     x = _residual_ln(x, multi_head_attention(x, enc_out, n_head, d_model,
                                              trg_len, src_len,
                                              dropout=dropout), dropout)

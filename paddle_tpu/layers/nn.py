@@ -1467,3 +1467,17 @@ def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
                'soft_max_lower_bound': soft_max_lower_bound},
         infer_shape=False)
     return out
+
+
+def fused_multihead_attention(q, k, v, causal=False, scale=1.0, name=None):
+    """Fused [B, H, S, D] attention: Pallas flash attention on TPU, naive
+    composition elsewhere (TPU-native extension; the reference composes
+    attention in nets.scaled_dot_product_attention)."""
+    helper = LayerHelper('fused_multihead_attention', name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    helper.append_op(
+        type='fused_multihead_attention',
+        inputs={'Q': q, 'K': k, 'V': v}, outputs={'Out': out},
+        attrs={'causal': causal, 'scale': scale}, infer_shape=False)
+    out.shape = q.shape  # same [B, H, S, D] as the query
+    return out

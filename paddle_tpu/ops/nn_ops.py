@@ -488,3 +488,36 @@ def _affine_grid(ctx, ins):
     base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H, W, 3]
     out = jnp.einsum('nij,hwj->nhwi', theta, base)
     return {'Output': [out]}
+
+
+@register('fused_multihead_attention', diff_inputs=('Q', 'K', 'V'))
+def _fused_multihead_attention(ctx, ins):
+    """TPU-native fused attention (beyond reference parity: the reference
+    composes scaled_dot_product_attention from matmul/softmax ops,
+    nets.py). On TPU this lowers to the Pallas flash-attention kernel —
+    O(S) memory, no [B,H,S,S] materialization; elsewhere (CPU tests) the
+    naive composition. Q/K/V: [B, H, S, D]."""
+    q, k, v = ins['Q'][0], ins['K'][0], ins['V'][0]
+    causal = bool(ctx.attr('causal', False))
+    scale = float(ctx.attr('scale', 1.0))
+    use_flash = any(d.platform in ('tpu', 'axon') for d in jax.devices())
+    if use_flash:
+        try:
+            from jax.experimental.pallas.ops.tpu.flash_attention import (
+                flash_attention)
+            out = flash_attention(q * scale, k, v, causal=causal)
+            return {'Out': [out]}
+        except (ImportError, NotImplementedError, ValueError) as e:
+            # fall through to the O(S^2) composition — but say so: on long
+            # sequences the fallback may be the OOM flash was avoiding
+            import warnings
+            warnings.warn("flash attention unavailable (%s); using the "
+                          "naive O(S^2) attention composition" % (e,))
+    s = jnp.einsum('bhqd,bhkd->bhqk', q * scale, k)
+    if causal:
+        Sq, Sk = q.shape[2], k.shape[2]
+        mask = jnp.tril(jnp.ones((Sq, Sk), bool), k=Sk - Sq)
+        s = jnp.where(mask, s, jnp.asarray(-1e30, s.dtype))
+    p = jax.nn.softmax(amp.promote_f32(s), axis=-1)
+    p = amp.restore(p, s)
+    return {'Out': [jnp.einsum('bhqk,bhkd->bhqd', p, v)]}
