@@ -1,15 +1,35 @@
 """Benchmark: training throughput on one TPU chip.
 
 Methodology mirrors the reference's benchmark/fluid/fluid_benchmark.py
-(synthetic data, steady-state samples/sec after warmup; fluid_benchmark.py:139).
-Baseline for vs_baseline is the only committed reference ResNet-50 training
-number: 84.08 img/s (2S Xeon 6148 + MKL-DNN, bs=256 —
-benchmark/IntelOptimizedPaddle.md:45); the K40m/V100 fluid numbers are not
-committed in-tree (BASELINE.md).
+(synthetic data, steady-state samples/sec after warmup; fluid_benchmark.py:139
+prints every metric it measures — so does this harness: one JSON line per
+metric, and a failed metric emits an {"metric", "error"} line instead of
+sinking the process).
 
-Prints one JSON line per metric; the headline ResNet-50 line is printed LAST:
-{"metric", "value", "unit", "vs_baseline", "mfu", ...}. Training runs in
-bf16 mixed precision (contrib.mixed_precision) — the TPU-native default.
+Hardening contract (the r3 driver artifact was destroyed by one transient
+axon-tunnel flake):
+  * EVERY benchmark runs inside a per-metric try/except — no metric can
+    crash the process; main() always exits 0.
+  * Transient tunnel errors (INTERNAL / remote_compile / UNAVAILABLE ...)
+    are retried up to 3 times with exponential backoff.
+  * The headline (ResNet-50) RUNS FIRST, and its result line is printed
+    immediately (insurance against a later hard crash) and re-printed LAST
+    so the driver's last-JSON-line parse still sees the headline.
+
+Baselines (vs_baseline derivations, see BASELINE.md):
+  * resnet: 84.08 img/s — the only committed reference training number
+    (2S Xeon 6148 + MKL-DNN, bs=256, benchmark/IntelOptimizedPaddle.md:45).
+  * transformer / bert: the reference committed no tokens/s number, so the
+    baseline is FLOPs-equalized from the same committed Xeon run: that
+    hardware sustained 84.08 img/s x 24.53 GFLOPs/img = 2.063e12 train
+    FLOP/s; baseline tokens/s = 2.063e12 / flops_per_token. Both sides are
+    compute-bound, so equal-FLOPs is the honest proxy.
+  * ctr: no committed reference CTR number exists and a FLOPs proxy is
+    meaningless for an embedding-gather-bound workload, so the ratio is
+    reported against self (=1.0) with the basis stated in the line.
+
+Training runs in bf16 mixed precision (contrib.mixed_precision) — the
+TPU-native default.
 """
 import json
 import os
@@ -21,9 +41,6 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import numpy as np
 
 BASELINE_RESNET_IMG_S = 84.08  # ResNet-50 train, IntelOptimizedPaddle.md:45
-# No committed reference tokens/s exists (BASELINE.md); use the only LSTM-era
-# seq number as a denominator proxy: 83 ms/batch @ bs=64 2-layer LSTM is not
-# comparable, so vs_baseline for transformer is reported against 1.0 (self).
 
 # Peak dense bf16 FLOP/s per chip, keyed on jax device_kind.
 PEAK_FLOPS = {
@@ -42,6 +59,17 @@ PEAK_FLOPS = {
 # ResNet-50 @224: 4.089e9 MACs forward (conv+fc, standard count).
 RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 2 * 4.089e9
 
+# Measured training FLOP/s of the committed reference Xeon ResNet run —
+# the denominator for FLOPs-equalized baselines (module docstring).
+XEON_TRAIN_FLOPS = BASELINE_RESNET_IMG_S * RESNET50_TRAIN_FLOPS_PER_IMG
+
+# Substrings identifying transient axon-tunnel / RPC faults worth retrying
+# (r3's fatal flake: "INTERNAL: ...remote_compile: read body: response body
+# closed before all bytes were read").
+TRANSIENT_MARKERS = ('remote_compile', 'INTERNAL', 'UNAVAILABLE',
+                     'DEADLINE_EXCEEDED', 'read body', 'response body closed',
+                     'Connection reset', 'Socket closed', 'EOF')
+
 
 def _peak_flops():
     import jax
@@ -53,11 +81,41 @@ def _peak_flops():
     return None
 
 
-def _emit(metric, value, unit, vs_baseline, **extra):
+def _line(metric, value, unit, vs_baseline, **extra):
     line = {'metric': metric, 'value': round(value, 2), 'unit': unit,
             'vs_baseline': round(vs_baseline, 2)}
     line.update(extra)
-    print(json.dumps(line))
+    return line
+
+
+def _print_line(line):
+    print(json.dumps(line), flush=True)
+
+
+def is_transient(exc):
+    msg = str(exc)
+    return any(m in msg for m in TRANSIENT_MARKERS)
+
+
+def run_metric(name, fn, retries=3, backoff_s=5, sleep=None):
+    """Run one benchmark with transient-fault retries and full isolation.
+
+    Returns the metric line dict on success, or an error line dict (never
+    raises). The error line carries the metric name, the error string, the
+    attempt count, and whether the final error looked transient.
+    """
+    last = None
+    for attempt in range(retries):
+        try:
+            return fn()
+        except Exception as e:  # per-metric isolation: nothing may escape
+            last = e
+            if attempt + 1 < retries and is_transient(e):
+                (sleep or time.sleep)(backoff_s * (2 ** attempt))
+                continue
+            break
+    return {'metric': name, 'error': str(last)[:300],
+            'attempts': attempt + 1, 'transient': is_transient(last)}
 
 
 def _timed_steps(exe, program, feed, loss, steps, warmup=4):
@@ -73,6 +131,14 @@ def _timed_steps(exe, program, feed, loss, steps, warmup=4):
                      return_numpy=False)
     _ = float(np.asarray(l).reshape(-1)[0])  # sync
     return time.perf_counter() - t0
+
+
+def _device():
+    import jax
+    import paddle_tpu as fluid
+    exe = fluid.Executor(fluid.TPUPlace())
+    dev = jax.devices(exe._device.platform)[0] if exe._device else None
+    return exe, dev
 
 
 def bench_resnet():
@@ -91,7 +157,7 @@ def bench_resnet():
     if use_bf16:
         fluid.contrib.mixed_precision.enable_bf16(main_p)
 
-    exe = fluid.Executor(fluid.TPUPlace())
+    exe, dev = _device()
     exe.run(startup_p)
 
     # synthetic data staged on device ONCE (reference benchmark's synthetic
@@ -99,7 +165,6 @@ def bench_resnet():
     # throughput measures the train step, not the PCIe/tunnel transfer
     import jax
     import jax.numpy as jnp
-    dev = jax.devices(exe._device.platform)[0] if exe._device else None
     xs = jax.device_put(
         jnp.asarray(np.random.randn(batch, 3, 224, 224), jnp.float32), dev)
     lab = jax.device_put(
@@ -110,10 +175,11 @@ def bench_resnet():
     img_s = batch * steps / dt
     peak = _peak_flops()
     mfu = (img_s * RESNET50_TRAIN_FLOPS_PER_IMG / peak) if peak else None
-    _emit('resnet50_train_img_s_per_chip', img_s, 'img/s',
-          img_s / BASELINE_RESNET_IMG_S,
-          mfu=round(mfu, 4) if mfu is not None else None,
-          dtype='bf16' if use_bf16 else 'fp32', batch=batch)
+    return _line('resnet50_train_img_s_per_chip', img_s, 'img/s',
+                 img_s / BASELINE_RESNET_IMG_S,
+                 mfu=round(mfu, 4) if mfu is not None else None,
+                 dtype='bf16' if use_bf16 else 'fp32', batch=batch,
+                 baseline='84.08 img/s Xeon 6148 (IntelOptimizedPaddle.md:45)')
 
 
 def bench_transformer():
@@ -131,12 +197,11 @@ def bench_transformer():
             d_model=512, d_ff=2048, n_head=8, n_layer=6)
     fluid.contrib.mixed_precision.enable_bf16(main_p)
 
-    exe = fluid.Executor(fluid.TPUPlace())
+    exe, dev = _device()
     exe.run(startup_p)
 
     import jax
     import jax.numpy as jnp
-    dev = jax.devices(exe._device.platform)[0] if exe._device else None
     rng = np.random.RandomState(0)
     feed = {}
     for name, shape, dtype in feeds:
@@ -151,9 +216,72 @@ def bench_transformer():
     tok_s = batch * seq_len * steps / dt
     peak = _peak_flops()
     mfu = (tok_s * flops_per_tok / peak) if peak else None
-    _emit('transformer_base_tokens_s_per_chip', tok_s, 'tokens/s', 1.0,
-          mfu=round(mfu, 4) if mfu is not None else None, dtype='bf16',
-          batch=batch, seq_len=seq_len)
+    # FLOPs-equalized Xeon baseline (module docstring): same FLOP/s as the
+    # committed ResNet Xeon run, spent on this model's per-token cost.
+    base_tok_s = XEON_TRAIN_FLOPS / flops_per_tok
+    return _line('transformer_base_tokens_s_per_chip', tok_s, 'tokens/s',
+                 tok_s / base_tok_s,
+                 mfu=round(mfu, 4) if mfu is not None else None, dtype='bf16',
+                 batch=batch, seq_len=seq_len,
+                 baseline='FLOPs-equalized Xeon 6148 proxy: %.0f tok/s'
+                          % base_tok_s)
+
+
+def bench_bert():
+    import paddle_tpu as fluid
+    from models.bert import build_bert_pretrain
+
+    batch = int(os.environ.get('PTPU_BENCH_BERT_BATCH', '64'))
+    seq_len = int(os.environ.get('PTPU_BENCH_BERT_SEQ', '128'))
+    steps = int(os.environ.get('PTPU_BENCH_BERT_STEPS', '20'))
+    k_merge = int(os.environ.get('PTPU_BENCH_BERT_GA', '2'))
+
+    vocab, d_model, d_ff, n_head, n_layer = 30522, 768, 3072, 12, 12
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup_p):
+        feeds, loss = build_bert_pretrain(
+            vocab=vocab, max_len=seq_len, d_model=d_model, d_ff=d_ff,
+            n_head=n_head, n_layer=n_layer)
+    fluid.contrib.mixed_precision.enable_bf16(main_p)
+    if k_merge > 1:
+        fluid.contrib.gradient_merge.enable(k_merge, main_p)
+
+    exe, dev = _device()
+    exe.run(startup_p)
+
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    feed = {}
+    for name, shape, dtype in feeds:
+        full = (batch,) + tuple(shape)
+        if dtype == 'int64':
+            hi = vocab if name == 'tok_ids' else (
+                2 if name == 'seg_ids' else vocab)
+            feed[name] = jax.device_put(jnp.asarray(
+                rng.randint(0, hi, full).astype(np.int32)), dev)
+        else:  # mlm_weights: ~15% masked positions
+            feed[name] = jax.device_put(jnp.asarray(
+                (rng.rand(*full) < 0.15).astype(np.float32)), dev)
+
+    dt = _timed_steps(exe, main_p, feed, loss, steps, warmup=3)
+    tok_s = batch * seq_len * steps / dt
+    # analytic train FLOPs per token (fwd 2*MACs, train = 3x): per encoder
+    # layer 4d^2 proj + 2*d*dff ffn + 2*S*d attention scores; MLM head
+    # d^2 transform + d*V projection over every position (models/bert.py)
+    macs_per_tok = (n_layer * (4 * d_model ** 2 + 2 * d_model * d_ff
+                               + 2 * seq_len * d_model)
+                    + d_model ** 2 + d_model * vocab)
+    flops_per_tok = 3 * 2 * macs_per_tok
+    peak = _peak_flops()
+    mfu = (tok_s * flops_per_tok / peak) if peak else None
+    base_tok_s = XEON_TRAIN_FLOPS / flops_per_tok
+    return _line('bert_mlm_tokens_s_per_chip', tok_s, 'tokens/s',
+                 tok_s / base_tok_s,
+                 mfu=round(mfu, 4) if mfu is not None else None, dtype='bf16',
+                 batch=batch, seq_len=seq_len, grad_merge_k=k_merge,
+                 baseline='FLOPs-equalized Xeon 6148 proxy: %.0f tok/s'
+                          % base_tok_s)
 
 
 def bench_ctr():
@@ -167,12 +295,11 @@ def bench_ctr():
     with fluid.program_guard(main_p, startup_p):
         feeds, loss = build_deepfm_train()
 
-    exe = fluid.Executor(fluid.TPUPlace())
+    exe, dev = _device()
     exe.run(startup_p)
 
     import jax
     import jax.numpy as jnp
-    dev = jax.devices(exe._device.platform)[0] if exe._device else None
     rng = np.random.RandomState(0)
     feed = {}
     for name, shape, dtype, vocab in feeds:
@@ -186,26 +313,62 @@ def bench_ctr():
         feed[name] = jax.device_put(jnp.asarray(arr), dev)
 
     dt = _timed_steps(exe, main_p, feed, loss, steps, warmup=3)
-    _emit('ctr_deepfm_samples_s_per_chip', batch * steps / dt, 'samples/s',
-          1.0, batch=batch)
+    samples_s = batch * steps / dt
+    # analytic dense-tower MACs/sample (models/deepfm.py defaults:
+    # concat 26*16+13=429 -> 400 -> 400 -> 400 -> 1, + dense fc 13->1);
+    # embedding gathers carry ~0 MXU FLOPs, so the honest MFU is tiny —
+    # this workload measures the sparse/gather path, not the MXU
+    macs = 429 * 400 + 400 * 400 + 400 * 400 + 400 + 13
+    flops_per_sample = 3 * 2 * macs
+    peak = _peak_flops()
+    mfu = (samples_s * flops_per_sample / peak) if peak else None
+    return _line(
+        'ctr_deepfm_samples_s_per_chip', samples_s, 'samples/s', 1.0,
+        mfu=round(mfu, 6) if mfu is not None else None, batch=batch,
+        baseline='self (no committed reference CTR number, BASELINE.md; '
+                 'FLOPs proxies are meaningless for embedding-bound work)')
 
 
-def main():
-    only = os.environ.get('PTPU_BENCH_ONLY', '')
-    extras = []
-    if not only or only == 'all':
-        extras = ['transformer', 'ctr']
-    elif only != 'resnet':
-        extras = [only]
-    for name in extras:
-        try:
-            {'transformer': bench_transformer, 'ctr': bench_ctr}[name]()
-        except Exception as e:  # secondary metrics must not sink the headline
-            print(json.dumps({'metric': name, 'error': str(e)[:200]}),
-                  file=sys.stderr)
-    if only in ('', 'all', 'resnet'):
-        bench_resnet()
+BENCHES = [
+    ('resnet50_train_img_s_per_chip', bench_resnet),     # headline: FIRST
+    ('transformer_base_tokens_s_per_chip', bench_transformer),
+    ('bert_mlm_tokens_s_per_chip', bench_bert),
+    ('ctr_deepfm_samples_s_per_chip', bench_ctr),
+]
+
+_SHORT = {'resnet': 0, 'transformer': 1, 'bert': 2, 'ctr': 3}
+
+
+def main(benches=None):
+    """Run benchmarks; always exit 0. The headline runs first; its line is
+    printed immediately (insurance) and re-printed last (the driver parses
+    the final JSON line as the headline)."""
+    if benches is None:
+        benches = BENCHES
+        only = os.environ.get('PTPU_BENCH_ONLY', '')
+        if only and only != 'all':
+            tokens = [t.strip() for t in only.split(',') if t.strip()]
+            unknown = [t for t in tokens if t not in _SHORT]
+            for t in unknown:
+                _print_line({'metric': t,
+                             'error': 'unknown PTPU_BENCH_ONLY token'})
+            keep = {_SHORT[t] for t in tokens if t in _SHORT}
+            # run only what was recognized; a pure-typo selection runs
+            # nothing rather than burning TPU time on the full suite
+            benches = [b for i, b in enumerate(BENCHES) if i in keep]
+    headline_line = None
+    for i, (name, fn) in enumerate(benches):
+        line = run_metric(name, fn)
+        _print_line(line)
+        if i == 0:
+            headline_line = line
+    if headline_line is not None and len(benches) > 1:
+        # headline (success OR error) is the last JSON line — the driver
+        # parses the final line, and mislabeling a secondary metric as the
+        # headline would be worse than an explicit headline error
+        _print_line(headline_line)
+    return 0
 
 
 if __name__ == '__main__':
-    main()
+    sys.exit(main())

@@ -11,7 +11,8 @@ from . import detection      # noqa: F401
 from .ops import *           # noqa: F401,F403
 from . import ops as _ops_mod
 from .tensor import (create_tensor, create_parameter, create_global_var,  # noqa
-                     sums, assign, fill_constant, fill_constant_batch_size_like,
+                     sums, sum, assign, fill_constant,
+                     fill_constant_batch_size_like,
                      ones, zeros, zeros_like, reverse, has_inf, has_nan,
                      isfinite, tensor_array_to_tensor, range)
 from .io import (data, read_file, load, py_reader,  # noqa: F401

@@ -43,6 +43,14 @@ def sums(input, out=None):
     return out
 
 
+def sum(x):
+    """Elementwise sum of a Variable or list of Variables
+    (ref: python/paddle/fluid/layers/nn.py `sum`, operators/sum_op.cc)."""
+    if isinstance(x, Variable):
+        x = [x]
+    return sums(list(x))
+
+
 def assign(input, output=None):
     helper = LayerHelper('assign')
     if isinstance(input, Variable):

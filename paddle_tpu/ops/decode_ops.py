@@ -82,32 +82,57 @@ def _warpctc(ctx, ins):
     return {'Loss': [loss.reshape(-1, 1)], 'WarpCTCGrad': None}
 
 
-@register('ctc_greedy_decoder', no_grad=True, lod='aware')
-def _ctc_greedy_decoder(ctx, ins):
-    """Best-path decode: argmax per frame, merge repeats, drop blanks.
-    Output keeps the input lod; decoded tokens are left-aligned per row
-    span, -1 elsewhere (see module docstring on static shapes)."""
-    x = ins['Input'][0]
-    blank = int(ctx.attr('blank', 0))
-    off = _lod_offsets(x, 'ctc_greedy_decoder')
-    best = jnp.argmax(unwrap(x), axis=-1).astype(INT_T())  # [T]
+def _align_flat(best, off, blank, merge_repeated=True):
+    """Merge repeats (optionally) and drop blanks over a flat LoD token
+    stream; kept tokens left-align within their original row span, -1
+    elsewhere (see module docstring on static shapes). One program
+    regardless of batch: a frame is kept if it differs from the previous
+    frame OF THE SAME SEQUENCE (when merging) and is not blank; kept
+    tokens scatter to their within-sequence rank."""
     T = best.shape[0]
-    # flat segment formulation (one program regardless of batch): a frame is
-    # kept if it differs from the previous frame OF THE SAME SEQUENCE and is
-    # not blank; kept tokens scatter to their within-sequence rank
     lens = off[1:] - off[:-1]
     seg = jnp.asarray(np.repeat(np.arange(len(lens)), lens).astype(np.int32))
     off_j = jnp.asarray(off.astype(np.int32))
     prev = jnp.concatenate([jnp.full((1,), -1, best.dtype), best[:-1]])
     first = jnp.asarray(
         np.isin(np.arange(T), off[:-1]))  # first frame of each sequence
-    keep = (first | (best != prev)) & (best != blank)
+    fresh = (first | (best != prev)) if merge_repeated \
+        else jnp.ones((T,), bool)
+    keep = fresh & (best != blank)
     csum = jnp.cumsum(keep.astype(jnp.int32))
     seq_base = jnp.take(jnp.concatenate(
         [jnp.zeros((1,), jnp.int32), csum]), jnp.take(off_j, seg))
     rank = csum - 1 - seq_base                    # within-seq kept rank
     tgt = jnp.where(keep, jnp.take(off_j, seg) + rank, T)
-    out = jnp.full((T,), -1, best.dtype).at[tgt].set(best, mode='drop')
+    return jnp.full((T,), -1, best.dtype).at[tgt].set(best, mode='drop')
+
+
+@register('ctc_greedy_decoder', no_grad=True, lod='aware')
+def _ctc_greedy_decoder(ctx, ins):
+    """Best-path decode: argmax per frame, merge repeats, drop blanks.
+    Output keeps the input lod; decoded tokens are left-aligned per row
+    span, -1 elsewhere."""
+    x = ins['Input'][0]
+    blank = int(ctx.attr('blank', 0))
+    off = _lod_offsets(x, 'ctc_greedy_decoder')
+    best = jnp.argmax(unwrap(x), axis=-1).astype(INT_T())  # [T]
+    out = _align_flat(best, off, blank)
+    return {'Output': [LoDArray(out.reshape(-1, 1), x.lod)]}
+
+
+@register('ctc_align', no_grad=True, lod='aware')
+def _ctc_align(ctx, ins):
+    """CTC alignment over already-decoded token ids: optionally merge
+    repeats, always remove blanks (ref: operators/ctc_align_op.cc). Unlike
+    the reference (which compacts the LoD), output keeps the input lod
+    with -1 padding after each sequence's kept tokens — the framework's
+    static-shape policy (module docstring)."""
+    x = ins['Input'][0]
+    blank = int(ctx.attr('blank', 0))
+    merge = bool(ctx.attr('merge_repeated', True))
+    off = _lod_offsets(x, 'ctc_align')
+    toks = unwrap(x).reshape(-1).astype(INT_T())
+    out = _align_flat(toks, off, blank, merge_repeated=merge)
     return {'Output': [LoDArray(out.reshape(-1, 1), x.lod)]}
 
 

@@ -398,6 +398,43 @@ def _huber_loss(ctx, ins):
     return {'Out': [loss], 'Residual': [r]}
 
 
+@register('hinge_loss')
+def _hinge_loss(ctx, ins):
+    """max(0, 1 - logits * (2*label - 1)) with {0,1} labels
+    (ref: operators/hinge_loss_op.cc)."""
+    x, y = ins['Logits'][0], ins['Labels'][0]
+    return {'Loss': [jnp.maximum(0.0, 1.0 - x * (2.0 * y - 1.0))]}
+
+
+@register('modified_huber_loss')
+def _modified_huber_loss(ctx, ins):
+    """z = x*(2y-1); loss = -4z for z<-1, (1-z)^2 for z<1, else 0
+    (ref: operators/modified_huber_loss_op.cc)."""
+    x, y = ins['X'][0], ins['Y'][0]
+    z = x * (2.0 * y - 1.0)
+    loss = jnp.where(z < -1.0, -4.0 * z,
+                     jnp.square(jnp.maximum(0.0, 1.0 - z)))
+    return {'Out': [loss.reshape(-1, 1)], 'IntermediateVal': [z]}
+
+
+@register('squared_l2_distance')
+def _squared_l2_distance(ctx, ins):
+    """Row-wise ||x - y||^2; y may have one row broadcast over the batch
+    (ref: operators/squared_l2_distance_op.cc)."""
+    x, y = ins['X'][0], ins['Y'][0]
+    x2 = x.reshape(x.shape[0], -1)
+    y2 = y.reshape(y.shape[0], -1)
+    sub = x2 - y2  # broadcasts when y has a single row
+    return {'sub_result': [sub],
+            'Out': [jnp.sum(jnp.square(sub), axis=1, keepdims=True)]}
+
+
+@register('l1_norm')
+def _l1_norm(ctx, ins):
+    """Scalar sum of absolute values (ref: operators/l1_norm_op.cc)."""
+    return {'Out': [jnp.sum(jnp.abs(X(ins))).reshape(1)]}
+
+
 @register('smooth_l1_loss')
 def _smooth_l1_loss(ctx, ins):
     x, y = ins['X'][0], ins['Y'][0]

@@ -177,6 +177,122 @@ def _pool3d(ctx, ins):
     return _pool(ctx, ins, 3)
 
 
+@register('max_pool2d_with_index')
+def _max_pool2d_with_index(ctx, ins):
+    """Max pool returning values + argmax flat index within each input
+    [H, W] plane (ref: operators/pool_with_index_op.cc, math/pooling.cc:625
+    index = h * input_width + w; first max wins, matching jnp.argmax).
+
+    TPU design: the kernel window is unrolled statically (kh*kw strided
+    slices stacked on a trailing axis) so value-max and index-gather are
+    one fused argmax — no data-dependent shapes."""
+    x = X(ins)
+    kh, kw = _pair(ctx.attr('ksize'))
+    sh, sw = _pair(ctx.attr('strides', [1, 1]))
+    ph, pw = _pair(ctx.attr('paddings', [0, 0]))
+    if ctx.attr('global_pooling', False):
+        # one argmax over the flattened plane — the windowed unroll below
+        # would trace H*W slices for the same result
+        n, c, h, w = x.shape
+        flat = x.reshape(n, c, h * w)
+        arg = jnp.argmax(flat, axis=-1)
+        return {'Out': [jnp.max(flat, axis=-1).reshape(n, c, 1, 1)],
+                'Mask': [arg.astype(jnp.int32).reshape(n, c, 1, 1)]}
+    n, c, h, w = x.shape
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                 constant_values=neg)
+    vals, idxs = [], []
+    for i in range(kh):
+        for j in range(kw):
+            sl = jax.lax.slice(
+                xp, (0, 0, i, j),
+                (n, c, i + (oh - 1) * sh + 1, j + (ow - 1) * sw + 1),
+                (1, 1, sh, sw))
+            vals.append(sl)
+            row = jnp.arange(oh) * sh + i - ph      # input-plane coords
+            col = jnp.arange(ow) * sw + j - pw
+            idxs.append(row[:, None] * w + col[None, :])
+    stack_v = jnp.stack(vals, axis=-1)              # [N, C, OH, OW, K]
+    stack_i = jnp.stack(idxs, axis=-1)              # [OH, OW, K]
+    arg = jnp.argmax(stack_v, axis=-1)
+    mask = jnp.take_along_axis(
+        jnp.broadcast_to(stack_i, stack_v.shape), arg[..., None],
+        axis=-1)[..., 0]
+    return {'Out': [jnp.max(stack_v, axis=-1)],
+            'Mask': [mask.astype(jnp.int32)]}
+
+
+@register('unpool')
+def _unpool(ctx, ins):
+    """Max unpooling: scatter X values to the Indices positions of each
+    output plane, zeros elsewhere (ref: operators/unpool_op.cc:68
+    OutputSize = (in - 1) * stride - 2 * padding + ksize,
+    math/unpooling.cc scatter). One batched scatter — XLA lowers it to a
+    single dynamic-update pass."""
+    x, idx = ins['X'][0], ins['Indices'][0]
+    kh, kw = _pair(ctx.attr('ksize'))
+    sh, sw = _pair(ctx.attr('strides', [1, 1]))
+    ph, pw = _pair(ctx.attr('paddings', [0, 0]))
+    n, c, h, w = x.shape
+    oh = (h - 1) * sh - 2 * ph + kh
+    ow = (w - 1) * sw - 2 * pw + kw
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    b_ix = jnp.arange(n)[:, None, None]
+    c_ix = jnp.arange(c)[None, :, None]
+    out = flat.at[b_ix, c_ix, idx.reshape(n, c, -1).astype(jnp.int32)].set(
+        x.reshape(n, c, -1), mode='drop')
+    return {'Out': [out.reshape(n, c, oh, ow)]}
+
+
+@register('spp')
+def _spp(ctx, ins):
+    """Spatial pyramid pooling: levels 2^0..2^(h-1) bins per side, each an
+    exact-cover pool (kernel = ceil(dim/bins), asymmetric pad to
+    kernel*bins), flattened [N, C*bins*bins] and concatenated
+    (ref: operators/spp_op.h). Each level is one reduce_window — no
+    per-bin loops."""
+    x = X(ins)
+    levels = int(ctx.attr('pyramid_height', 1))
+    ptype = ctx.attr('pooling_type', 'max')
+    n, c, h, w = x.shape
+    outs = []
+    for p in range(levels):
+        bins = 2 ** p
+        kh, kw = -(-h // bins), -(-w // bins)
+        ph, pw = (kh * bins - h + 1) // 2, (kw * bins - w + 1) // 2
+        pad = [(0, 0), (0, 0),
+               (ph, max(0, kh * bins - h - ph)),
+               (pw, max(0, kw * bins - w - pw))]
+        window, strides = (1, 1, kh, kw), (1, 1, kh, kw)
+        if ptype == 'max':
+            lvl = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window,
+                                        strides, pad)
+        else:
+            s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides,
+                                      pad)
+            cnt = jax.lax.reduce_window(jnp.ones(x.shape, x.dtype), 0.0,
+                                        jax.lax.add, window, strides, pad)
+            lvl = s / cnt  # exclusive counting, as the reference pools
+        outs.append(lvl.reshape(n, c * bins * bins))
+    return {'Out': [jnp.concatenate(outs, axis=1)]}
+
+
+@register('conv_shift')
+def _conv_shift(ctx, ins):
+    """Circular convolution (NTM shift): Out[b,i] = sum_j X[b,(i+j-half)%M]
+    * Y[b,j], N odd (ref: operators/conv_shift_op.cc). The N rotations are
+    a static gather -> one batched contraction on the MXU."""
+    x, y = ins['X'][0], ins['Y'][0]
+    m, nk = x.shape[1], y.shape[1]
+    offs = jnp.arange(nk) - (nk - 1) // 2
+    idx = (jnp.arange(m)[None, :] + offs[:, None]) % m   # [N, M]
+    return {'Out': [jnp.einsum('bnm,bn->bm', x[:, idx], y)]}
+
+
 # ---------------------------------------------------------------------------
 # normalization
 # ---------------------------------------------------------------------------

