@@ -14,8 +14,10 @@ construction (single optimizer stream, no lock-free races).
 from __future__ import annotations
 
 import glob as _glob
+import os
 import queue as _queue
 import threading
+import time as _time
 
 import numpy as np
 
@@ -167,7 +169,13 @@ class AsyncExecutor(object):
         self._exe = Executor(place)
 
     def run(self, program, data_feed, filelist, thread_num, fetch=None,
-            mode='', debug=False, epochs=1, scope=None):
+            mode='', debug=False, epochs=1, scope=None, journal_dir=None):
+        """File-driven train loop. With `journal_dir`, file dispatch runs
+        through the elastic TaskService (reader/elastic.py — the Go
+        master's lease/timeout/failure-cap design, go/master/service.go:89)
+        with per-batch progress journaled AFTER the train step, so a
+        killed run resumed with the same journal_dir skips batches already
+        trained on — mid-epoch resume without loss or duplication."""
         program = program or default_main_program()
         scope = scope or global_scope()
         if isinstance(filelist, str):
@@ -181,49 +189,128 @@ class AsyncExecutor(object):
         fetch = fetch or []
         fetch_names = [f if isinstance(f, str) else f.name for f in fetch]
 
+        svc = None
+        if journal_dir is not None:
+            from .reader.elastic import TaskService
+            os.makedirs(journal_dir, exist_ok=True)
+            svc = TaskService(
+                filelist,
+                journal_path=os.path.join(journal_dir, 'data_tasks.journal'))
+            # progress is journaled in BATCH units: a resume with another
+            # batch size would mis-skip, so reject it up front
+            prev_bs = svc.get_meta('batch_size')
+            if prev_bs is None:
+                svc.set_meta('batch_size', bs)
+            elif prev_bs != bs:
+                svc.close()
+                raise ValueError(
+                    "journal at %s was written with batch_size=%s; resuming "
+                    "with batch_size=%s would skip or replay the wrong "
+                    "batches" % (journal_dir, prev_bs, bs))
+
         batches = _queue.Queue(maxsize=max(2 * thread_num, 4))
         stop = object()
         errors = []
 
+        def _file_batches(path):
+            with open(path, 'rb') as f:
+                parsed, nlines = parse_multislot_lines(f.read(), slots)
+            offs = [np.concatenate([[0], np.cumsum(l)])
+                    for _, l in parsed]
+            out = []
+            for start in range(0, nlines, bs):
+                end = min(start + bs, nlines)
+                feed = {}
+                for (vals, lens), off, slot in zip(parsed, offs, slots):
+                    if not slot['is_used']:
+                        continue
+                    seg = vals[off[start]:off[end]]
+                    seg_lens = lens[start:end]
+                    if slot['type'] == 'float':
+                        arr = seg.astype(np.float32)
+                    else:
+                        arr = seg.astype(np.int64)
+                    if slot['is_dense']:
+                        feed[slot['name']] = arr.reshape(end - start, -1)
+                    else:
+                        feed[slot['name']] = create_lod_tensor(
+                            arr.reshape(-1, 1), [list(seg_lens)])
+                out.append(feed)
+            return out
+
         def ingest(paths):
             try:
                 for path in paths:
-                    with open(path, 'rb') as f:
-                        parsed, nlines = parse_multislot_lines(f.read(),
-                                                               slots)
-                    # slice into batches
-                    offs = [np.concatenate([[0], np.cumsum(l)])
-                            for _, l in parsed]
-                    for start in range(0, nlines, bs):
-                        end = min(start + bs, nlines)
-                        feed = {}
-                        for (vals, lens), off, slot in zip(parsed, offs,
-                                                           slots):
-                            if not slot['is_used']:
-                                continue
-                            seg = vals[off[start]:off[end]]
-                            seg_lens = lens[start:end]
-                            if slot['type'] == 'float':
-                                arr = seg.astype(np.float32)
-                            else:
-                                arr = seg.astype(np.int64)
-                            if slot['is_dense']:
-                                feed[slot['name']] = arr.reshape(
-                                    end - start, -1)
-                            else:
-                                feed[slot['name']] = create_lod_tensor(
-                                    arr.reshape(-1, 1), [list(seg_lens)])
-                        batches.put(feed)
+                    for feed in _file_batches(path):
+                        batches.put((feed, None, 0, False))
             except Exception as e:  # propagate to the train loop
                 errors.append(e)
 
+        def ingest_elastic():
+            while True:
+                leased = svc.get_task()
+                if leased is None:
+                    if svc.epoch_done:
+                        return
+                    _time.sleep(0.02)  # another thread holds the last leases
+                    continue
+                task_id, path, skip = leased
+                try:
+                    file_batches = _file_batches(path)
+                    if skip >= len(file_batches):
+                        svc.task_finished(task_id)
+                        continue
+                    for bi, feed in enumerate(file_batches):
+                        if bi < skip:
+                            continue  # journaled: already trained on
+                        batches.put((feed, task_id, bi,
+                                     bi == len(file_batches) - 1))
+                        # put() can block behind other tasks' batches for
+                        # longer than the lease — heartbeat so the task
+                        # isn't re-dispatched into duplicate training
+                        svc.renew_lease(task_id)
+                except Exception as e:
+                    # lease-and-retry semantics (go/master/service.go:140):
+                    # re-queue until the failure cap; only a DROPPED task
+                    # is a hard error worth sinking the run
+                    svc.task_failed(task_id)
+                    if svc.is_dropped(task_id):
+                        errors.append(e)
+                        return
+
         results = []
+        # epoch accounting against the journal: `epochs` is the TOTAL the
+        # journal should reach, so a resumed run finishes the interrupted
+        # epoch and never over-trains past the requested count
+        start_epoch = 0
+        if svc is not None:
+            start_epoch = svc.epoch + (1 if svc.epoch_done else 0)
+        try:
+            self._run_epochs(range(start_epoch, max(1, int(epochs))),
+                             svc, thread_num, filelist, ingest,
+                             ingest_elastic, batches, stop, scope, program,
+                             fetch_names, results, errors, debug)
+        finally:
+            if svc is not None:
+                svc.close()
+        return results
+
+    def _run_epochs(self, epoch_range, svc, thread_num, filelist, ingest,
+                    ingest_elastic, batches, stop, scope, program,
+                    fetch_names, results, errors, debug):
         from .core.scope import scope_guard
-        for _epoch in range(max(1, int(epochs))):
-            shards = [filelist[i::thread_num] for i in range(thread_num)]
-            threads = [threading.Thread(target=ingest, args=(s,),
-                                        daemon=True)
-                       for s in shards if s]
+        for _epoch in epoch_range:
+            if svc is not None:
+                if svc.epoch_done:
+                    svc.new_epoch()
+                target = ingest_elastic
+                threads = [threading.Thread(target=target, daemon=True)
+                           for _ in range(thread_num)]
+            else:
+                shards = [filelist[i::thread_num] for i in range(thread_num)]
+                threads = [threading.Thread(target=ingest, args=(s,),
+                                            daemon=True)
+                           for s in shards if s]
 
             def closer(ts=threads):
                 for t in ts:
@@ -236,11 +323,18 @@ class AsyncExecutor(object):
 
             with scope_guard(scope):
                 while True:
-                    feed = batches.get()
-                    if feed is stop:
+                    item = batches.get()
+                    if item is stop:
                         break
+                    feed, task_id, bi, last = item
                     outs = self._exe.run(program, feed=feed,
                                          fetch_list=fetch_names)
+                    if task_id is not None:
+                        # journal AFTER the step: a crash replays at most
+                        # the in-flight batch, never skips a trained one
+                        svc.report_progress(task_id, bi + 1)
+                        if last:
+                            svc.task_finished(task_id)
                     if fetch_names:
                         results.append([np.asarray(o) for o in outs])
                         if debug:
@@ -249,4 +343,3 @@ class AsyncExecutor(object):
                                    for n, o in zip(fetch_names, outs)})
             if errors:
                 raise errors[0]
-        return results

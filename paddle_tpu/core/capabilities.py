@@ -1,0 +1,39 @@
+"""Per-backend capability probes.
+
+Some PJRT plugins (notably the axon TPU tunnel) don't implement host
+send/recv callbacks, which `py_func` (ops/tensor_ops.py, lowered via
+jax.pure_callback — ref: operators/py_func_op.cc) depends on. Probing once
+per platform and failing at BUILD time turns an opaque runtime
+UNIMPLEMENTED into an actionable error before any compile work happens.
+"""
+from __future__ import annotations
+
+_cache = {}
+
+
+def _platform_key(device):
+    client = getattr(device, 'client', None)
+    if client is not None and getattr(client, 'platform', None):
+        return client.platform
+    return device.platform
+
+
+def host_callbacks_supported(device=None):
+    """True if jax.pure_callback works on `device` (default: first default
+    device). Probed once per platform, cached."""
+    import jax
+    import jax.numpy as jnp
+    if device is None:
+        device = jax.devices()[0]
+    key = _platform_key(device)
+    if key not in _cache:
+        def probe(x):
+            return jax.pure_callback(
+                lambda a: a, jax.ShapeDtypeStruct((), jnp.float32), x)
+        try:
+            with jax.default_device(device):
+                jax.jit(probe)(jnp.float32(0.0)).block_until_ready()
+            _cache[key] = True
+        except Exception:
+            _cache[key] = False
+    return _cache[key]

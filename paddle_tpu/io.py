@@ -11,9 +11,11 @@ to JSON (programs are plain-python IR; see framework.py).
 """
 from __future__ import annotations
 
+import io as _io
 import json
 import os
 import struct
+import zlib
 
 import numpy as np
 
@@ -23,7 +25,7 @@ from .core.scope import global_scope
 from .core.lod import LoDArray, unwrap, lod_of
 
 _MAGIC = b'PTPU'
-_VERSION = 1
+_VERSION = 2  # v2 adds a crc32 of the payload to the header (v1 readable)
 
 
 # ---------------------------------------------------------------------------
@@ -32,13 +34,17 @@ _VERSION = 1
 def _serialize_tensor(f, value):
     data = np.asarray(unwrap(value))
     lod = [np.asarray(l).tolist() for l in lod_of(value)]
+    payload = np.ascontiguousarray(data).tobytes()
+    # CRC per tensor, mirroring the reference pserver checkpoints'
+    # corruption guard (go/pserver/service.go:346 crc32 + atomic rename)
     header = json.dumps({'dtype': data.dtype.name,
-                         'shape': list(data.shape), 'lod': lod}).encode()
+                         'shape': list(data.shape), 'lod': lod,
+                         'crc32': zlib.crc32(payload) & 0xffffffff}).encode()
     f.write(_MAGIC)
     f.write(struct.pack('<I', _VERSION))
     f.write(struct.pack('<I', len(header)))
     f.write(header)
-    f.write(np.ascontiguousarray(data).tobytes())
+    f.write(payload)
 
 
 def _deserialize_tensor(f):
@@ -51,12 +57,97 @@ def _deserialize_tensor(f):
     header = json.loads(f.read(hlen).decode())
     n = int(np.prod(header['shape'])) if header['shape'] else 1
     dt = np.dtype(header['dtype'])
-    data = np.frombuffer(f.read(n * dt.itemsize), dtype=dt).reshape(
-        header['shape'])
+    payload = f.read(n * dt.itemsize)
+    if 'crc32' in header and (zlib.crc32(payload) & 0xffffffff) \
+            != header['crc32']:
+        raise ValueError("tensor payload CRC mismatch — corrupt checkpoint")
+    data = np.frombuffer(payload, dtype=dt).reshape(header['shape'])
     arr = jnp.asarray(data)
     if header['lod']:
         return LoDArray(arr, [np.asarray(l, np.int32) for l in header['lod']])
     return arr
+
+
+# ---------------------------------------------------------------------------
+# multi-host coordination (ref: only pserver-owned shards write their own
+# checkpoint, checkpoint_notify_op.cc; dist_save_load.py equivalence). Here
+# params are replicated or GSPMD-sharded: process 0 alone writes (after
+# gathering cross-host shards), loads broadcast from process 0 so a shared
+# filesystem is NOT required.
+# ---------------------------------------------------------------------------
+def _proc_info():
+    try:
+        import jax
+        return jax.process_index(), jax.process_count()
+    except Exception:
+        return 0, 1
+
+
+def _full_value(value):
+    """Materialize a possibly cross-host-sharded array on every process
+    (collective when sharded — all processes must call in the same order)."""
+    import jax
+    data = unwrap(value)
+    if isinstance(data, jax.Array) and not data.is_fully_addressable:
+        from jax.experimental import multihost_utils
+        data = multihost_utils.process_allgather(data, tiled=True)
+        if isinstance(value, LoDArray):
+            return LoDArray(data, value.lod)
+        return data
+    return value
+
+
+def _barrier(tag):
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(tag)
+
+
+def _broadcast_bytes(blob, pid, error=None):
+    """Ship bytes (or an error) from process 0 to every process. The first
+    collective carries [length, ok]; an error on process 0 is broadcast as
+    the payload and raised on EVERY process — one host raising while the
+    others sit in a collective would otherwise hang the job."""
+    from jax.experimental import multihost_utils
+    if pid == 0 and error is not None:
+        blob = str(error).encode()
+    hdr = multihost_utils.broadcast_one_to_all(np.asarray(
+        [len(blob) if pid == 0 else 0,
+         0 if (pid == 0 and error is not None) else 1], np.int64))
+    size, ok = int(hdr[0]), int(hdr[1])
+    buf = np.frombuffer(blob, np.uint8) if pid == 0 \
+        else np.zeros(size, np.uint8)
+    buf = multihost_utils.broadcast_one_to_all(buf)
+    if not ok:
+        raise RuntimeError("load failed on process 0: %s"
+                           % buf.tobytes().decode(errors='replace'))
+    return buf.tobytes()
+
+
+class _atomic_file(object):
+    """Write-to-temp + fsync + os.replace: a reader never sees a partial
+    file (ref: go/pserver/service.go:346 checkpoint atomic rename)."""
+
+    def __init__(self, path):
+        self._path = path
+        self._tmp = '%s.tmp.%d' % (path, os.getpid())
+
+    def __enter__(self):
+        self._f = open(self._tmp, 'wb')
+        return self._f
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._f.close()
+            os.replace(self._tmp, self._path)
+        else:
+            self._f.close()
+            try:
+                os.remove(self._tmp)
+            except OSError:
+                pass
+        return False
 
 
 # ---------------------------------------------------------------------------
@@ -162,54 +253,124 @@ def _resolve_vars(main_program, vars, predicate):
 
 def save_vars(executor, dirname, main_program=None, vars=None,
               predicate=None, filename=None):
+    """Write vars to dirname. Multi-host: every process participates in
+    gathering cross-host shards (collective), but ONLY process 0 writes —
+    N processes racing identical writes to a shared FS was the r3 hazard.
+    Returns the list of paths this process wrote (empty on non-writers)."""
     vars = _resolve_vars(main_program, vars, predicate or (lambda v: True))
     scope = global_scope()
-    os.makedirs(dirname, exist_ok=True)
+    pid, pcount = _proc_info()
+    present = [(v, scope.get(v.name)) for v in vars]
+    present = [(v, val) for v, val in present if val is not None]
+    if pcount > 1:  # collective gather: same order on every process
+        present = [(v, _full_value(val)) for v, val in present]
+    written = []
+    save_err = None
+    if pid == 0:
+        try:
+            os.makedirs(dirname, exist_ok=True)
+            if filename is None:
+                for v, val in present:
+                    path = os.path.join(dirname, v.name)
+                    with _atomic_file(path) as f:
+                        _serialize_tensor(f, val)
+                    written.append(path)
+            else:
+                path = os.path.join(dirname, filename)
+                with _atomic_file(path) as f:
+                    f.write(struct.pack('<I', len(present)))
+                    for v, val in present:
+                        name = v.name.encode()
+                        f.write(struct.pack('<I', len(name)))
+                        f.write(name)
+                        _serialize_tensor(f, val)
+                written.append(path)
+        except Exception as e:
+            # the barrier below must still be reached — process 0 raising
+            # while the others wait in a collective would hang the job
+            save_err = e
+    if pcount > 1:
+        _barrier('ptpu:save_vars:' + dirname)  # files visible before return
+    if save_err is not None:
+        raise save_err
+    return written
+
+
+def _read_var_blob(dirname, names, filename):
+    """Read requested vars into the single-file wire format (in memory)."""
+    buf = _io.BytesIO()
     if filename is None:
-        for v in vars:
-            val = scope.get(v.name)
-            if val is None:
-                continue
-            with open(os.path.join(dirname, v.name), 'wb') as f:
-                _serialize_tensor(f, val)
+        entries = []
+        for name in names:
+            path = os.path.join(dirname, name)
+            if not os.path.exists(path):
+                raise RuntimeError("missing checkpoint file for var %r at %s"
+                                   % (name, path))
+            with open(path, 'rb') as f:
+                entries.append((name, f.read()))
+        buf.write(struct.pack('<I', len(entries)))
+        for name, raw in entries:
+            nb = name.encode()
+            buf.write(struct.pack('<I', len(nb)))
+            buf.write(nb)
+            buf.write(raw)
     else:
-        with open(os.path.join(dirname, filename), 'wb') as f:
-            present = [v for v in vars if scope.get(v.name) is not None]
-            f.write(struct.pack('<I', len(present)))
-            for v in present:
-                name = v.name.encode()
-                f.write(struct.pack('<I', len(name)))
-                f.write(name)
-                _serialize_tensor(f, scope.get(v.name))
+        with open(os.path.join(dirname, filename), 'rb') as f:
+            buf.write(f.read())
+    return buf.getvalue()
+
+
+def _parse_var_blob(blob):
+    f = _io.BytesIO(blob)
+    (n,) = struct.unpack('<I', f.read(4))
+    loaded = {}
+    for _ in range(n):
+        (ln,) = struct.unpack('<I', f.read(4))
+        name = f.read(ln).decode()
+        loaded[name] = _deserialize_tensor(f)
+    return loaded
 
 
 def load_vars(executor, dirname, main_program=None, vars=None,
               predicate=None, filename=None):
+    """Load vars from dirname. Multi-host: process 0 reads and BROADCASTS
+    the bytes (dist_save_load.py equivalence without requiring a shared
+    filesystem); every process then deserializes identically."""
     vars = _resolve_vars(main_program, vars, predicate or (lambda v: True))
     scope = global_scope()
-    if filename is None:
+    pid, pcount = _proc_info()
+    if pcount > 1:
+        blob, err = b'', None
+        if pid == 0:
+            try:
+                blob = _read_var_blob(dirname, [v.name for v in vars],
+                                      filename)
+            except Exception as e:
+                err = e
+        loaded = _parse_var_blob(_broadcast_bytes(blob, pid, error=err))
+        missing = [v.name for v in vars if v.name not in loaded]
+        if filename is None and missing:
+            raise RuntimeError("missing checkpoint vars: %r" % missing)
+    elif filename is None:
+        loaded = {}
         for v in vars:
             path = os.path.join(dirname, v.name)
             if not os.path.exists(path):
                 raise RuntimeError("missing checkpoint file for var %r at %s"
                                    % (v.name, path))
             with open(path, 'rb') as f:
-                scope.set(v.name, _deserialize_tensor(f))
+                loaded[v.name] = _deserialize_tensor(f)
     else:
         with open(os.path.join(dirname, filename), 'rb') as f:
-            (n,) = struct.unpack('<I', f.read(4))
-            loaded = {}
-            for _ in range(n):
-                (ln,) = struct.unpack('<I', f.read(4))
-                name = f.read(ln).decode()
-                loaded[name] = _deserialize_tensor(f)
-        for v in vars:
-            if v.name in loaded:
-                scope.set(v.name, loaded[v.name])
+            loaded = _parse_var_blob(f.read())
+    for v in vars:
+        if v.name in loaded:
+            scope.set(v.name, loaded[v.name])
 
 
 def save_params(executor, dirname, main_program=None, filename=None):
-    save_vars(executor, dirname, main_program, None, is_parameter, filename)
+    return save_vars(executor, dirname, main_program, None, is_parameter,
+                     filename)
 
 
 def load_params(executor, dirname, main_program=None, filename=None):
@@ -217,7 +378,8 @@ def load_params(executor, dirname, main_program=None, filename=None):
 
 
 def save_persistables(executor, dirname, main_program=None, filename=None):
-    save_vars(executor, dirname, main_program, None, is_persistable, filename)
+    return save_vars(executor, dirname, main_program, None, is_persistable,
+                     filename)
 
 
 def load_persistables(executor, dirname, main_program=None, filename=None):
@@ -254,13 +416,15 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     pruned = prune_program(main_program, feeded_var_names, fetch_names)
     pruned._feed_names = list(feeded_var_names)
     pruned._fetch_names = fetch_names
-    os.makedirs(dirname, exist_ok=True)
-    model_path = os.path.join(dirname, model_filename or '__model__')
     d = program_to_dict(pruned)
     d['feed_names'] = list(feeded_var_names)
     d['fetch_names'] = fetch_names
-    with open(model_path, 'wb') as f:
-        f.write(json.dumps(d).encode())
+    pid, _pcount = _proc_info()
+    if pid == 0:  # process-0 guard; save_persistables barriers below
+        os.makedirs(dirname, exist_ok=True)
+        model_path = os.path.join(dirname, model_filename or '__model__')
+        with _atomic_file(model_path) as f:
+            f.write(json.dumps(d).encode())
     save_persistables(executor, dirname, pruned, params_filename)
     return fetch_names
 

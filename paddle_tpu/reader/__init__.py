@@ -2,3 +2,4 @@ from .decorator import (map_readers, buffered, compose, chain, shuffle,  # noqa
                         firstn, xmap_readers, multiprocess_reader, cache,
                         batch, bucket_by_length, Fake, ComposeNotAligned)
 from .pipeline import PyReader  # noqa: F401
+from .elastic import TaskService, elastic_sample_stream  # noqa: F401

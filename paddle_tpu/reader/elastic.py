@@ -1,0 +1,239 @@
+"""Elastic data plane: leased task dispatch + on-disk journal for
+mid-epoch resume.
+
+Port of the Go master's design (ref: go/master/service.go:89 partition
+into todo/pending/done/failed queues with lease timeouts, :140
+re-queue on timeout with a failure cap; go/pserver/service.go:346
+CRC + atomic-rename checkpoints — the CRC/rename half lives in io.py).
+
+TPU-native shape: there is no separate master process — the SPMD runtime
+owns topology — so the task service is a library object journaling to the
+shared filesystem next to the checkpoints. A task is a unit of input work
+(a file, a RecordIO chunk). The journal is append-only JSONL:
+
+    {"event": "epoch", "epoch": N}          epoch barrier (resets tasks)
+    {"event": "done", "task": "<id>"}       task fully consumed
+    {"event": "progress", "task": "<id>", "count": K}   K samples consumed
+
+Recovery replays the journal: done tasks never re-dispatch; a task with
+progress K re-dispatches with skip=K, so a killed feeder resumes mid-task
+without sample loss or duplication (the Go master resumes at chunk
+granularity; journaled progress is strictly finer). Exactly-once holds
+when progress writes are flushed per consumed sample (the default here);
+an unflushed tail sample degrades to at-least-once, same as the
+reference's chunk re-dispatch.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+
+class TaskService(object):
+    """todo/pending/done task dispatch with leases, timeout re-queue, a
+    failure cap, and an optional journal for crash recovery."""
+
+    def __init__(self, tasks, journal_path=None, lease_timeout_s=60.0,
+                 max_failures=3):
+        self._all = {str(t): t for t in tasks}
+        if len(self._all) != len(tasks):
+            raise ValueError("task ids (str(task)) must be unique")
+        self._lock = threading.Lock()
+        self._todo = list(self._all)          # FIFO of task ids
+        self._pending = {}                    # id -> lease deadline
+        self._done = set()
+        self._dropped = set()                 # failure cap exceeded
+        self._failures = {}                   # id -> count
+        self._progress = {}                   # id -> samples consumed
+        self._meta = {}                       # journaled config facts
+        self._epoch = 0
+        self._lease_timeout = float(lease_timeout_s)
+        self._max_failures = int(max_failures)
+        self._journal_path = journal_path
+        self._journal_f = None
+        if journal_path:
+            self._recover(journal_path)
+            self._journal_f = open(journal_path, 'a')
+
+    # -- journal -----------------------------------------------------------
+    def _recover(self, path):
+        if not os.path.exists(path):
+            return
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail write from a crash
+                ev = rec.get('event')
+                if ev == 'epoch':
+                    # epoch barrier: everything before it is history
+                    self._done.clear()
+                    self._progress.clear()
+                    self._epoch = rec.get('epoch', self._epoch)
+                elif ev == 'done':
+                    self._done.add(rec['task'])
+                    self._progress.pop(rec['task'], None)
+                elif ev == 'progress':
+                    self._progress[rec['task']] = rec['count']
+                elif ev == 'meta':
+                    self._meta[rec['key']] = rec['value']
+        self._todo = [t for t in self._all if t not in self._done]
+
+    def _journal(self, rec):
+        if self._journal_f is not None:
+            self._journal_f.write(json.dumps(rec) + '\n')
+            self._journal_f.flush()
+
+    # -- dispatch (ref service.go:89 taskQueues, :140 CheckTimeoutFunc) ----
+    def _requeue_expired(self, now):
+        expired = [t for t, dl in self._pending.items() if dl <= now]
+        for t in expired:
+            del self._pending[t]
+            self._fail_locked(t, 'lease timeout')
+
+    def _fail_locked(self, task_id, why):
+        n = self._failures.get(task_id, 0) + 1
+        self._failures[task_id] = n
+        if n >= self._max_failures:
+            self._dropped.add(task_id)  # cap hit: stop poisoning the queue
+        else:
+            self._todo.append(task_id)
+
+    def get_task(self):
+        """Lease the next task. Returns (task_id, task, skip) or None when
+        nothing is currently dispatchable (all done/leased/dropped).
+        `skip` is the journaled progress — samples already consumed."""
+        now = time.monotonic()
+        with self._lock:
+            self._requeue_expired(now)
+            if not self._todo:
+                return None
+            task_id = self._todo.pop(0)
+            self._pending[task_id] = now + self._lease_timeout
+            return task_id, self._all[task_id], self._progress.get(task_id, 0)
+
+    def report_progress(self, task_id, count):
+        """Journal that `count` samples of task are consumed (monotonic).
+        Doubles as the lease heartbeat: a long task that keeps reporting
+        progress is alive and must not be re-queued under another worker."""
+        with self._lock:
+            self._progress[task_id] = count
+            if task_id in self._pending:
+                self._pending[task_id] = time.monotonic() \
+                    + self._lease_timeout
+            self._journal({'event': 'progress', 'task': task_id,
+                           'count': count})
+
+    def renew_lease(self, task_id):
+        """Heartbeat without journaling progress: a producer that is still
+        enqueuing a task's work (but whose consumer hasn't trained on it
+        yet) must keep the lease from expiring into a duplicate dispatch."""
+        with self._lock:
+            if task_id in self._pending:
+                self._pending[task_id] = time.monotonic() \
+                    + self._lease_timeout
+
+    def is_dropped(self, task_id):
+        with self._lock:
+            return task_id in self._dropped
+
+    def set_meta(self, key, value):
+        """Journal a configuration fact (e.g. batch size) so a resume with
+        incompatible settings can be rejected instead of mis-skipping."""
+        with self._lock:
+            self._meta[key] = value
+            self._journal({'event': 'meta', 'key': key, 'value': value})
+
+    def get_meta(self, key, default=None):
+        with self._lock:
+            return self._meta.get(key, default)
+
+    @property
+    def epoch(self):
+        with self._lock:
+            return self._epoch
+
+    def task_finished(self, task_id):
+        with self._lock:
+            self._pending.pop(task_id, None)
+            self._done.add(task_id)
+            self._progress.pop(task_id, None)
+            self._journal({'event': 'done', 'task': task_id})
+
+    def task_failed(self, task_id):
+        with self._lock:
+            self._pending.pop(task_id, None)
+            self._fail_locked(task_id, 'reported')
+
+    def new_epoch(self):
+        """Barrier: all tasks re-dispatchable; journaled so recovery does
+        not resurrect the previous epoch's done-set."""
+        with self._lock:
+            if self._pending:
+                raise RuntimeError("new_epoch with %d leased tasks"
+                                   % len(self._pending))
+            self._epoch += 1
+            self._done.clear()
+            self._dropped.clear()
+            self._failures.clear()
+            self._progress.clear()
+            self._todo = list(self._all)
+            self._journal({'event': 'epoch', 'epoch': self._epoch})
+
+    @property
+    def epoch_done(self):
+        with self._lock:
+            return not self._todo and not self._pending
+
+    @property
+    def counts(self):
+        with self._lock:
+            return {'todo': len(self._todo), 'pending': len(self._pending),
+                    'done': len(self._done), 'dropped': len(self._dropped)}
+
+    def close(self):
+        if self._journal_f is not None:
+            self._journal_f.close()
+            self._journal_f = None
+
+
+def elastic_sample_stream(service, read_task, progress_every=1):
+    """Generator over samples of every task in `service`, journaling
+    consumption so a killed consumer resumes exactly where it stopped.
+
+    read_task(task) yields samples; journaled skip counts fast-forward a
+    re-leased task. With progress_every=1 (default) the stream is
+    exactly-once across kill/restart; larger values trade journal writes
+    for an at-most-(progress_every-1)-sample replay window."""
+    while True:
+        leased = service.get_task()
+        if leased is None:
+            if service.epoch_done:
+                return
+            time.sleep(0.05)  # someone else holds leases; wait for requeue
+            continue
+        task_id, task, skip = leased
+        try:
+            n = 0
+            for sample in read_task(task):
+                n += 1
+                if n <= skip:
+                    continue
+                # journal BEFORE the hand-off: a sample counts as consumed
+                # the moment the trainer receives it, so a consumer killed
+                # between samples never sees a replay
+                if (n - skip) % progress_every == 0:
+                    service.report_progress(task_id, n)
+                yield sample
+            service.task_finished(task_id)
+        except GeneratorExit:
+            raise  # consumer died: lease expires / journal has progress
+        except Exception:
+            service.task_failed(task_id)
+            raise

@@ -18,4 +18,19 @@ echo "== bench smoke (tiny config) =="
 PTPU_BENCH_ONLY=resnet PTPU_BENCH_BATCH=16 PTPU_BENCH_STEPS=3 \
 PTPU_PLATFORM=cpu python bench.py
 
+echo "== tpu smoke tier (when a real chip is visible) =="
+if env -u JAX_PLATFORMS -u PTPU_PLATFORM -u XLA_FLAGS python - <<'EOF'
+import sys
+try:
+    import jax
+    sys.exit(0 if any(d.platform == 'tpu' for d in jax.devices()) else 1)
+except Exception:
+    sys.exit(1)
+EOF
+then
+  PTPU_RUN_TPU_TESTS=1 python -m pytest tests/test_tpu_smoke.py -q -m tpu
+else
+  echo "no TPU visible; skipping"
+fi
+
 echo "CI OK"

@@ -56,7 +56,29 @@ def main():
                 .astype(np.float32)}
         l, = exe.run(prog, feed=feed, fetch_list=[loss])
         losses.append(float(np.asarray(l).reshape(-1)[0]))
-    print('MHLOSSES', TRAINER_ID, ' '.join('%.6f' % v for v in losses))
+    # one preformatted write: Gloo's C++ logging shares this fd and can
+    # interleave between separate write() calls
+    print('MHLOSSES %d %s'
+          % (TRAINER_ID, ' '.join('%.6f' % v for v in losses)), flush=True)
+
+    # dist_save_load equivalence (ref: tests/unittests/dist_save_load.py):
+    # process 0 alone writes; the load broadcasts from process 0, so wipe
+    # the scope first and prove the broadcast restores identical state
+    ckpt = os.environ.get('PTPU_MH_CKPT')
+    if ckpt:
+        from paddle_tpu.core.scope import global_scope
+        written = fluid.io.save_persistables(exe, ckpt, main_p)
+        print('MHSAVED %d %d' % (TRAINER_ID, len(written)), flush=True)
+        scope = global_scope()
+        names = [p.name for p in main_p.global_block().all_parameters()]
+        before = {n: np.asarray(scope.get(n)) for n in names}
+        for n in names:  # corrupt local state; load must repair it
+            scope.set(n, np.zeros_like(before[n]))
+        fluid.io.load_persistables(exe, ckpt, main_p)
+        for n in names:
+            np.testing.assert_array_equal(np.asarray(scope.get(n)),
+                                          before[n])
+        print('MHLOADOK %d' % TRAINER_ID, flush=True)
 
 
 if __name__ == '__main__':

@@ -1,0 +1,49 @@
+"""Real-chip smoke tier (VERDICT r3 item 8): one subprocess drives every
+axon-specific behavior on the actual TPU (tests/tpu_smoke_worker.py); each
+check surfaces as its own @pytest.mark.tpu test here.
+
+Opt-in: set PTPU_RUN_TPU_TESTS=1 (scripts/ci.sh does when a TPU is
+visible). The default suite stays on the deterministic virtual-CPU mesh so
+one tunnel flake can't sink `pytest tests/ -x`.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+_CHECKS = ['conv_train_step', 'attention_train_step', 'sparse_ctr_train_step',
+           'amp_bf16_numerics', 'dlpack_roundtrip',
+           'py_func_capability_error', 'profiler_trace',
+           'checkpoint_roundtrip', 'compiled_artifact_serves_on_chip']
+
+
+@pytest.fixture(scope='module')
+def smoke_results():
+    if os.environ.get('PTPU_RUN_TPU_TESTS') != '1':
+        pytest.skip('TPU smoke tier is opt-in: set PTPU_RUN_TPU_TESTS=1')
+    worker = os.path.join(os.path.dirname(__file__), 'tpu_smoke_worker.py')
+    env = dict(os.environ)
+    for k in ('JAX_PLATFORMS', 'PTPU_PLATFORM', 'XLA_FLAGS'):
+        env.pop(k, None)
+    r = subprocess.run([sys.executable, worker], env=env,
+                       capture_output=True, text=True, timeout=900,
+                       cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))))
+    results = {}
+    for line in r.stdout.splitlines():
+        if line.startswith('CHECK '):
+            parts = line.split(None, 2)
+            results[parts[1]] = (parts[2] if len(parts) > 2 else 'FAIL')
+    if not results:
+        pytest.fail('smoke worker produced no results: %s' % r.stderr[-2000:])
+    return results
+
+
+@pytest.mark.parametrize('name', _CHECKS)
+def test_tpu(name, smoke_results):
+    out = smoke_results.get(name)
+    assert out is not None, 'check %s never ran' % name
+    assert out.startswith('OK'), out
