@@ -148,12 +148,15 @@ def bench_resnet():
     batch = int(os.environ.get('PTPU_BENCH_BATCH', '256'))
     steps = int(os.environ.get('PTPU_BENCH_STEPS', '30'))
     use_bf16 = os.environ.get('PTPU_BENCH_DTYPE', 'bf16') == 'bf16'
+    # MLPerf-style space-to-depth stem (models/resnet.py _s2d_stem);
+    # PTPU_BENCH_S2D=0 benches the classic 7x7 stem
+    s2d = os.environ.get('PTPU_BENCH_S2D', '1') != '0'
 
     main_p, startup_p = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_p, startup_p):
         images, label, loss, acc = build_train_net(
             dshape=(3, 224, 224), class_dim=1000, depth=50, imagenet=True,
-            lr=0.1)
+            lr=0.1, s2d_stem=s2d)
     if use_bf16:
         fluid.contrib.mixed_precision.enable_bf16(main_p)
 

@@ -10,9 +10,6 @@ whole block onto the MXU; bf16 AMP applies via contrib.mixed_precision.
 """
 from __future__ import annotations
 
-import os
-import warnings
-
 import paddle_tpu as fluid
 
 
@@ -39,17 +36,13 @@ def multi_head_attention(q_in, kv_in, n_head, d_model, q_len, kv_len,
     k = _split_heads(k, n_head, d_model, kv_len)
     v = _split_heads(v, n_head, d_model, kv_len)
     scale = (d_model // n_head) ** -0.5
-    use_flash = os.environ.get('PTPU_FLASH_ATTN', '0') not in ('', '0')
-    if use_flash and dropout > 0.0:
-        warnings.warn("PTPU_FLASH_ATTN is set but attention dropout > 0 "
-                      "forces the unfused path; build with dropout=0.0 to "
-                      "engage flash attention")
-    if use_flash and dropout == 0.0 and (mask is None or causal):
-        # opt-in fused path (Pallas flash attention, O(S) memory). Measured
-        # on the v5e tunnel it LOSES to XLA's fused softmax-matmul at seq
-        # 256-1024 (45k vs 120k tok/s @1024), so XLA composition is the
-        # default; flash matters for sequences whose [B,H,S,S] scores
-        # don't fit, where the O(S^2) memory wall, not speed, decides
+    if dropout == 0.0 and (mask is None or causal):
+        # fused attention op: the lowering auto-selects the tuned Pallas
+        # flash kernel where measured to win on this chip or where O(S^2)
+        # score materialization can't fit, else the XLA composition
+        # (ops/nn_ops.py _flash_policy; PERF_NOTES.md has the sweep).
+        # Attention-weight dropout has no fused kernel, so training with
+        # dropout>0 stays on the composition below.
         ctxv = fluid.layers.fused_multihead_attention(q, k, v,
                                                       causal=causal,
                                                       scale=scale)

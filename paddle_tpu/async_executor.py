@@ -193,9 +193,15 @@ class AsyncExecutor(object):
         if journal_dir is not None:
             from .reader.elastic import TaskService
             os.makedirs(journal_dir, exist_ok=True)
+            # dispatch + training share THIS process: a leased task can't
+            # outlive a live run, so lease expiry (which would re-dispatch
+            # a task whose batches merely sit behind a slow consumer and
+            # train them twice) is disabled — crash recovery comes from
+            # the journal, not from timeouts
             svc = TaskService(
                 filelist,
-                journal_path=os.path.join(journal_dir, 'data_tasks.journal'))
+                journal_path=os.path.join(journal_dir, 'data_tasks.journal'),
+                lease_timeout_s=1e12)
             # progress is journaled in BATCH units: a resume with another
             # batch size would mis-skip, so reject it up front
             prev_bs = svc.get_meta('batch_size')

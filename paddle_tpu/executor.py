@@ -168,8 +168,9 @@ class Executor(object):
                 else _nullcontext():
             # carried as RAW key data (uint32) so multi-host placement can
             # treat it like any other array; step() re-wraps it
+            impl = _config.rng_impl()
             rng = jax.random.key_data(
-                jax.random.fold_in(jax.random.key(seed), step))
+                jax.random.fold_in(jax.random.key(seed, impl=impl), step))
 
         from . import profiler as _profiler
         prof_ctx = (_profiler.record_event('executor_run#%d' % program._uid)
@@ -411,8 +412,11 @@ class Executor(object):
             ga_persist = sorted(persist_all & ga_scan_outs)
             ga_carried = [n for n in ga_carried if n not in ga_persist]
 
+        from .core import config as _config
+        rng_impl = _config.rng_impl()
+
         def step(state, feed, rng_raw):
-            rng = jax.random.wrap_key_data(rng_raw)
+            rng = jax.random.wrap_key_data(rng_raw, impl=rng_impl)
             # amp scope is a trace-time flag: the body below runs exactly
             # once per compile, so the context governs which lowering the
             # matmul/conv ops pick (core/amp.py), not per-step state

@@ -17,11 +17,10 @@ shared filesystem next to the checkpoints. A task is a unit of input work
 
 Recovery replays the journal: done tasks never re-dispatch; a task with
 progress K re-dispatches with skip=K, so a killed feeder resumes mid-task
-without sample loss or duplication (the Go master resumes at chunk
-granularity; journaled progress is strictly finer). Exactly-once holds
-when progress writes are flushed per consumed sample (the default here);
-an unflushed tail sample degrades to at-least-once, same as the
-reference's chunk re-dispatch.
+(the Go master resumes at chunk granularity; journaled progress is
+strictly finer). The margin semantics of the one in-flight sample/batch
+are a per-consumer choice — see elastic_sample_stream's delivery
+contract vs AsyncExecutor's journal-after-step.
 """
 from __future__ import annotations
 
@@ -205,12 +204,20 @@ class TaskService(object):
 
 def elastic_sample_stream(service, read_task, progress_every=1):
     """Generator over samples of every task in `service`, journaling
-    consumption so a killed consumer resumes exactly where it stopped.
+    consumption so a killed consumer resumes where it stopped.
 
     read_task(task) yields samples; journaled skip counts fast-forward a
-    re-leased task. With progress_every=1 (default) the stream is
-    exactly-once across kill/restart; larger values trade journal writes
-    for an at-most-(progress_every-1)-sample replay window."""
+    re-leased task. Delivery contract (progress_every=1): a sample is
+    journaled as consumed at the moment it is handed to the consumer, so
+    termination BETWEEN samples (generator close, crash in consumer code)
+    is exactly-once; a hard kill inside the single-sample hand-off window
+    (after the journal flush, before the consumer acts on it) loses that
+    one sample — at-most-once at the margin. AsyncExecutor makes the
+    opposite choice (journal AFTER the train step — at-least-once margin
+    of one in-flight batch) because replaying a batch is safe for SGD
+    while skipping one is not detectable. progress_every>1 widens the
+    window to progress_every-1 samples in exchange for fewer journal
+    writes."""
     while True:
         leased = service.get_task()
         if leased is None:

@@ -239,6 +239,69 @@ def compiled_artifact_serves_on_chip():
     np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)  # MXU bf16
 
 
+@check
+def flash_attention_parity():
+    """The auto-selected Pallas flash path must agree with the XLA
+    composition at a shape where the policy engages it (S=512)."""
+    import os
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as fluid
+    from paddle_tpu.ops.nn_ops import _flash_policy
+    assert _flash_policy(512, False)[0], "policy should pick flash @512"
+
+    r = np.random.RandomState(2)
+    qkv = [r.randn(2, 4, 512, 64).astype(np.float32) for _ in range(3)]
+
+    def run(force):
+        os.environ['PTPU_FLASH_ATTN'] = force
+        try:
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                qv = fluid.layers.data(name='q', shape=[4, 512, 64],
+                                       dtype='float32')
+                kv = fluid.layers.data(name='k', shape=[4, 512, 64],
+                                       dtype='float32')
+                vv = fluid.layers.data(name='v', shape=[4, 512, 64],
+                                       dtype='float32')
+                out = fluid.layers.fused_multihead_attention(
+                    qv, kv, vv, causal=False, scale=0.125)
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup)
+            o, = exe.run(main, feed=dict(zip('qkv', qkv)),
+                         fetch_list=[out])
+            return np.asarray(o)
+        finally:
+            os.environ.pop('PTPU_FLASH_ATTN', None)
+
+    flash, comp = run('1'), run('0')
+    np.testing.assert_allclose(flash, comp, rtol=3e-2, atol=3e-2)
+
+
+@check
+def pallas_bn_numerics():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_bn import fused_bn_apply
+    r = np.random.RandomState(3)
+    x = jnp.asarray(r.randn(4, 64, 16, 16), jnp.bfloat16)
+    k = jnp.asarray(r.randn(64), jnp.float32)
+    b = jnp.asarray(r.randn(64), jnp.float32)
+    y = jax.jit(lambda x, k, b: fused_bn_apply(x, k, b, 'relu'))(x, k, b)
+    ref = np.maximum(np.asarray(x, np.float32)
+                     * np.asarray(k).reshape(1, -1, 1, 1)
+                     + np.asarray(b).reshape(1, -1, 1, 1), 0.0)
+    np.testing.assert_allclose(np.asarray(y, np.float32), ref,
+                               rtol=2e-2, atol=2e-2)  # bf16 compute
+
+    def lossf(x, k, b):
+        return jnp.sum(fused_bn_apply(x, k, b, 'relu')
+                       .astype(jnp.float32) ** 2)
+    gx, gk, gb = jax.jit(jax.grad(lossf, argnums=(0, 1, 2)))(x, k, b)
+    assert np.isfinite(np.asarray(gx, np.float32)).all()
+    assert gk.shape == (64,) and gb.shape == (64,)
+
+
 def main():
     failed = 0
     for fn in CHECKS:
