@@ -415,12 +415,15 @@ class Executor(object):
         from .core import config as _config
         rng_impl = _config.rng_impl()
 
+        from .parallel.mesh import trace_mesh_scope
+
         def step(state, feed, rng_raw):
             rng = jax.random.wrap_key_data(rng_raw, impl=rng_impl)
-            # amp scope is a trace-time flag: the body below runs exactly
-            # once per compile, so the context governs which lowering the
-            # matmul/conv ops pick (core/amp.py), not per-step state
-            with amp.scope(amp_on):
+            # amp/mesh scopes are trace-time flags: the body below runs
+            # exactly once per compile, so the contexts govern which
+            # lowering the ops pick (core/amp.py bf16 routes; ring
+            # attention over the compile mesh), not per-step state
+            with amp.scope(amp_on), trace_mesh_scope(mesh):
                 if k > 1:
                     return self._ga_step(program, state, feed, rng, k,
                                          ga_ops, ga_scan, ga_outer,

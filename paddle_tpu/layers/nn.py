@@ -1469,15 +1469,20 @@ def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
     return out
 
 
-def fused_multihead_attention(q, k, v, causal=False, scale=1.0, name=None):
-    """Fused [B, H, S, D] attention: Pallas flash attention on TPU, naive
-    composition elsewhere (TPU-native extension; the reference composes
-    attention in nets.scaled_dot_product_attention)."""
+def fused_multihead_attention(q, k, v, causal=False, scale=1.0,
+                              sequence_parallel=False, name=None):
+    """Fused [B, H, S, D] attention: Pallas flash attention on TPU where
+    measured to win, naive composition elsewhere (TPU-native extension;
+    the reference composes attention in nets.scaled_dot_product_attention).
+    With sequence_parallel=True and a mesh carrying an 'sp' axis, lowers
+    to ring attention (parallel/ring_attention.py) — the sequence shards
+    across devices and k/v blocks rotate over ICI, O(S·S/P) memory."""
     helper = LayerHelper('fused_multihead_attention', name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
     helper.append_op(
         type='fused_multihead_attention',
         inputs={'Q': q, 'K': k, 'V': v}, outputs={'Out': out},
-        attrs={'causal': causal, 'scale': scale}, infer_shape=False)
+        attrs={'causal': causal, 'scale': scale,
+               'sequence_parallel': sequence_parallel}, infer_shape=False)
     out.shape = q.shape  # same [B, H, S, D] as the query
     return out

@@ -656,6 +656,14 @@ def _fused_multihead_attention(ctx, ins):
     q, k, v = ins['Q'][0], ins['K'][0], ins['V'][0]
     causal = bool(ctx.attr('causal', False))
     scale = float(ctx.attr('scale', 1.0))
+    if ctx.attr('sequence_parallel', False):
+        from ..parallel.mesh import current_trace_mesh, SEQ_AXIS
+        mesh = current_trace_mesh()
+        if mesh is not None and mesh.shape.get(SEQ_AXIS, 1) > 1:
+            from ..parallel.ring_attention import ring_attention
+            return {'Out': [ring_attention(q, k, v, mesh, causal=causal,
+                                           scale=scale)]}
+        # no sp axis in the compile mesh: single-device semantics below
     on_tpu = any(d.platform in ('tpu', 'axon') for d in jax.devices())
     want, bq, bkv = _flash_policy(q.shape[2], causal)
     force = os.environ.get('PTPU_FLASH_ATTN', '')

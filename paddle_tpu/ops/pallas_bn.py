@@ -82,4 +82,4 @@ fused_bn_apply.defvjp(_fwd, _bwd)
 
 def supported(x, layout):
     return (layout == 'NCHW' and x.ndim == 4
-            and any(d.platform == 'tpu' for d in jax.devices()))
+            and any(d.platform in ('tpu', 'axon') for d in jax.devices()))

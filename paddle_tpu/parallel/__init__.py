@@ -3,3 +3,4 @@ from .mesh import make_mesh, DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS, \
 from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy  # noqa
 from .parallel_executor import ParallelExecutor  # noqa: F401
 from .api import shard_parameter, shard_embedding  # noqa: F401
+from .ring_attention import ring_attention  # noqa: F401

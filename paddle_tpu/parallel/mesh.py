@@ -10,6 +10,7 @@ within a slice and DCN across slices; no id exchange needed.
 """
 from __future__ import annotations
 
+import contextlib
 import os
 
 import numpy as np
@@ -45,6 +46,26 @@ def make_mesh(num_devices=None, axes=None, backend=None):
         "mesh axes %r need %d devices, have %d" %
         (axes, int(np.prod(shape)), len(devs)))
     return Mesh(np.asarray(devs).reshape(shape), names)
+
+
+_trace_mesh = {'mesh': None}
+
+
+@contextlib.contextmanager
+def trace_mesh_scope(mesh):
+    """Trace-time mesh context: set by the Executor around the step trace
+    so mesh-aware lowerings (ring attention) can shard_map over the
+    compile mesh without plumbing it through the op system."""
+    prev = _trace_mesh['mesh']
+    _trace_mesh['mesh'] = mesh
+    try:
+        yield
+    finally:
+        _trace_mesh['mesh'] = prev
+
+
+def current_trace_mesh():
+    return _trace_mesh['mesh']
 
 
 def replicated(mesh):

@@ -1,0 +1,151 @@
+"""Ring attention (sequence/context parallelism) on the 8-device virtual
+CPU mesh: numeric parity against single-device attention, gradient flow,
+and the framework-level sequence_parallel lowering path.
+
+TPU-native extension beyond the reference (SURVEY §2.4 lists SP as absent
+upstream); math follows the online-softmax/flash recurrence with k/v
+blocks rotating over lax.ppermute (parallel/ring_attention.py).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu.parallel import make_mesh, ring_attention
+
+
+def _naive(q, k, v, causal, scale):
+    s = np.einsum('bhqd,bhkd->bhqk', q * scale, k)
+    if causal:
+        S = q.shape[2]
+        mask = np.tril(np.ones((S, S), bool))
+        s = np.where(mask, s, -np.inf)
+    s = s - s.max(-1, keepdims=True)
+    p = np.exp(s)
+    p = p / p.sum(-1, keepdims=True)
+    return np.einsum('bhqk,bhkd->bhqd', p, v)
+
+
+def _qkv(b=2, h=4, s=32, d=16, seed=0):
+    r = np.random.RandomState(seed)
+    return [r.randn(b, h, s, d).astype(np.float32) for _ in range(3)]
+
+
+@pytest.mark.parametrize('causal', [False, True])
+@pytest.mark.parametrize('axes', [{'sp': 8}, {'dp': 2, 'sp': 4}])
+def test_ring_matches_naive(causal, axes):
+    q, k, v = _qkv()
+    mesh = make_mesh(axes=axes)
+    out = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, mesh, causal=causal, scale=0.25))(q, k, v)
+    ref = _naive(q, k, v, causal, 0.25)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_ring_gradients_match_naive():
+    q, k, v = _qkv(s=16)
+    mesh = make_mesh(num_devices=4, axes={'sp': 4})
+
+    def ring_loss(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh, causal=True,
+                                      scale=0.25) ** 2)
+
+    def naive_loss(q, k, v):
+        s = jnp.einsum('bhqd,bhkd->bhqk', q * 0.25, k)
+        S = q.shape[2]
+        s = jnp.where(jnp.tril(jnp.ones((S, S), bool)), s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.sum(jnp.einsum('bhqk,bhkd->bhqd', p, v) ** 2)
+
+    g_ring = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.jit(jax.grad(naive_loss, argnums=(0, 1, 2)))(q, k, v)
+    # tolerance = the measured f32 noise floor: the NAIVE composition's own
+    # grads deviate ~1.4e-2 abs (grad magnitude ~4-6) from f64 truth; the
+    # ring recurrence matches f64 truth to 1e-13 when run in f64
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-2, atol=3e-2)
+
+
+def test_sequence_parallel_layer_lowering():
+    """fused_multihead_attention(sequence_parallel=True) under a mesh with
+    an sp axis matches the same program run single-device."""
+    from paddle_tpu.parallel.compiler import CompiledProgram
+
+    q_np, k_np, v_np = _qkv(b=4, h=2, s=32, d=8, seed=3)
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            qv = fluid.layers.data(name='q', shape=[2, 32, 8],
+                                   dtype='float32')
+            kv = fluid.layers.data(name='k', shape=[2, 32, 8],
+                                   dtype='float32')
+            vv = fluid.layers.data(name='v', shape=[2, 32, 8],
+                                   dtype='float32')
+            out = fluid.layers.fused_multihead_attention(
+                qv, kv, vv, causal=True, scale=0.3,
+                sequence_parallel=True)
+        return main, startup, out
+
+    feed = {'q': q_np, 'k': k_np, 'v': v_np}
+
+    main, startup, out = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    single, = exe.run(main, feed=feed, fetch_list=[out])
+
+    main2, startup2, out2 = build()
+    mesh = make_mesh(axes={'dp': 2, 'sp': 4})
+    prog = CompiledProgram(main2).with_data_parallel(mesh=mesh)
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    exe2.run(startup2)
+    sharded, = exe2.run(prog, feed=feed, fetch_list=[out2])
+    np.testing.assert_allclose(np.asarray(sharded), np.asarray(single),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_sequence_parallel_training_step():
+    """A transformer-style block with sp ring attention TRAINS over a
+    dp x sp mesh: loss finite and decreasing."""
+    from paddle_tpu.parallel.compiler import CompiledProgram
+
+    S, D, H = 32, 16, 2
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[S, D], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        q = fluid.layers.fc(x, size=D, num_flatten_dims=2, bias_attr=False)
+        k = fluid.layers.fc(x, size=D, num_flatten_dims=2, bias_attr=False)
+        v = fluid.layers.fc(x, size=D, num_flatten_dims=2, bias_attr=False)
+        def split(t):
+            t = fluid.layers.reshape(t, shape=[-1, S, H, D // H])
+            return fluid.layers.transpose(t, perm=[0, 2, 1, 3])
+        ctxv = fluid.layers.fused_multihead_attention(
+            split(q), split(k), split(v), causal=True,
+            scale=(D // H) ** -0.5, sequence_parallel=True)
+        ctxv = fluid.layers.reshape(
+            fluid.layers.transpose(ctxv, perm=[0, 2, 1, 3]),
+            shape=[-1, S, D])
+        pooled = fluid.layers.reduce_mean(ctxv, dim=1)
+        pred = fluid.layers.fc(pooled, size=1)
+        loss = fluid.layers.mean(fluid.layers.square(pred - y))
+        fluid.optimizer.Adam(1e-2).minimize(loss)
+
+    mesh = make_mesh(axes={'dp': 2, 'sp': 4})
+    prog = CompiledProgram(main).with_data_parallel(loss_name=loss.name,
+                                                    mesh=mesh)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    r = np.random.RandomState(0)
+    feed = {'x': r.randn(8, S, D).astype(np.float32),
+            'y': r.randn(8, 1).astype(np.float32)}
+    vals = []
+    for _ in range(10):
+        l, = exe.run(prog, feed=feed, fetch_list=[loss])
+        vals.append(float(np.asarray(l).reshape(-1)[0]))
+    assert np.isfinite(vals).all()
+    assert vals[-1] < vals[0], vals
