@@ -287,6 +287,39 @@ def bench_bert():
                           % base_tok_s)
 
 
+def bench_ocr():
+    """CRNN+CTC OCR training (BASELINE.md north star #4: the LoDTensor
+    var-len path end-to-end). Labels are variable-length LoD; one compiled
+    program serves every batch via traced offsets."""
+    import paddle_tpu as fluid
+    from models.crnn import build_crnn_train
+
+    batch = int(os.environ.get('PTPU_BENCH_OCR_BATCH', '64'))
+    steps = int(os.environ.get('PTPU_BENCH_OCR_STEPS', '20'))
+
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup_p):
+        images, label, avg_cost, decoded, edit = build_crnn_train(
+            num_classes=95, img_h=32, img_w=96, rnn_hidden=96)
+    fluid.contrib.mixed_precision.enable_bf16(main_p)
+
+    exe, dev = _device()
+    exe.run(startup_p)
+
+    rng = np.random.RandomState(0)
+    imgs = rng.randn(batch, 1, 32, 96).astype(np.float32)
+    lens = rng.randint(3, 12, batch)
+    toks = rng.randint(0, 95, int(lens.sum())).astype(np.int32)
+    lbl = fluid.create_lod_tensor(toks.reshape(-1, 1), [list(lens)])
+    feed = {'pixel': imgs, 'label': lbl}
+
+    dt = _timed_steps(exe, main_p, feed, avg_cost, steps, warmup=3)
+    return _line('ocr_crnn_img_s_per_chip', batch * steps / dt, 'img/s',
+                 1.0, dtype='bf16', batch=batch,
+                 baseline='self (reference commits no OCR number; north '
+                          'star is "end-to-end training runs", BASELINE.md)')
+
+
 def bench_ctr():
     import paddle_tpu as fluid
     from models.deepfm import build_deepfm_train
@@ -337,9 +370,10 @@ BENCHES = [
     ('transformer_base_tokens_s_per_chip', bench_transformer),
     ('bert_mlm_tokens_s_per_chip', bench_bert),
     ('ctr_deepfm_samples_s_per_chip', bench_ctr),
+    ('ocr_crnn_img_s_per_chip', bench_ocr),
 ]
 
-_SHORT = {'resnet': 0, 'transformer': 1, 'bert': 2, 'ctr': 3}
+_SHORT = {'resnet': 0, 'transformer': 1, 'bert': 2, 'ctr': 3, 'ocr': 4}
 
 
 def main(benches=None):

@@ -131,8 +131,10 @@ def _lstm(ctx, ins):
             o = act_gate(g_o)
         h = o * act_cell(c)
         m = m_t[:, None]
-        h = jnp.where(m, h, h_prev)
-        c = jnp.where(m, c, c_prev)
+        # carry dtype stays fixed: under bf16 AMP the recurrent matmul
+        # promotes (bf16 @ f32 -> f32) and the scan carry would drift
+        h = jnp.where(m, h, h_prev).astype(h_prev.dtype)
+        c = jnp.where(m, c, c_prev).astype(c_prev.dtype)
         return (h, c), (h, c, jnp.concatenate([cand, i, f, o], axis=1))
 
     (_, _), (hs, cs, gs) = jax.lax.scan(step, (h0, c0), (xs, ms))
@@ -187,7 +189,8 @@ def _gru(ctx, ins):
             h = u * h_prev + (1.0 - u) * c
         else:
             h = (1.0 - u) * h_prev + u * c
-        h = jnp.where(m_t[:, None], h, h_prev)
+        # carry dtype stays fixed under bf16 AMP (see lstm step above)
+        h = jnp.where(m_t[:, None], h, h_prev).astype(h_prev.dtype)
         return h, (h, jnp.concatenate([u, r, c], axis=1), r * h_prev)
 
     _, (hs, gs, rs) = jax.lax.scan(step, h0, (xs, ms))

@@ -35,9 +35,24 @@ def ring_attention(q, k, v, mesh, causal=False, scale=1.0,
     """Attention over [B, H, S, D] with S sharded on `seq_axis` of `mesh`.
     B additionally shards over `batch_axis` and H over `head_axis` when
     those axes exist in the mesh. Returns [B, H, S, D], S-sharded."""
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map                      # jax >= 0.8
+        rep_kw = {'check_vma': False}
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+        rep_kw = {'check_rep': False}
 
     nsp = int(mesh.shape[seq_axis])
+    if q.shape[2] % nsp != 0:
+        raise ValueError(
+            "ring attention: sequence length %d must divide the %r mesh "
+            "axis (size %d)" % (q.shape[2], seq_axis, nsp))
+    for dim, ax in ((0, batch_axis), (1, head_axis)):
+        n = int(mesh.shape.get(ax, 1))
+        if n > 1 and q.shape[dim] % n != 0:
+            raise ValueError(
+                "ring attention: q dim %d (size %d) must divide the %r "
+                "mesh axis (size %d)" % (dim, q.shape[dim], ax, n))
     b_ax = batch_axis if mesh.shape.get(batch_axis, 1) > 1 else None
     h_ax = head_axis if mesh.shape.get(head_axis, 1) > 1 else None
     spec = P(b_ax, h_ax, seq_axis, None)
@@ -45,7 +60,7 @@ def ring_attention(q, k, v, mesh, causal=False, scale=1.0,
 
     @functools.partial(shard_map, mesh=mesh,
                        in_specs=(spec, spec, spec), out_specs=spec,
-                       check_rep=False)
+                       **rep_kw)
     def ring(ql, kl, vl):
         rank = jax.lax.axis_index(seq_axis)
         sl = ql.shape[2]

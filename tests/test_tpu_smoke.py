@@ -18,7 +18,8 @@ _CHECKS = ['conv_train_step', 'attention_train_step', 'sparse_ctr_train_step',
            'amp_bf16_numerics', 'dlpack_roundtrip',
            'py_func_capability_error', 'profiler_trace',
            'checkpoint_roundtrip', 'compiled_artifact_serves_on_chip',
-           'flash_attention_parity', 'pallas_bn_numerics']
+           'crnn_ctc_train_step', 'flash_attention_parity',
+           'pallas_bn_numerics']
 
 
 @pytest.fixture(scope='module')

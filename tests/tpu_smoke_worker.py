@@ -240,6 +240,31 @@ def compiled_artifact_serves_on_chip():
 
 
 @check
+def crnn_ctc_train_step():
+    """OCR north star: conv->im2sequence->BiGRU->warpctc with var-len LoD
+    labels trains on the chip (the LoD path axon-side)."""
+    import paddle_tpu as fluid
+    from models.crnn import build_crnn_train
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        images, label, avg_cost, decoded, edit = build_crnn_train(
+            num_classes=10, img_h=32, img_w=64, rnn_hidden=32, lr=1e-3)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    r = np.random.RandomState(0)
+    imgs = r.randn(4, 1, 32, 64).astype(np.float32)
+    lens = r.randint(1, 5, 4)
+    toks = r.randint(0, 10, int(lens.sum())).astype(np.int32)
+    lbl = fluid.create_lod_tensor(toks.reshape(-1, 1), [list(lens)])
+    vals = []
+    for _ in range(4):
+        l, = exe.run(main, feed={'pixel': imgs, 'label': lbl},
+                     fetch_list=[avg_cost])
+        vals.append(float(np.asarray(l).reshape(-1)[0]))
+    assert np.isfinite(vals).all() and vals[-1] < vals[0], vals
+
+
+@check
 def flash_attention_parity():
     """The auto-selected Pallas flash path must agree with the XLA
     composition at a shape where the policy engages it (S=512)."""
