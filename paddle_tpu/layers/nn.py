@@ -1469,6 +1469,51 @@ def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
     return out
 
 
+def switch_moe_ffn(input, num_experts, d_ff, capacity_factor=1.25,
+                   expert_axis='ep', param_attr=None, name=None):
+    """Switch (top-1) mixture-of-experts FFN over the last dim of `input`
+    (TPU-native extension; the reference has no MoE). Expert weights are
+    sharded over the mesh `expert_axis` when one exists — GSPMD turns the
+    einsum dispatch/combine into all-to-alls over ICI. Returns
+    (out, aux_loss); add aux_loss (load-balancing, Switch eq. 4) to the
+    training objective scaled by ~1e-2."""
+    import copy
+    from ..parallel.api import shard_parameter
+    helper = LayerHelper('switch_moe_ffn', name=name)
+    d = int(input.shape[-1])
+    dtype = input.dtype
+
+    def _attr(suffix):
+        # create_parameter mutates attr.name in place, so a shared
+        # ParamAttr would alias all three weights to one parameter —
+        # copy per weight and keep user-provided names distinct
+        if param_attr is None:
+            return None
+        a = copy.deepcopy(param_attr)
+        if getattr(a, 'name', None):
+            a.name = a.name + suffix
+        return a
+
+    gate_w = helper.create_parameter(attr=_attr('_gate'),
+                                     shape=[d, num_experts], dtype=dtype)
+    w1 = helper.create_parameter(attr=_attr('_w1'),
+                                 shape=[num_experts, d, d_ff], dtype=dtype)
+    w2 = helper.create_parameter(attr=_attr('_w2'),
+                                 shape=[num_experts, d_ff, d], dtype=dtype)
+    shard_parameter(w1, (expert_axis, None, None))
+    shard_parameter(w2, (expert_axis, None, None))
+    out = helper.create_variable_for_type_inference(dtype)
+    aux = helper.create_variable_for_type_inference('float32')
+    helper.append_op(
+        type='switch_moe_ffn',
+        inputs={'X': input, 'GateW': gate_w, 'W1': w1, 'W2': w2},
+        outputs={'Out': out, 'AuxLoss': aux},
+        attrs={'capacity_factor': capacity_factor}, infer_shape=False)
+    out.shape = input.shape
+    aux.shape = (1,)
+    return out, aux
+
+
 def fused_multihead_attention(q, k, v, causal=False, scale=1.0,
                               sequence_parallel=False, name=None):
     """Fused [B, H, S, D] attention: Pallas flash attention on TPU where

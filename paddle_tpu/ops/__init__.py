@@ -16,3 +16,4 @@ from . import sequence_ops    # noqa: F401
 from . import rnn_ops         # noqa: F401
 from . import sparse_ops      # noqa: F401
 from . import detection_ops   # noqa: F401
+from . import moe_ops         # noqa: F401
