@@ -1469,6 +1469,19 @@ def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
     return out
 
 
+def _suffixed_attr(param_attr, suffix):
+    """Per-weight copy of a shared ParamAttr: create_parameter mutates
+    attr.name in place, so reusing one attr would alias every weight of a
+    multi-parameter layer to a single name."""
+    import copy
+    if param_attr is None:
+        return None
+    a = copy.deepcopy(param_attr)
+    if getattr(a, 'name', None):
+        a.name = a.name + suffix
+    return a
+
+
 def switch_moe_ffn(input, num_experts, d_ff, capacity_factor=1.25,
                    expert_axis='ep', param_attr=None, name=None):
     """Switch (top-1) mixture-of-experts FFN over the last dim of `input`
@@ -1477,28 +1490,15 @@ def switch_moe_ffn(input, num_experts, d_ff, capacity_factor=1.25,
     einsum dispatch/combine into all-to-alls over ICI. Returns
     (out, aux_loss); add aux_loss (load-balancing, Switch eq. 4) to the
     training objective scaled by ~1e-2."""
-    import copy
     from ..parallel.api import shard_parameter
     helper = LayerHelper('switch_moe_ffn', name=name)
     d = int(input.shape[-1])
     dtype = input.dtype
-
-    def _attr(suffix):
-        # create_parameter mutates attr.name in place, so a shared
-        # ParamAttr would alias all three weights to one parameter —
-        # copy per weight and keep user-provided names distinct
-        if param_attr is None:
-            return None
-        a = copy.deepcopy(param_attr)
-        if getattr(a, 'name', None):
-            a.name = a.name + suffix
-        return a
-
-    gate_w = helper.create_parameter(attr=_attr('_gate'),
+    gate_w = helper.create_parameter(attr=_suffixed_attr(param_attr, '_gate'),
                                      shape=[d, num_experts], dtype=dtype)
-    w1 = helper.create_parameter(attr=_attr('_w1'),
+    w1 = helper.create_parameter(attr=_suffixed_attr(param_attr, '_w1'),
                                  shape=[num_experts, d, d_ff], dtype=dtype)
-    w2 = helper.create_parameter(attr=_attr('_w2'),
+    w2 = helper.create_parameter(attr=_suffixed_attr(param_attr, '_w2'),
                                  shape=[num_experts, d_ff, d], dtype=dtype)
     shard_parameter(w1, (expert_axis, None, None))
     shard_parameter(w2, (expert_axis, None, None))
@@ -1512,6 +1512,41 @@ def switch_moe_ffn(input, num_experts, d_ff, capacity_factor=1.25,
     out.shape = input.shape
     aux.shape = (1,)
     return out, aux
+
+
+def pipelined_ffn_stack(input, num_layers, d_ff, num_microbatches=0,
+                        pipe_axis='pp', param_attr=None, name=None):
+    """A stack of `num_layers` residual FFN layers (x + W2·relu(W1·x))
+    with parameters stacked [L, ...] and sharded over the mesh `pipe_axis`
+    (TPU-native extension). Under a mesh whose 'pp' axis equals
+    num_layers, the stack runs as an SPMD GPipe (parallel/pipeline.py):
+    each rank owns one layer, activations ride ICI, microbatches hide the
+    bubble. Without a pp axis the same op runs the layers sequentially —
+    identical math, so programs are portable across meshes."""
+    from ..parallel.api import shard_parameter
+    helper = LayerHelper('pipelined_ffn_stack', name=name)
+    d = int(input.shape[-1])
+    dtype = input.dtype
+    w1 = helper.create_parameter(attr=_suffixed_attr(param_attr, '_w1'),
+                                 shape=[num_layers, d, d_ff], dtype=dtype)
+    b1 = helper.create_parameter(attr=_suffixed_attr(param_attr, '_b1'),
+                                 shape=[num_layers, d_ff], dtype=dtype,
+                                 is_bias=True)
+    w2 = helper.create_parameter(attr=_suffixed_attr(param_attr, '_w2'),
+                                 shape=[num_layers, d_ff, d], dtype=dtype)
+    b2 = helper.create_parameter(attr=_suffixed_attr(param_attr, '_b2'),
+                                 shape=[num_layers, d], dtype=dtype,
+                                 is_bias=True)
+    for p in (w1, b1, w2, b2):
+        shard_parameter(p, (pipe_axis,) + (None,) * (len(p.shape) - 1))
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type='pipelined_ffn_stack',
+        inputs={'X': input, 'W1': w1, 'B1': b1, 'W2': w2, 'B2': b2},
+        outputs={'Out': out},
+        attrs={'num_microbatches': num_microbatches}, infer_shape=False)
+    out.shape = input.shape
+    return out
 
 
 def fused_multihead_attention(q, k, v, causal=False, scale=1.0,

@@ -1,0 +1,67 @@
+"""Pipelined layer-stack ops (mesh 'pp' axis — parallel/pipeline.py).
+
+`pipelined_ffn_stack`: L residual FFN layers with parameters stacked on a
+leading [L, ...] axis. When the compile mesh carries a 'pp' axis of size
+L, the stack executes as an SPMD GPipe (each rank owns one layer,
+activations flow over ICI, microbatches keep every stage busy); otherwise
+the layers run sequentially via lax.scan — identical math, one device.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+from ..core import amp
+
+
+def _ffn_layer(p, x):
+    w1, b1, w2, b2 = p
+    h = jax.nn.relu(amp.matmul(x, w1) + b1)
+    return x + amp.matmul(h, w2) + b2   # residual: stable deep stacking
+
+
+@register('pipelined_ffn_stack',
+          diff_inputs=('X', 'W1', 'B1', 'W2', 'B2'))
+def _pipelined_ffn_stack(ctx, ins):
+    x_in = ins['X'][0]                       # [B, ..., D]
+    w1, b1 = ins['W1'][0], ins['B1'][0]      # [L, D, F], [L, F]
+    w2, b2 = ins['W2'][0], ins['B2'][0]      # [L, F, D], [L, D]
+    nlayers = w1.shape[0]
+    params = (w1, b1, w2, b2)
+
+    from ..parallel.mesh import current_trace_mesh, PIPE_AXIS
+    mesh = current_trace_mesh()
+    pp = int(mesh.shape.get(PIPE_AXIS, 1)) if mesh is not None else 1
+    if pp > 1 and pp == nlayers:
+        from ..parallel.pipeline import gpipe_apply
+        m = int(ctx.attr('num_microbatches', 0))
+        if m < 0:
+            raise ValueError(
+                "pipelined_ffn_stack: num_microbatches must be >= 0 "
+                "(0 = auto), got %d" % m)
+        m = m or 2 * pp
+        bsz = x_in.shape[0]
+        ndp = int(mesh.shape.get('dp', 1))
+
+        def ok(c):  # microbatches tile the batch; rows tile the dp axis
+            return bsz % c == 0 and (bsz // c) % ndp == 0
+        if not ok(m):
+            fit = next((c for c in range(min(m, bsz), 0, -1) if ok(c)),
+                       None)
+            if fit is None:  # batch itself not dp-divisible: replicate
+                fit = next(c for c in range(min(m, bsz), 0, -1)
+                           if bsz % c == 0)
+            import warnings
+            warnings.warn(
+                "pipelined_ffn_stack: num_microbatches=%d does not tile "
+                "batch %d (dp=%d); using %d" % (m, bsz, ndp, fit))
+            m = fit
+        xs = x_in.reshape(m, bsz // m, *x_in.shape[1:])
+        out = gpipe_apply(_ffn_layer, params, xs, mesh)
+        return {'Out': [out.reshape(x_in.shape)]}
+    # no pp axis (or mismatched stage count): sequential scan, same math
+    def body(x, p):
+        return _ffn_layer(p, x), None
+    out, _ = jax.lax.scan(body, x_in, params)
+    return {'Out': [out]}
