@@ -44,6 +44,18 @@ def test_ring_matches_naive(causal, axes):
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
 
 
+def test_long_sequence_rings_across_devices():
+    """Long-context posture: S=2048 over sp=8 — each device's score block
+    is [B,H,256,256] (O(S·S/P)) instead of a monolithic [B,H,2048,2048];
+    causal output must still match the dense computation."""
+    q, k, v = _qkv(b=1, h=2, s=2048, d=32, seed=5)
+    mesh = make_mesh(axes={'sp': 8})
+    out = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, mesh, causal=True, scale=0.1))(q, k, v)
+    ref = _naive(q, k, v, True, 0.1)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=5e-5, atol=5e-5)
+
+
 def test_ring_gradients_match_naive():
     q, k, v = _qkv(s=16)
     mesh = make_mesh(num_devices=4, axes={'sp': 4})
