@@ -83,9 +83,10 @@ class Predictor(object):
     def get_output_names(self):
         return [v.name for v in self._fetch_vars if v is not None]
 
-    def run(self, inputs):
+    def run(self, inputs, return_numpy=True):
         """inputs: list (feed order) or dict name -> array/LoDTensor.
-        Returns list of numpy outputs."""
+        Returns list of numpy outputs; return_numpy=False skips the host
+        sync and returns device arrays (async serving loops sync once)."""
         from ..core.scope import scope_guard
         if isinstance(inputs, (list, tuple)):
             if len(inputs) != len(self._feed_names):
@@ -98,7 +99,10 @@ class Predictor(object):
         with scope_guard(self._scope):
             outs = self._exe.run(self._program, feed=feed,
                                  fetch_list=[v.name for v in
-                                             self._fetch_vars])
+                                             self._fetch_vars],
+                                 return_numpy=return_numpy)
+        if not return_numpy:
+            return list(outs)
         return [np.asarray(o) for o in outs]
 
     def warmup(self, sample_inputs):

@@ -1,0 +1,26 @@
+"""VGG model family builds and trains (benchmark parity with the
+reference's benchmark/fluid/models/vgg.py; the committed Xeon number it
+benches against lives in BASELINE.md)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from models.vgg import build_train_net
+
+
+def test_vgg16_trains_one_batch():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        images, label, loss, acc = build_train_net(
+            dshape=(3, 32, 32), class_dim=10, depth=16, lr=0.01)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    r = np.random.RandomState(0)
+    feed = {'data': r.randn(2, 3, 32, 32).astype(np.float32),
+            'label': r.randint(0, 10, (2, 1)).astype(np.int64)}
+    vals = []
+    for _ in range(3):
+        l, = exe.run(main, feed=feed, fetch_list=[loss])
+        vals.append(float(np.asarray(l).reshape(-1)[0]))
+    assert np.isfinite(vals).all(), vals
+    assert vals[-1] < vals[0], vals
