@@ -141,48 +141,57 @@ def _device():
     return exe, dev
 
 
-def bench_resnet():
+def _bench_image_train(metric, build, batch, steps, flops_per_img,
+                       baseline_img_s, baseline, use_bf16=True, warmup=4,
+                       class_dim=1000):
+    """Shared image-classifier train bench: synthetic data staged on device
+    ONCE (the reference benchmark's synthetic mode, benchmark/fluid/args.py
+    --use_reader_op=false path) so steady-state throughput measures the
+    train step, not the PCIe/tunnel transfer."""
     import paddle_tpu as fluid
-    from models.resnet import build_train_net
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup_p):
+        images, label, loss, acc = build()
+    if use_bf16:
+        fluid.contrib.mixed_precision.enable_bf16(main_p)
 
+    exe, dev = _device()
+    exe.run(startup_p)
+    import jax
+    import jax.numpy as jnp
+    xs = jax.device_put(
+        jnp.asarray(np.random.randn(batch, 3, 224, 224), jnp.float32), dev)
+    lab = jax.device_put(
+        jnp.asarray(np.random.randint(0, class_dim, (batch, 1)), jnp.int32),
+        dev)
+    feed = {'data': xs, 'label': lab}
+
+    dt = _timed_steps(exe, main_p, feed, loss, steps, warmup=warmup)
+    img_s = batch * steps / dt
+    peak = _peak_flops()
+    mfu = (img_s * flops_per_img / peak) if peak else None
+    return _line(metric, img_s, 'img/s', img_s / baseline_img_s,
+                 mfu=round(mfu, 4) if mfu is not None else None,
+                 dtype='bf16' if use_bf16 else 'fp32', batch=batch,
+                 baseline=baseline)
+
+
+def bench_resnet():
+    from models.resnet import build_train_net
     batch = int(os.environ.get('PTPU_BENCH_BATCH', '256'))
     steps = int(os.environ.get('PTPU_BENCH_STEPS', '30'))
     use_bf16 = os.environ.get('PTPU_BENCH_DTYPE', 'bf16') == 'bf16'
     # MLPerf-style space-to-depth stem (models/resnet.py _s2d_stem);
     # PTPU_BENCH_S2D=0 benches the classic 7x7 stem
     s2d = os.environ.get('PTPU_BENCH_S2D', '1') != '0'
-
-    main_p, startup_p = fluid.Program(), fluid.Program()
-    with fluid.program_guard(main_p, startup_p):
-        images, label, loss, acc = build_train_net(
-            dshape=(3, 224, 224), class_dim=1000, depth=50, imagenet=True,
-            lr=0.1, s2d_stem=s2d)
-    if use_bf16:
-        fluid.contrib.mixed_precision.enable_bf16(main_p)
-
-    exe, dev = _device()
-    exe.run(startup_p)
-
-    # synthetic data staged on device ONCE (reference benchmark's synthetic
-    # mode, benchmark/fluid/args.py --use_reader_op=false path): steady-state
-    # throughput measures the train step, not the PCIe/tunnel transfer
-    import jax
-    import jax.numpy as jnp
-    xs = jax.device_put(
-        jnp.asarray(np.random.randn(batch, 3, 224, 224), jnp.float32), dev)
-    lab = jax.device_put(
-        jnp.asarray(np.random.randint(0, 1000, (batch, 1)), jnp.int32), dev)
-    feed = {'data': xs, 'label': lab}
-
-    dt = _timed_steps(exe, main_p, feed, loss, steps)
-    img_s = batch * steps / dt
-    peak = _peak_flops()
-    mfu = (img_s * RESNET50_TRAIN_FLOPS_PER_IMG / peak) if peak else None
-    return _line('resnet50_train_img_s_per_chip', img_s, 'img/s',
-                 img_s / BASELINE_RESNET_IMG_S,
-                 mfu=round(mfu, 4) if mfu is not None else None,
-                 dtype='bf16' if use_bf16 else 'fp32', batch=batch,
-                 baseline='84.08 img/s Xeon 6148 (IntelOptimizedPaddle.md:45)')
+    return _bench_image_train(
+        'resnet50_train_img_s_per_chip',
+        lambda: build_train_net(dshape=(3, 224, 224), class_dim=1000,
+                                depth=50, imagenet=True, lr=0.1,
+                                s2d_stem=s2d),
+        batch, steps, RESNET50_TRAIN_FLOPS_PER_IMG, BASELINE_RESNET_IMG_S,
+        '84.08 img/s Xeon 6148 (IntelOptimizedPaddle.md:45)',
+        use_bf16=use_bf16)
 
 
 def bench_transformer():
@@ -289,39 +298,31 @@ def bench_bert():
 
 def bench_vgg():
     """VGG-19 train vs the committed reference number: 30.44 img/s on 2S
-    Xeon 6148 + MKL-DNN, bs=256 (benchmark/IntelOptimizedPaddle.md:35)."""
-    import paddle_tpu as fluid
+    Xeon 6148 + MKL-DNN, bs=256 (benchmark/IntelOptimizedPaddle.md:35).
+    VGG-19 fwd MACs @224 ~= 19.6e9 (standard count), train = 3x fwd."""
     from models.vgg import build_train_net
+    return _bench_image_train(
+        'vgg19_train_img_s_per_chip',
+        lambda: build_train_net(depth=19),
+        int(os.environ.get('PTPU_BENCH_VGG_BATCH', '128')),
+        int(os.environ.get('PTPU_BENCH_VGG_STEPS', '20')),
+        3 * 2 * 19.6e9, 30.44,
+        '30.44 img/s Xeon 6148 (IntelOptimizedPaddle.md:35)', warmup=3)
 
-    batch = int(os.environ.get('PTPU_BENCH_VGG_BATCH', '128'))
-    steps = int(os.environ.get('PTPU_BENCH_VGG_STEPS', '20'))
 
-    main_p, startup_p = fluid.Program(), fluid.Program()
-    with fluid.program_guard(main_p, startup_p):
-        images, label, loss, acc = build_train_net(depth=19)
-    fluid.contrib.mixed_precision.enable_bf16(main_p)
-
-    exe, dev = _device()
-    exe.run(startup_p)
-
-    import jax
-    import jax.numpy as jnp
-    xs = jax.device_put(
-        jnp.asarray(np.random.randn(batch, 3, 224, 224), jnp.float32), dev)
-    lab = jax.device_put(
-        jnp.asarray(np.random.randint(0, 1000, (batch, 1)), jnp.int32), dev)
-    feed = {'data': xs, 'label': lab}
-    dt = _timed_steps(exe, main_p, feed, loss, steps, warmup=3)
-    img_s = batch * steps / dt
-    # VGG-19 train fwd MACs @224 ~= 19.6e9 (standard count), train = 3x fwd
-    flops_per_img = 3 * 2 * 19.6e9
-    peak = _peak_flops()
-    mfu = (img_s * flops_per_img / peak) if peak else None
-    return _line('vgg19_train_img_s_per_chip', img_s, 'img/s',
-                 img_s / 30.44,
-                 mfu=round(mfu, 4) if mfu is not None else None,
-                 dtype='bf16', batch=batch,
-                 baseline='30.44 img/s Xeon 6148 (IntelOptimizedPaddle.md:35)')
+def bench_alexnet():
+    """AlexNet train vs the committed reference numbers: 626.53 img/s on
+    2S Xeon 6148 (IntelOptimizedPaddle.md:65); the K40m number is
+    602 ms/batch at bs=256 ~= 425 img/s (benchmark/README.md:37).
+    AlexNet fwd ~0.77 GMACs incl. the 58.6M-param fc head, train = 3x."""
+    from models.alexnet import build_train_net
+    return _bench_image_train(
+        'alexnet_train_img_s_per_chip', build_train_net,
+        int(os.environ.get('PTPU_BENCH_ALEX_BATCH', '256')),
+        int(os.environ.get('PTPU_BENCH_ALEX_STEPS', '30')),
+        3 * 2 * 0.77e9, 626.53,
+        '626.53 img/s Xeon 6148 (IntelOptimizedPaddle.md:65); '
+        '~425 img/s K40m (README.md:37)', warmup=3)
 
 
 def bench_resnet_infer():
@@ -460,11 +461,12 @@ BENCHES = [
     ('ctr_deepfm_samples_s_per_chip', bench_ctr),
     ('ocr_crnn_img_s_per_chip', bench_ocr),
     ('vgg19_train_img_s_per_chip', bench_vgg),
+    ('alexnet_train_img_s_per_chip', bench_alexnet),
     ('resnet50_infer_img_s_per_chip', bench_resnet_infer),
 ]
 
 _SHORT = {'resnet': 0, 'transformer': 1, 'bert': 2, 'ctr': 3, 'ocr': 4,
-          'vgg': 5, 'infer': 6}
+          'vgg': 5, 'alexnet': 6, 'infer': 7}
 
 
 def main(benches=None):

@@ -99,7 +99,8 @@ class Predictor(object):
         with scope_guard(self._scope):
             outs = self._exe.run(self._program, feed=feed,
                                  fetch_list=[v.name for v in
-                                             self._fetch_vars],
+                                             self._fetch_vars
+                                             if v is not None],
                                  return_numpy=return_numpy)
         if not return_numpy:
             return list(outs)

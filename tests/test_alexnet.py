@@ -1,0 +1,25 @@
+"""AlexNet builds and trains (benchmark parity: the reference's committed
+AlexNet numbers live in BASELINE.md)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from models.alexnet import build_train_net
+
+
+def test_alexnet_trains_one_batch():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 9
+    with fluid.program_guard(main, startup):
+        # small lr: the 4096-wide fc head overshoots at tiny batch sizes
+        images, label, loss, acc = build_train_net(class_dim=10, lr=1e-3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    r = np.random.RandomState(0)
+    feed = {'data': r.randn(2, 3, 224, 224).astype(np.float32),
+            'label': r.randint(0, 10, (2, 1)).astype(np.int64)}
+    vals = []
+    for _ in range(4):
+        l, = exe.run(main, feed=feed, fetch_list=[loss])
+        vals.append(float(np.asarray(l).reshape(-1)[0]))
+    assert np.isfinite(vals).all(), vals
+    assert vals[-1] < vals[0], vals
