@@ -40,6 +40,7 @@ def _pipelined_ffn_stack(ctx, ins):
             raise ValueError(
                 "pipelined_ffn_stack: num_microbatches must be >= 0 "
                 "(0 = auto), got %d" % m)
+        explicit = m > 0
         m = m or 2 * pp
         bsz = x_in.shape[0]
         ndp = int(mesh.shape.get('dp', 1))
@@ -49,13 +50,21 @@ def _pipelined_ffn_stack(ctx, ins):
         if not ok(m):
             fit = next((c for c in range(min(m, bsz), 0, -1) if ok(c)),
                        None)
-            if fit is None:  # batch itself not dp-divisible: replicate
+            degraded = fit is None
+            if degraded:  # batch itself not dp-divisible: replicate
                 fit = next(c for c in range(min(m, bsz), 0, -1)
                            if bsz % c == 0)
-            import warnings
-            warnings.warn(
-                "pipelined_ffn_stack: num_microbatches=%d does not tile "
-                "batch %d (dp=%d); using %d" % (m, bsz, ndp, fit))
+            # warn about a value the user actually chose, and always about
+            # the degraded replicate path (a real misconfiguration signal)
+            if explicit or degraded:
+                import warnings
+                warnings.warn(
+                    "pipelined_ffn_stack: num_microbatches=%d does not "
+                    "tile batch %d (dp=%d); using %d%s"
+                    % (m, bsz, ndp, fit,
+                       " (batch not dp-divisible: microbatch rows "
+                       "replicate instead of sharding over dp)"
+                       if degraded else ""))
             m = fit
         xs = x_in.reshape(m, bsz // m, *x_in.shape[1:])
         out = gpipe_apply(_ffn_layer, params, xs, mesh)
