@@ -1,0 +1,239 @@
+"""layers.lstm (the reference's cudnn stacked-LSTM path) numeric + grad
+tests (ref: operators/cudnn_lstm_op.cc:1, tests/unittests/test_lstm_op.py
+methodology): forward vs a float64 numpy oracle, analytic-vs-numeric
+gradients via OpTest.check_grad, a composition cross-check against
+dynamic_lstm, and layer-level train/infer behavior (dropout gating,
+bidirectional shapes, training moves the loss)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.lod_tensor import create_lod_tensor
+
+from op_test import OpTest
+
+
+def _sig(z):
+    return 1.0 / (1.0 + np.exp(-z))
+
+
+def np_stacked_lstm(x, wx, wh, b, h0, c0, nlayers, ndir):
+    """float64 oracle, gate packing {i, f, c, o}; no dropout."""
+    cur = x.astype(np.float64)
+    lh, lc = [], []
+    for layer in range(nlayers):
+        outs = []
+        for d in range(ndir):
+            i = layer * ndir + d
+            xs = cur[::-1] if d == 1 else cur
+            h = h0[i].astype(np.float64)
+            c = c0[i].astype(np.float64)
+            hidden = wh[i].shape[0]
+            hs = []
+            for t in range(xs.shape[0]):
+                g = xs[t] @ wx[i].astype(np.float64) \
+                    + h @ wh[i].astype(np.float64) + b[i].astype(np.float64)
+                gi, gf = g[:, :hidden], g[:, hidden:2 * hidden]
+                gc, go = g[:, 2 * hidden:3 * hidden], g[:, 3 * hidden:]
+                c = _sig(gf) * c + _sig(gi) * np.tanh(gc)
+                h = _sig(go) * np.tanh(c)
+                hs.append(h)
+            hs = np.stack(hs)
+            if d == 1:
+                hs = hs[::-1]
+            outs.append(hs)
+            lh.append(h)
+            lc.append(c)
+        cur = np.concatenate(outs, -1) if ndir > 1 else outs[0]
+    return cur, np.stack(lh), np.stack(lc)
+
+
+def _make_case(S=4, B=3, D=5, H=6, nlayers=1, ndir=1, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(S, B, D).astype(np.float32) * 0.5
+    h0 = rng.randn(nlayers * ndir, B, H).astype(np.float32) * 0.3
+    c0 = rng.randn(nlayers * ndir, B, H).astype(np.float32) * 0.3
+    wx, wh, b = [], [], []
+    for layer in range(nlayers):
+        in_sz = D if layer == 0 else H * ndir
+        for _ in range(ndir):
+            wx.append(rng.randn(in_sz, 4 * H).astype(np.float32) * 0.2)
+            wh.append(rng.randn(H, 4 * H).astype(np.float32) * 0.2)
+            b.append(rng.randn(4 * H).astype(np.float32) * 0.1)
+    return x, h0, c0, wx, wh, b
+
+
+class _CudnnLstmTest(OpTest):
+    op_type = 'cudnn_lstm'
+
+    def __init__(self, nlayers, ndir, **kw):
+        x, h0, c0, wx, wh, b = _make_case(nlayers=nlayers, ndir=ndir, **kw)
+        out, lh, lc = np_stacked_lstm(x, wx, wh, b, h0, c0, nlayers, ndir)
+        self.inputs = {
+            'Input': x, 'InitH': h0, 'InitC': c0,
+            'WeightX': [('wx%d' % i, w) for i, w in enumerate(wx)],
+            'WeightH': [('wh%d' % i, w) for i, w in enumerate(wh)],
+            'Bias': [('b%d' % i, w) for i, w in enumerate(b)],
+        }
+        self.attrs = {'hidden_size': wh[0].shape[0], 'num_layers': nlayers,
+                      'is_bidirec': ndir == 2, 'dropout_prob': 0.0,
+                      'is_test': False}
+        self.outputs = {'Out': out.astype(np.float32),
+                        'LastH': lh.astype(np.float32),
+                        'LastC': lc.astype(np.float32)}
+
+
+def test_forward_single_layer():
+    _CudnnLstmTest(nlayers=1, ndir=1).check_output(atol=1e-5, rtol=1e-5)
+
+
+def test_forward_stacked_bidirectional():
+    _CudnnLstmTest(nlayers=3, ndir=2).check_output(atol=1e-5, rtol=1e-5)
+
+
+def test_grad_weights_and_input():
+    t = _CudnnLstmTest(nlayers=2, ndir=2, S=3, B=2, D=4, H=3)
+    t.check_grad(['Input', 'wx0', 'wh1', 'b2'], 'Out',
+                 max_relative_error=1e-2)
+
+
+def test_cross_check_vs_dynamic_lstm():
+    """Single-layer unidirectional layers.lstm must equal dynamic_lstm fed
+    the pre-projected input with gates re-packed {i,f,c,o} -> {c,i,f,o}
+    (the two ops implement the same recurrence with different packings;
+    ref lstm_op.cc vs cudnn_lstm_op.cc)."""
+    S, B, D, H = 5, 3, 4, 6
+    x, h0, c0, wx, wh, b = _make_case(S=S, B=B, D=D, H=H)
+    # my packing {i,f,c,o} -> dynamic_lstm packing {c,i,f,o}
+    perm = np.concatenate([np.arange(2 * H, 3 * H), np.arange(0, H),
+                           np.arange(H, 2 * H), np.arange(3 * H, 4 * H)])
+    proj = (x @ wx[0] + b[0])[..., perm]          # [S, B, 4H] pre-projected
+    w_dyn = wh[0][:, perm]
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        inp = fluid.layers.data('inp', shape=[4 * H], dtype='float32',
+                                lod_level=1)
+        h0v = fluid.layers.data('h0', shape=[B, H], dtype='float32',
+                                append_batch_size=False)
+        c0v = fluid.layers.data('c0', shape=[B, H], dtype='float32',
+                                append_batch_size=False)
+        hidden, _ = fluid.layers.dynamic_lstm(
+            input=inp, size=4 * H, h_0=h0v, c_0=c0v, use_peepholes=False)
+        (weight,) = [p for p in main.global_block().all_parameters()
+                     if tuple(p.shape) == (H, 4 * H)]
+    # rows: sequence b is x[:, b, :] (all length S)
+    rows = np.swapaxes(proj, 0, 1).reshape(B * S, 4 * H)
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        scope.set(weight.name, w_dyn)
+        got, = exe.run(main,
+                       feed={'inp': create_lod_tensor(rows, [[S] * B]),
+                             'h0': h0[0], 'c0': c0[0]},
+                       fetch_list=[hidden])
+    want, _, _ = np_stacked_lstm(x, wx, wh, b, h0, c0, 1, 1)
+    np.testing.assert_allclose(
+        np.asarray(got).reshape(B, S, H), np.swapaxes(want, 0, 1),
+        rtol=1e-4, atol=1e-5)
+
+
+def _build_lstm_net(S, B, D, H, nlayers, is_bidirec, dropout_prob=0.0,
+                    is_test=False):
+    ndir = 2 if is_bidirec else 1
+    x = fluid.layers.data('x', shape=[S, B, D], dtype='float32',
+                          append_batch_size=False)
+    h0 = fluid.layers.data('h0', shape=[nlayers * ndir, B, H],
+                           dtype='float32', append_batch_size=False)
+    c0 = fluid.layers.data('c0', shape=[nlayers * ndir, B, H],
+                           dtype='float32', append_batch_size=False)
+    return fluid.layers.lstm(x, h0, c0, max_len=S, hidden_size=H,
+                             num_layers=nlayers, is_bidirec=is_bidirec,
+                             dropout_prob=dropout_prob, is_test=is_test)
+
+
+def test_layer_shapes_and_oracle_parity():
+    """layers.lstm end-to-end: shapes per the reference contract and
+    numeric parity with the oracle when weights are read back out."""
+    S, B, D, H, L = 6, 2, 3, 5, 2
+    out, last_h, last_c = _build_lstm_net(S, B, D, H, L, is_bidirec=True)
+    rng = np.random.RandomState(1)
+    x = rng.randn(S, B, D).astype(np.float32)
+    h0 = np.zeros((L * 2, B, H), np.float32)
+    c0 = np.zeros((L * 2, B, H), np.float32)
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(fluid.default_startup_program())
+        params = fluid.default_main_program().global_block().all_parameters()
+        vals = {p.name: np.asarray(scope.get(p.name)) for p in params}
+        o, lh, lc = exe.run(feed={'x': x, 'h0': h0, 'c0': c0},
+                            fetch_list=[out, last_h, last_c])
+    assert np.shape(o) == (S, B, 2 * H)
+    assert np.shape(lh) == (L * 2, B, H)
+    assert np.shape(lc) == (L * 2, B, H)
+    # creation order per (layer, dir): wx, wh, bias
+    ws = [vals[p.name] for p in params if '.w_' in p.name]
+    wx, wh = ws[0::2], ws[1::2]
+    b = [vals[p.name] for p in params if '.b_' in p.name]
+    want_o, want_h, want_c = np_stacked_lstm(x, wx, wh, b, h0, c0, L, 2)
+    np.testing.assert_allclose(np.asarray(o), want_o, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(lh), want_h, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(lc), want_c, rtol=1e-4, atol=1e-5)
+
+
+def test_dropout_between_layers_only():
+    """dropout_prob fires only between stacked layers at train time: a
+    1-layer net is unaffected; a 2-layer net changes output vs is_test."""
+    S, B, D, H = 4, 2, 3, 4
+    rng = np.random.RandomState(2)
+    x = rng.randn(S, B, D).astype(np.float32)
+
+    def run(nlayers, dropout, is_test):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            out, _, _ = _build_lstm_net(S, B, D, H, nlayers, False,
+                                        dropout_prob=dropout,
+                                        is_test=is_test)
+        h0 = np.zeros((nlayers, B, H), np.float32)
+        scope = fluid.core.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            o, = exe.run(main, feed={'x': x, 'h0': h0, 'c0': h0},
+                         fetch_list=[out])
+        return np.asarray(o)
+
+    # 1 layer: no between-layer boundary, dropout is a no-op
+    np.testing.assert_allclose(run(1, 0.5, False), run(1, 0.5, True),
+                               rtol=1e-6)
+    # 2 layers: train-time dropout perturbs; is_test restores determinism
+    a, bo = run(2, 0.9, False), run(2, 0.9, True)
+    assert not np.allclose(a, bo, rtol=1e-3)
+    np.testing.assert_allclose(run(2, 0.9, True), run(2, 0.9, True),
+                               rtol=1e-6)
+
+
+def test_training_moves_loss():
+    """A stacked-LSTM classifier trains (loss decreases) through the op's
+    vjp-derived gradients — the reference's end-to-end bar."""
+    S, B, D, H = 8, 4, 6, 8
+    out, _, _ = _build_lstm_net(S, B, D, H, nlayers=2, is_bidirec=True)
+    label = fluid.layers.data('label', shape=[B, 1], dtype='int64',
+                              append_batch_size=False)
+    logits = fluid.layers.fc(fluid.layers.reduce_mean(out, dim=0), size=4)
+    loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+        logits=logits, label=label))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    rng = np.random.RandomState(3)
+    feed = {'x': rng.randn(S, B, D).astype(np.float32),
+            'h0': np.zeros((4, B, H), np.float32),
+            'c0': np.zeros((4, B, H), np.float32),
+            'label': rng.randint(0, 4, (B, 1)).astype(np.int64)}
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses = [float(np.asarray(exe.run(feed=feed, fetch_list=[loss])[0])
+                    .reshape(-1)[0]) for _ in range(12)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
