@@ -17,11 +17,13 @@ from .predictor import Config, Predictor, create_predictor
 from .ref_format import (load_reference_inference_model,
                          save_reference_inference_model,
                          load_reference_persistables)
-from .export import export_compiled
-from .serve import CompiledPredictor, load_compiled
+from .export import export_compiled, export_train_step
+from .serve import (CompiledPredictor, load_compiled,
+                    CompiledTrainer, load_trainer)
 
 __all__ = ['Config', 'Predictor', 'create_predictor',
            'load_reference_inference_model',
            'save_reference_inference_model',
            'load_reference_persistables',
-           'export_compiled', 'CompiledPredictor', 'load_compiled']
+           'export_compiled', 'CompiledPredictor', 'load_compiled',
+           'export_train_step', 'CompiledTrainer', 'load_trainer']

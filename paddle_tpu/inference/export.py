@@ -26,6 +26,9 @@ import numpy as np
 
 _SIGNATURE = 'signature.json'
 _MODULE = 'module.jaxexport'
+_TRAIN_SIGNATURE = 'train_signature.json'
+_TRAIN_MODULE = 'train_module.jaxexport'
+_TRAIN_STATE0 = 'train_state0.npz'
 
 
 def export_compiled(predictor, sample_inputs, out_dir):
@@ -91,4 +94,124 @@ def export_compiled(predictor, sample_inputs, out_dir):
            'fetches': fetch_names}
     with open(os.path.join(out_dir, _SIGNATURE), 'w') as f:
         json.dump(sig, f, indent=1)
+    return out_dir
+
+
+def export_train_step(program, sample_inputs, fetch_list, out_dir,
+                      scope=None, seed=None):
+    """Export a full TRAIN step as a tracer-free compiled artifact.
+
+    The reference can train from a saved program with no Python
+    (train/demo_trainer.cc:1, train/test_train_recognize_digits.cc:1); the
+    TPU-native counterpart is this: the train step (forward + backward +
+    optimizer update) is traced ONCE, with parameters AND optimizer state
+    as pytree inputs -> outputs — nothing baked — plus an rng input, and
+    serialized with jax.export. The loader (serve.py CompiledTrainer) runs
+    steps from numpy state in a process that imports only json/numpy/jax.
+
+    program: the built train program (optimizer already applied).
+    sample_inputs: dict name -> array fixing feed shapes/dtypes.
+    fetch_list: Variables/names to fetch each step (put the loss here).
+    scope: initialized scope (run the startup program first); its
+      persistable values become the artifact's initial state
+      (train_state0.npz) and define the state signature.
+    seed: rng seed recorded in the artifact (default program.random_seed).
+      The loader reproduces the Executor's per-step stream:
+      fold_in(key(seed, impl), step).
+
+    Artifact files: train_module.jaxexport, train_signature.json,
+    train_state0.npz. Returns out_dir.
+    """
+    import jax
+    from jax import export as jexport
+    from ..core.lowering import Tracer
+    from ..core import amp
+    from ..core import config as _config
+    from ..core.lod import LoDArray
+    from ..executor import _program_analysis
+    from ..framework import Variable
+    from .. import global_scope
+
+    if int(getattr(program, '_grad_accum_k', 1) or 1) > 1:
+        raise ValueError(
+            "export_train_step does not support gradient-merge programs; "
+            "export the k=1 form and accumulate in the serving loop")
+    scope = scope if scope is not None else global_scope()
+    sample = dict(sample_inputs)
+    feed_names = sorted(sample)
+    fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                   for f in fetch_list]
+    for name in feed_names:
+        v = program.global_block()._find_var_recursive(name)
+        if v is not None and getattr(v, 'lod_level', 0):
+            raise ValueError(
+                "export_train_step serves dense tensors only; feed %r is "
+                "a LoD tensor" % name)
+
+    persist, persist_written = _program_analysis(program)
+    state = {}
+    for name in persist:
+        val = scope.get(name)
+        if val is not None:
+            state[name] = np.asarray(
+                val.data if isinstance(val, LoDArray) else val)
+    extra = sorted(set(persist_written) - set(state))
+    if extra:
+        raise ValueError(
+            "train-step state %r is written by the program but absent "
+            "from the scope — run the startup program before export so "
+            "every optimizer slot is materialized" % (extra,))
+    state_names = sorted(state)
+
+    amp_on = bool(getattr(program, '_amp_bf16', False))
+    rng_impl = _config.rng_impl()
+    if seed is None:
+        # mirror the Executor's fallback exactly (executor.py run()):
+        # an unseeded program under the deterministic flag uses 1234567,
+        # otherwise per-process entropy — so in-process bit-match always
+        # holds; cross-process an unseeded stream is random by intent
+        seed = int(program.random_seed or 0)
+        if not seed:
+            from ..executor import _process_entropy
+            seed = (1234567 if _config.get_flag('deterministic')
+                    else _process_entropy())
+
+    def fn(state_list, feed_list, rng_raw):
+        rng = jax.random.wrap_key_data(rng_raw, impl=rng_impl)
+        with amp.scope(amp_on):
+            tracer = Tracer(program, rng)
+            tracer.env.update(dict(zip(state_names, state_list)))
+            tracer.env.update(dict(zip(feed_names, feed_list)))
+            tracer.run_block(program.global_block())
+            fetches = [tracer.env[n] for n in fetch_names]
+            new_state = [tracer.env[n] for n in state_names]
+        return fetches, new_state
+
+    state_specs = [jax.ShapeDtypeStruct(state[n].shape, state[n].dtype)
+                   for n in state_names]
+    feed_specs = [jax.ShapeDtypeStruct(np.shape(sample[n]),
+                                       np.asarray(sample[n]).dtype)
+                  for n in feed_names]
+    key_data = jax.random.key_data(jax.random.key(0, impl=rng_impl))
+    rng_spec = jax.ShapeDtypeStruct(key_data.shape, key_data.dtype)
+    exp = jexport.export(jax.jit(fn), platforms=['cpu', 'tpu'])(
+        state_specs, feed_specs, rng_spec)
+
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, _TRAIN_MODULE), 'wb') as f:
+        f.write(exp.serialize())
+    sig = {'version': 1,
+           'feeds': [{'name': n, 'shape': list(np.shape(sample[n])),
+                      'dtype': np.asarray(sample[n]).dtype.name}
+                     for n in feed_names],
+           'fetches': fetch_names,
+           'state': [{'name': n, 'shape': list(state[n].shape),
+                      'dtype': state[n].dtype.name} for n in state_names],
+           'rng': {'impl': rng_impl, 'seed': int(seed),
+                   'key_shape': list(key_data.shape),
+                   'key_dtype': key_data.dtype.name}}
+    with open(os.path.join(out_dir, _TRAIN_SIGNATURE), 'w') as f:
+        json.dump(sig, f, indent=1)
+    np.savez(os.path.join(out_dir, _TRAIN_STATE0),
+             **{n: state[n] for n in state_names})
     return out_dir

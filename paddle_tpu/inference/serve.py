@@ -20,6 +20,36 @@ import numpy as np
 
 _SIGNATURE = 'signature.json'
 _MODULE = 'module.jaxexport'
+_TRAIN_SIGNATURE = 'train_signature.json'
+_TRAIN_MODULE = 'train_module.jaxexport'
+_TRAIN_STATE0 = 'train_state0.npz'
+
+
+def _build_args(sig_feeds, feed_names, inputs):
+    """Normalize list-or-dict inputs against the artifact signature:
+    feed-order list, dtype cast, fixed-shape check. Shared by
+    CompiledPredictor.run and CompiledTrainer.step."""
+    if isinstance(inputs, (list, tuple)):
+        if len(inputs) != len(feed_names):
+            raise ValueError("artifact expects %d inputs (%s), got %d"
+                             % (len(feed_names), feed_names, len(inputs)))
+        feed = dict(zip(feed_names, inputs))
+    else:
+        feed = dict(inputs)
+    missing = [e['name'] for e in sig_feeds if e['name'] not in feed]
+    if missing:
+        raise ValueError("missing feeds: %r (artifact expects %s)"
+                         % (missing, feed_names))
+    args = []
+    for e in sig_feeds:
+        arr = np.asarray(feed[e['name']], dtype=np.dtype(e['dtype']))
+        if list(arr.shape) != e['shape']:
+            raise ValueError(
+                "feed %r: expected shape %s (artifacts are compiled for "
+                "fixed shapes), got %s"
+                % (e['name'], e['shape'], list(arr.shape)))
+        args.append(arr)
+    return args
 
 
 class CompiledPredictor(object):
@@ -48,23 +78,7 @@ class CompiledPredictor(object):
     def run(self, inputs):
         """inputs: list (feed order) or dict name -> array.
         Returns list of numpy outputs."""
-        if isinstance(inputs, (list, tuple)):
-            if len(inputs) != len(self._feed_names):
-                raise ValueError("artifact expects %d inputs (%s), got %d"
-                                 % (len(self._feed_names), self._feed_names,
-                                    len(inputs)))
-            feed = dict(zip(self._feed_names, inputs))
-        else:
-            feed = dict(inputs)
-        args = []
-        for e in self._sig['feeds']:
-            arr = np.asarray(feed[e['name']], dtype=np.dtype(e['dtype']))
-            if list(arr.shape) != e['shape']:
-                raise ValueError(
-                    "feed %r: expected shape %s (artifacts are compiled for "
-                    "fixed shapes), got %s"
-                    % (e['name'], e['shape'], list(arr.shape)))
-            args.append(arr)
+        args = _build_args(self._sig['feeds'], self._feed_names, inputs)
         if self._device is not None:
             import jax
             with jax.default_device(self._device):
@@ -78,9 +92,113 @@ def load_compiled(artifact_dir):
     return CompiledPredictor(artifact_dir)
 
 
+class CompiledTrainer(object):
+    """Tracer-free TRAINING from an export_train_step artifact — the
+    deployment-side counterpart of the reference's C++ trainer
+    (train/demo_trainer.cc:1). Parameters and optimizer state flow
+    through each call as arrays (nothing baked); this class carries them
+    between steps and reproduces the Executor's per-step rng stream
+    (fold_in(key(seed, impl), step)), so losses bit-match in-framework
+    training. Imports only json/numpy/jax."""
+
+    def __init__(self, artifact_dir, platform=None, seed=None):
+        import jax
+        from jax import export as jexport
+        with open(os.path.join(artifact_dir, _TRAIN_SIGNATURE)) as f:
+            self._sig = json.load(f)
+        with open(os.path.join(artifact_dir, _TRAIN_MODULE), 'rb') as f:
+            self._exported = jexport.deserialize(f.read())
+        self._state_names = [e['name'] for e in self._sig['state']]
+        with np.load(os.path.join(artifact_dir, _TRAIN_STATE0)) as z:
+            self._state = [z[n] for n in self._state_names]
+        self._feed_names = [e['name'] for e in self._sig['feeds']]
+        self._seed = int(self._sig['rng']['seed'] if seed is None else seed)
+        self._impl = self._sig['rng']['impl']
+        self._step_count = 0
+        platform = platform or os.environ.get('PTPU_PLATFORM')
+        self._device = jax.devices(platform)[0] if platform else None
+
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_output_names(self):
+        return list(self._sig['fetches'])
+
+    @property
+    def state(self):
+        """Current state as {name: numpy array} (a checkpoint)."""
+        return {n: np.asarray(v)
+                for n, v in zip(self._state_names, self._state)}
+
+    def _rng(self):
+        import jax
+        key = jax.random.key(self._seed, impl=self._impl)
+        return jax.random.key_data(jax.random.fold_in(key,
+                                                      self._step_count))
+
+    def step(self, inputs):
+        """Run one train step. inputs: list (feed order) or dict.
+        Advances the carried state and rng; returns numpy fetches."""
+        args = _build_args(self._sig['feeds'], self._feed_names, inputs)
+
+        def call():
+            return self._exported.call(self._state, args, self._rng())
+        if self._device is not None:
+            import jax
+            with jax.default_device(self._device):
+                fetches, new_state = call()
+        else:
+            fetches, new_state = call()
+        self._state = new_state
+        self._step_count += 1
+        return [np.asarray(f) for f in fetches]
+
+    def save_state(self, path):
+        """Checkpoint the carried state plus the step counter (so a
+        resumed trainer continues the exact rng stream); same npz tensor
+        format as the artifact's train_state0.npz."""
+        np.savez(path, __step_count__=np.int64(self._step_count),
+                 **self.state)
+
+    def load_state(self, path):
+        with np.load(path) as z:
+            missing = [n for n in self._state_names if n not in z.files]
+            if missing:
+                raise ValueError("checkpoint missing state vars: %r"
+                                 % missing)
+            self._state = [z[n] for n in self._state_names]
+            if '__step_count__' in z.files:
+                self._step_count = int(z['__step_count__'])
+
+
+def load_trainer(artifact_dir, platform=None, seed=None):
+    return CompiledTrainer(artifact_dir, platform=platform, seed=seed)
+
+
 def main(argv):
+    if len(argv) >= 2 and argv[1] == 'train':
+        # serve.py train ARTIFACT_DIR FEEDS.npz OUT.npz STEPS [CKPT.npz]
+        # runs STEPS train steps on the (fixed) feeds; OUT.npz holds each
+        # fetch stacked over steps; CKPT.npz (optional) the final state.
+        if len(argv) not in (6, 7):
+            print("usage: serve.py train ARTIFACT_DIR FEEDS.npz OUT.npz "
+                  "STEPS [CKPT.npz]", file=sys.stderr)
+            return 2
+        artifact_dir, in_path, out_path, steps = argv[2:6]
+        trainer = CompiledTrainer(artifact_dir)
+        with np.load(in_path) as data:
+            feed = {k: data[k] for k in data.files}
+        per_step = [trainer.step(feed) for _ in range(int(steps))]
+        np.savez(out_path, **{
+            n: np.stack([s[i] for s in per_step])
+            for i, n in enumerate(trainer.get_output_names())})
+        if len(argv) == 7:
+            trainer.save_state(argv[6])
+        return 0
     if len(argv) != 4:
-        print("usage: serve.py ARTIFACT_DIR IN.npz OUT.npz", file=sys.stderr)
+        print("usage: serve.py ARTIFACT_DIR IN.npz OUT.npz\n"
+              "       serve.py train ARTIFACT_DIR FEEDS.npz OUT.npz STEPS "
+              "[CKPT.npz]", file=sys.stderr)
         return 2
     artifact_dir, in_path, out_path = argv[1:]
     pred = CompiledPredictor(artifact_dir)

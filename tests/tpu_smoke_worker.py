@@ -240,6 +240,40 @@ def compiled_artifact_serves_on_chip():
 
 
 @check
+def train_artifact_steps_on_chip():
+    """Tracer-free TRAIN export runs on the chip: export_train_step ->
+    CompiledTrainer 3 steps, loss finite and decreasing-ish (bit-match is
+    asserted CPU-side in test_export_train.py; on-chip MXU bf16 numerics
+    differ by design)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.inference import export_train_step, load_trainer
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[12], dtype='float32')
+        label = fluid.layers.data('label', shape=[1], dtype='int64')
+        h = fluid.layers.dropout(fluid.layers.fc(x, 24, act='relu'),
+                                 dropout_prob=0.2)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            logits=fluid.layers.fc(h, 5), label=label))
+        fluid.optimizer.Momentum(0.05, 0.9).minimize(loss)
+    exe = fluid.Executor(fluid.TPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {'x': rng.randn(16, 12).astype(np.float32),
+            'label': rng.randint(0, 5, (16, 1)).astype(np.int64)}
+    art = tempfile.mkdtemp()
+    export_train_step(main, feed, [loss], art, scope=scope)
+    trainer = load_trainer(art)
+    losses = [float(np.asarray(trainer.step(feed)[0]).reshape(-1)[0])
+              for _ in range(3)]
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses
+
+
+@check
 def crnn_ctc_train_step():
     """OCR north star: conv->im2sequence->BiGRU->warpctc with var-len LoD
     labels trains on the chip (the LoD path axon-side)."""
