@@ -31,10 +31,46 @@ _TRAIN_MODULE = 'train_module.jaxexport'
 _TRAIN_STATE0 = 'train_state0.npz'
 
 
+def _normalize_lod_sample(name, value, lod_level):
+    """Normalize a LoD feed sample to (data ndarray, [int32 offsets per
+    level]). Accepts a LoDArray/LoDTensor or a (values, lod) pair where
+    lod is nested offsets (or a flat list for one level)."""
+    from ..core.lod import LoDArray
+    if isinstance(value, LoDArray):
+        data = np.asarray(value.data)
+        offs = [np.asarray(value.off_t(i)) for i in range(value.nlevels)]
+    elif isinstance(value, tuple) and len(value) == 2:
+        data, lod = value
+        data = np.asarray(data)
+        if isinstance(lod, np.ndarray):
+            lod = [lod] if lod.ndim == 1 else list(lod)
+        elif len(lod) and np.isscalar(lod[0]):
+            lod = [lod]
+        offs = [np.asarray(l) for l in lod]
+    else:
+        raise ValueError(
+            "feed %r has lod_level=%d: pass a LoDTensor "
+            "(fluid.create_lod_tensor) or a (values, offsets) pair"
+            % (name, lod_level))
+    if len(offs) != lod_level:
+        raise ValueError("feed %r: expected %d lod level(s), got %d"
+                         % (name, lod_level, len(offs)))
+    return data, [o.astype(np.int32).reshape(-1) for o in offs]
+
+
 def export_compiled(predictor, sample_inputs, out_dir):
     """Export `predictor`'s program as a tracer-free compiled artifact.
 
-    sample_inputs: list (feed order) or dict of arrays fixing shapes/dtypes.
+    sample_inputs: list (feed order) or dict of arrays fixing shapes and
+    dtypes. LoD feeds take a LoDTensor or (values, offsets) pair; they
+    export in TRACED-lod form (core/lod.py), so the artifact carries the
+    offsets as runtime inputs and one export serves every batch of the
+    same BUCKET shape (rows, nseq) — export one artifact per bucket, the
+    same discipline the Executor's lod-generic cache uses. LoD fetches
+    come back as (values, offsets...) with the levels recorded in
+    signature.json (the reference's PaddleTensor.lod contract,
+    inference/api/paddle_api.h:1).
+
     Returns out_dir. Load with inference/serve.py (no framework imports).
     """
     import jax
@@ -53,12 +89,28 @@ def export_compiled(predictor, sample_inputs, out_dir):
     if missing:
         raise ValueError("sample_inputs missing feeds: %r" % missing)
 
+    # flat calling convention: per feed, data then one int32 offsets array
+    # per lod level (traced mode — offsets are runtime data)
+    feed_plan = []           # (name, lod_levels)
+    flat_specs = []
+    feed_sig = []
     for name in feed_names:
         v = program.global_block().var(name)
-        if getattr(v, 'lod_level', 0):
-            raise ValueError(
-                "export_compiled serves dense tensors only; feed %r is a "
-                "LoD tensor — serve it through the Python Predictor" % name)
+        ll = int(getattr(v, 'lod_level', 0) or 0)
+        if ll:
+            data, offs = _normalize_lod_sample(name, sample[name], ll)
+            flat_specs.append(jax.ShapeDtypeStruct(data.shape, data.dtype))
+            flat_specs.extend(jax.ShapeDtypeStruct(o.shape, np.int32)
+                              for o in offs)
+            feed_sig.append({'name': name, 'shape': list(data.shape),
+                             'dtype': data.dtype.name, 'lod_levels': ll,
+                             'lod_sizes': [int(o.shape[0]) for o in offs]})
+        else:
+            arr = np.asarray(sample[name])
+            flat_specs.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
+            feed_sig.append({'name': name, 'shape': list(arr.shape),
+                             'dtype': arr.dtype.name})
+        feed_plan.append((name, ll))
 
     # parameters / BN stats become baked-in constants
     state = {}
@@ -69,29 +121,50 @@ def export_compiled(predictor, sample_inputs, out_dir):
                 state[v.name] = val.data if isinstance(val, LoDArray) else val
     rng = jax.random.key(0)  # inference programs draw no randomness
 
-    def fn(*feeds):
+    def run_env(*flat):
+        it = iter(flat)
         tracer = Tracer(program, rng)
         tracer.env.update(state)
-        tracer.env.update(dict(zip(feed_names, feeds)))
+        for name, ll in feed_plan:
+            data = next(it)
+            if ll:
+                tracer.env[name] = LoDArray.traced(
+                    data, [next(it) for _ in range(ll)])
+            else:
+                tracer.env[name] = data
         tracer.run_block(program.global_block())
         return tuple(tracer.env[n] for n in fetch_names)
 
-    specs = [jax.ShapeDtypeStruct(np.shape(sample[n]),
-                                  np.asarray(sample[n]).dtype)
-             for n in feed_names]
+    # the export trace below records which fetches are LoD and with how
+    # many levels — the output flattening must be plain arrays (the
+    # serving process has no LoDArray class to unflatten into)
+    fetch_levels = []
+
+    def fn(*flat):
+        outs = run_env(*flat)
+        del fetch_levels[:]
+        flat_out = []
+        for o in outs:
+            if isinstance(o, LoDArray):
+                fetch_levels.append(o.nlevels)
+                flat_out.append(o.data)
+                flat_out.extend(o.off_t(i) for i in range(o.nlevels))
+            else:
+                fetch_levels.append(0)
+                flat_out.append(o)
+        return tuple(flat_out)
+
     # multi-platform artifact: serves on TPU or CPU hosts. Numerics follow
     # the executing platform's matmul precision (MXU bf16-input on TPU,
     # full f32 on CPU) — the same contract the Executor has.
-    exp = jexport.export(jax.jit(fn), platforms=['cpu', 'tpu'])(*specs)
+    exp = jexport.export(jax.jit(fn), platforms=['cpu', 'tpu'])(*flat_specs)
 
     os.makedirs(out_dir, exist_ok=True)
     with open(os.path.join(out_dir, _MODULE), 'wb') as f:
         f.write(exp.serialize())
-    sig = {'version': 1,
-           'feeds': [{'name': n, 'shape': list(np.shape(sample[n])),
-                      'dtype': np.asarray(sample[n]).dtype.name}
-                     for n in feed_names],
-           'fetches': fetch_names}
+    fetch_sig = [{'name': n, 'lod_levels': ll}
+                 for n, ll in zip(fetch_names, fetch_levels)]
+    sig = {'version': 2, 'feeds': feed_sig, 'fetches': fetch_sig}
     with open(os.path.join(out_dir, _SIGNATURE), 'w') as f:
         json.dump(sig, f, indent=1)
     return out_dir
@@ -147,6 +220,13 @@ def export_train_step(program, sample_inputs, fetch_list, out_dir,
             raise ValueError(
                 "export_train_step serves dense tensors only; feed %r is "
                 "a LoD tensor" % name)
+    for name in fetch_names:
+        v = program.global_block()._find_var_recursive(name)
+        if v is not None and getattr(v, 'lod_level', 0):
+            raise ValueError(
+                "export_train_step fetches must be dense; %r carries lod "
+                "(the framework-free trainer has no LoD output "
+                "convention) — fetch the loss or a dense metric" % name)
 
     persist, persist_written = _program_analysis(program)
     state = {}

@@ -25,9 +25,30 @@ _TRAIN_MODULE = 'train_module.jaxexport'
 _TRAIN_STATE0 = 'train_state0.npz'
 
 
+def _split_lod_value(name, value, levels):
+    """A LoD feed arrives as (values, lod) — lod nested offsets, or flat
+    for one level — or any object with .data/.off_t (duck-typed LoDArray,
+    so in-framework callers can pass LoDTensors without this module
+    importing the framework)."""
+    if hasattr(value, 'off_t') and hasattr(value, 'data'):
+        return (np.asarray(value.data),
+                [np.asarray(value.off_t(i)) for i in range(levels)])
+    if isinstance(value, tuple) and len(value) == 2:
+        data, lod = value
+        if isinstance(lod, np.ndarray):
+            lod = [lod] if lod.ndim == 1 else list(lod)
+        elif len(lod) and np.isscalar(lod[0]):
+            lod = [lod]
+        return np.asarray(data), [np.asarray(l) for l in lod]
+    raise ValueError(
+        "feed %r carries %d lod level(s): pass a (values, offsets) pair"
+        % (name, levels))
+
+
 def _build_args(sig_feeds, feed_names, inputs):
     """Normalize list-or-dict inputs against the artifact signature:
-    feed-order list, dtype cast, fixed-shape check. Shared by
+    feed-order list, dtype cast, fixed-shape check; LoD feeds contribute
+    their data plus one int32 offsets array per level. Shared by
     CompiledPredictor.run and CompiledTrainer.step."""
     if isinstance(inputs, (list, tuple)):
         if len(inputs) != len(feed_names):
@@ -42,7 +63,38 @@ def _build_args(sig_feeds, feed_names, inputs):
                          % (missing, feed_names))
     args = []
     for e in sig_feeds:
-        arr = np.asarray(feed[e['name']], dtype=np.dtype(e['dtype']))
+        levels = int(e.get('lod_levels', 0))
+        value = feed[e['name']]
+        if levels:
+            data, offs = _split_lod_value(e['name'], value, levels)
+            if len(offs) != levels:
+                raise ValueError("feed %r: expected %d lod level(s), got %d"
+                                 % (e['name'], levels, len(offs)))
+            data = np.asarray(data, dtype=np.dtype(e['dtype']))
+            rows = data.shape[0]
+            bucket_rows = e['shape'][0]
+            if rows < bucket_rows \
+                    and list(data.shape[1:]) == e['shape'][1:]:
+                # pad up to the bucket capacity (the executor's
+                # bucket_rows discipline, core/lod.py create_lod_array)
+                pad = np.zeros((bucket_rows - rows,) + data.shape[1:],
+                               data.dtype)
+                data = np.concatenate([data, pad], axis=0)
+            if list(data.shape) != e['shape']:
+                raise ValueError(
+                    "feed %r: expected bucket shape %s, got %s"
+                    % (e['name'], e['shape'], list(data.shape)))
+            args.append(data)
+            for i, (o, want) in enumerate(zip(offs, e['lod_sizes'])):
+                o = np.asarray(o, np.int32).reshape(-1)
+                if o.shape[0] != want:
+                    raise ValueError(
+                        "feed %r lod level %d: artifact bucket has %d "
+                        "offsets (nseq=%d), got %d"
+                        % (e['name'], i, want, want - 1, o.shape[0]))
+                args.append(o)
+            continue
+        arr = np.asarray(value, dtype=np.dtype(e['dtype']))
         if list(arr.shape) != e['shape']:
             raise ValueError(
                 "feed %r: expected shape %s (artifacts are compiled for "
@@ -50,6 +102,31 @@ def _build_args(sig_feeds, feed_names, inputs):
                 % (e['name'], e['shape'], list(arr.shape)))
         args.append(arr)
     return args
+
+
+def _fetch_entries(sig):
+    """Fetch signature entries across artifact versions: v1 stored plain
+    names (dense-only), v2 stores {name, lod_levels}."""
+    return [{'name': f, 'lod_levels': 0} if isinstance(f, str) else f
+            for f in sig['fetches']]
+
+
+def _structure_outputs(sig, flat):
+    """Group the module's flat outputs per the fetch signature: dense
+    fetches yield an array, LoD fetches a (values, [offsets...]) pair."""
+    flat = list(flat)
+    out, i = [], 0
+    for e in _fetch_entries(sig):
+        levels = int(e.get('lod_levels', 0))
+        data = np.asarray(flat[i])
+        i += 1
+        if levels:
+            offs = [np.asarray(flat[i + k]) for k in range(levels)]
+            i += levels
+            out.append((data, offs))
+        else:
+            out.append(data)
+    return out
 
 
 class CompiledPredictor(object):
@@ -73,11 +150,12 @@ class CompiledPredictor(object):
         return list(self._feed_names)
 
     def get_output_names(self):
-        return list(self._sig['fetches'])
+        return [e['name'] for e in _fetch_entries(self._sig)]
 
     def run(self, inputs):
-        """inputs: list (feed order) or dict name -> array.
-        Returns list of numpy outputs."""
+        """inputs: list (feed order) or dict name -> array; LoD feeds as
+        (values, offsets) pairs. Returns a list with a numpy array per
+        dense fetch and a (values, [offsets...]) pair per LoD fetch."""
         args = _build_args(self._sig['feeds'], self._feed_names, inputs)
         if self._device is not None:
             import jax
@@ -85,7 +163,7 @@ class CompiledPredictor(object):
                 outs = self._exported.call(*args)
         else:
             outs = self._exported.call(*args)
-        return [np.asarray(o) for o in outs]
+        return _structure_outputs(self._sig, outs)
 
 
 def load_compiled(artifact_dir):
@@ -167,8 +245,11 @@ class CompiledTrainer(object):
                 raise ValueError("checkpoint missing state vars: %r"
                                  % missing)
             self._state = [z[n] for n in self._state_names]
-            if '__step_count__' in z.files:
-                self._step_count = int(z['__step_count__'])
+            # a checkpoint without a counter (e.g. train_state0.npz) means
+            # "restart from step 0" — keeping the old counter would
+            # silently shift the rng stream off the bit-match trajectory
+            self._step_count = (int(z['__step_count__'])
+                                if '__step_count__' in z.files else 0)
 
 
 def load_trainer(artifact_dir, platform=None, seed=None):
@@ -203,10 +284,26 @@ def main(argv):
     artifact_dir, in_path, out_path = argv[1:]
     pred = CompiledPredictor(artifact_dir)
     with np.load(in_path) as data:
-        feed = {k: data[k] for k in data.files}
+        raw = {k: data[k] for k in data.files}
+    # LoD feeds ride npz as '<name>' plus '<name>.lod<i>' offset arrays
+    feed = {}
+    for e in pred._sig['feeds']:
+        n, levels = e['name'], int(e.get('lod_levels', 0))
+        if levels:
+            feed[n] = (raw[n], [raw['%s.lod%d' % (n, i)]
+                                for i in range(levels)])
+        else:
+            feed[n] = raw[n]
     outs = pred.run(feed)
-    np.savez(out_path, **{n: o for n, o in
-                          zip(pred.get_output_names(), outs)})
+    save = {}
+    for n, o in zip(pred.get_output_names(), outs):
+        if isinstance(o, tuple):
+            save[n] = o[0]
+            for i, off in enumerate(o[1]):
+                save['%s.lod%d' % (n, i)] = off
+        else:
+            save[n] = o
+    np.savez(out_path, **save)
     return 0
 
 
