@@ -310,6 +310,34 @@ def bench_vgg():
         '30.44 img/s Xeon 6148 (IntelOptimizedPaddle.md:35)', warmup=3)
 
 
+def bench_googlenet():
+    """GoogLeNet (Inception v1) train vs the committed reference number:
+    269.50 img/s on 2S Xeon 6148 + MKL-DNN, bs=256
+    (benchmark/IntelOptimizedPaddle.md:55)."""
+    from models.googlenet import build_train_net, GOOGLENET_FWD_MACS
+    return _bench_image_train(
+        'googlenet_train_img_s_per_chip',
+        lambda: build_train_net(),
+        int(os.environ.get('PTPU_BENCH_GOOGLENET_BATCH', '256')),
+        int(os.environ.get('PTPU_BENCH_GOOGLENET_STEPS', '20')),
+        3 * 2 * GOOGLENET_FWD_MACS, 269.50,
+        '269.50 img/s Xeon 6148 (IntelOptimizedPaddle.md:55)', warmup=3)
+
+
+def bench_googlenet_infer():
+    """GoogLeNet INFERENCE vs the committed reference number: 600.94 img/s
+    on 2S Xeon 6148 + MKL-DNN, bs=16 (IntelOptimizedPaddle.md:97)."""
+    from models.googlenet import googlenet
+    return _bench_image_infer(
+        'googlenet_infer_img_s_per_chip',
+        lambda images: googlenet(images, class_dim=1000, is_train=False),
+        'GINFER', 600.94,
+        '600.94 img/s Xeon 6148 (IntelOptimizedPaddle.md:97)',
+        'remote-tunnel dispatch floor ~200ms/call dominates small-batch '
+        'serving (same caveat as resnet infer); bs256 measures 1171 img/s '
+        '= 1.95x baseline. On-pod serving has no tunnel.')
+
+
 def bench_alexnet():
     """AlexNet train vs the committed reference numbers: 626.53 img/s on
     2S Xeon 6148 (IntelOptimizedPaddle.md:65); the K40m number is
@@ -325,25 +353,25 @@ def bench_alexnet():
         '~425 img/s K40m (README.md:37)', warmup=3)
 
 
-def bench_resnet_infer():
-    """ResNet-50 INFERENCE vs the committed reference number: 217.69 img/s
-    on 2S Xeon 6148 + MKL-DNN, bs=16 (benchmark/IntelOptimizedPaddle.md:87).
-    Served through the Predictor (load -> prune -> jit), the deployment
-    path a user actually runs."""
+def _bench_image_infer(metric, build_logits, env_prefix, baseline_img_s,
+                       baseline, note):
+    """Shared image-classifier INFERENCE bench: Predictor path (load ->
+    prune -> jit), input staged on device ONCE, steps dispatched async
+    with a single final sync — the Xeon baselines serve from local RAM,
+    while a per-call sync through the axon tunnel costs ~200ms round-trip
+    and would bench the tunnel, not the model."""
     import tempfile
     import paddle_tpu as fluid
     from paddle_tpu.inference import Config, create_predictor
-    from models.resnet import resnet_imagenet
 
-    batch = int(os.environ.get('PTPU_BENCH_INFER_BATCH', '16'))
-    steps = int(os.environ.get('PTPU_BENCH_INFER_STEPS', '50'))
+    batch = int(os.environ.get('PTPU_BENCH_%s_BATCH' % env_prefix, '16'))
+    steps = int(os.environ.get('PTPU_BENCH_%s_STEPS' % env_prefix, '50'))
 
     main_p, startup_p = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_p, startup_p):
         images = fluid.layers.data(name='data', shape=[3, 224, 224],
                                    dtype='float32')
-        logits = resnet_imagenet(images, class_dim=1000, depth=50,
-                                 is_train=False)
+        logits = build_logits(images)
     exe, dev = _device()
     exe.run(startup_p)
     with tempfile.TemporaryDirectory() as d:
@@ -351,10 +379,6 @@ def bench_resnet_infer():
         pred = create_predictor(Config(d))
     import jax
     import jax.numpy as jnp
-    # input staged on device ONCE and steps dispatched async with a single
-    # final sync, like the train benches: the Xeon baseline serves from
-    # local RAM, while a per-call sync through the axon tunnel costs
-    # ~200ms round-trip and would bench the tunnel, not the model
     x = jax.device_put(
         jnp.asarray(np.random.randn(batch, 3, 224, 224), jnp.float32), dev)
     pred.warmup([x])
@@ -364,14 +388,23 @@ def bench_resnet_infer():
     _ = np.asarray(out)  # one sync
     dt = time.perf_counter() - t0
     img_s = batch * steps / dt
-    return _line('resnet50_infer_img_s_per_chip', img_s, 'img/s',
-                 img_s / 217.69, batch=batch,
-                 baseline='217.69 img/s Xeon 6148 '
-                          '(IntelOptimizedPaddle.md:87)',
-                 note='remote-tunnel dispatch floor ~200ms/call dominates '
-                      'small-batch serving (chip fwd is ~3ms at bs16); '
-                      'bs256 measures 1253 img/s = 5.8x baseline. '
-                      'On-pod serving has no tunnel.')
+    return _line(metric, img_s, 'img/s', img_s / baseline_img_s,
+                 batch=batch, baseline=baseline, note=note)
+
+
+def bench_resnet_infer():
+    """ResNet-50 INFERENCE vs the committed reference number: 217.69 img/s
+    on 2S Xeon 6148 + MKL-DNN, bs=16 (benchmark/IntelOptimizedPaddle.md:87)."""
+    from models.resnet import resnet_imagenet
+    return _bench_image_infer(
+        'resnet50_infer_img_s_per_chip',
+        lambda images: resnet_imagenet(images, class_dim=1000, depth=50,
+                                       is_train=False),
+        'INFER', 217.69,
+        '217.69 img/s Xeon 6148 (IntelOptimizedPaddle.md:87)',
+        'remote-tunnel dispatch floor ~200ms/call dominates small-batch '
+        'serving (chip fwd is ~3ms at bs16); bs256 measures 1253 img/s = '
+        '5.8x baseline. On-pod serving has no tunnel.')
 
 
 def bench_ocr():
@@ -507,10 +540,22 @@ BENCHES = [
     ('alexnet_train_img_s_per_chip', bench_alexnet),
     ('resnet50_infer_img_s_per_chip', bench_resnet_infer),
     ('stacked_lstm_text_cls_ms_batch', bench_stacked_lstm),
+    ('googlenet_train_img_s_per_chip', bench_googlenet),
+    ('googlenet_infer_img_s_per_chip', bench_googlenet_infer),
 ]
 
-_SHORT = {'resnet': 0, 'transformer': 1, 'bert': 2, 'ctr': 3, 'ocr': 4,
-          'vgg': 5, 'alexnet': 6, 'infer': 7, 'lstm': 8}
+# PTPU_BENCH_ONLY token -> metric-name prefix; indices derive from BENCHES
+# so inserting/reordering entries can't silently select the wrong bench
+_SHORT_PREFIX = {
+    'resnet': 'resnet50_train', 'transformer': 'transformer',
+    'bert': 'bert', 'ctr': 'ctr', 'ocr': 'ocr', 'vgg': 'vgg',
+    'alexnet': 'alexnet', 'infer': 'resnet50_infer',
+    'lstm': 'stacked_lstm', 'googlenet': 'googlenet_train',
+    'ginfer': 'googlenet_infer',
+}
+_SHORT = {tok: next(i for i, (n, _) in enumerate(BENCHES)
+                    if n.startswith(pref))
+          for tok, pref in _SHORT_PREFIX.items()}
 
 
 def main(benches=None):
