@@ -29,6 +29,11 @@ import os
 import threading
 import time
 
+try:
+    import fcntl
+except ImportError:          # non-POSIX: no advisory locking available
+    fcntl = None
+
 
 class TaskService(object):
     """todo/pending/done task dispatch with leases, timeout re-queue, a
@@ -55,6 +60,33 @@ class TaskService(object):
         if journal_path:
             self._recover(journal_path)
             self._journal_f = open(journal_path, 'a')
+            # single-writer guard: the Go master serialized all queue
+            # mutation through one server (service.go); as a library, two
+            # feeders pointed at one journal would interleave appends
+            # silently — refuse instead (service.go:89's invariant)
+            if fcntl is not None:
+                import errno
+                try:
+                    fcntl.flock(self._journal_f, fcntl.LOCK_EX
+                                | fcntl.LOCK_NB)
+                except OSError as e:
+                    if e.errno in (errno.EWOULDBLOCK, errno.EAGAIN,
+                                   errno.EACCES):
+                        self._journal_f.close()
+                        self._journal_f = None
+                        raise RuntimeError(
+                            "journal %r is locked by another TaskService "
+                            "— one journal admits ONE writer; give each "
+                            "feeder its own journal_path (or route all "
+                            "work through one service)" % journal_path)
+                    # filesystem without flock support (GCS-FUSE ENOTSUP,
+                    # lock-less NFS ENOLCK): journaling still works, the
+                    # guard just can't be enforced
+                    import warnings
+                    warnings.warn(
+                        "journal %r: filesystem does not support flock "
+                        "(%s); the single-writer guard is not enforced"
+                        % (journal_path, e))
 
     # -- journal -----------------------------------------------------------
     def _recover(self, path):
@@ -74,15 +106,24 @@ class TaskService(object):
                     # epoch barrier: everything before it is history
                     self._done.clear()
                     self._progress.clear()
+                    self._failures.clear()
+                    self._dropped.clear()
                     self._epoch = rec.get('epoch', self._epoch)
                 elif ev == 'done':
                     self._done.add(rec['task'])
                     self._progress.pop(rec['task'], None)
                 elif ev == 'progress':
                     self._progress[rec['task']] = rec['count']
+                elif ev == 'failed':
+                    self._failures[rec['task']] = rec.get('count', 1)
+                elif ev == 'dropped':
+                    # poison task hit the failure cap before a crash: a
+                    # restart must not re-fail it max_failures more times
+                    self._dropped.add(rec['task'])
                 elif ev == 'meta':
                     self._meta[rec['key']] = rec['value']
-        self._todo = [t for t in self._all if t not in self._done]
+        self._todo = [t for t in self._all
+                      if t not in self._done and t not in self._dropped]
 
     def _journal(self, rec):
         if self._journal_f is not None:
@@ -99,9 +140,15 @@ class TaskService(object):
     def _fail_locked(self, task_id, why):
         n = self._failures.get(task_id, 0) + 1
         self._failures[task_id] = n
+        self._journal({'event': 'failed', 'task': task_id, 'count': n,
+                       'why': why})
         if n >= self._max_failures:
             self._dropped.add(task_id)  # cap hit: stop poisoning the queue
-        else:
+            self._journal({'event': 'dropped', 'task': task_id})
+        elif task_id not in self._todo and task_id not in self._pending:
+            # no duplicate queue entries: a late task_failed() from a
+            # worker whose lease already expired (and re-dispatched) must
+            # not enqueue the task a second time
             self._todo.append(task_id)
 
     def get_task(self):
@@ -111,11 +158,15 @@ class TaskService(object):
         now = time.monotonic()
         with self._lock:
             self._requeue_expired(now)
-            if not self._todo:
-                return None
-            task_id = self._todo.pop(0)
-            self._pending[task_id] = now + self._lease_timeout
-            return task_id, self._all[task_id], self._progress.get(task_id, 0)
+            while self._todo:
+                task_id = self._todo.pop(0)
+                if task_id in self._dropped or task_id in self._pending \
+                        or task_id in self._done:
+                    continue  # stale queue entry: never lease these
+                self._pending[task_id] = now + self._lease_timeout
+                return (task_id, self._all[task_id],
+                        self._progress.get(task_id, 0))
+            return None
 
     def report_progress(self, task_id, count):
         """Journal that `count` samples of task are consumed (monotonic).

@@ -1,0 +1,71 @@
+"""Subprocess worker for the real-kill elastic recovery test (the
+reference kills trainer processes with signals in its distributed tier,
+test_dist_base.py:339; this worker is the paddle_tpu feeder that gets
+SIGKILL'd mid-epoch and later restarted on the same journal).
+
+usage: elastic_kill_worker.py MODE JOURNAL OUT_FILE SLEEP_MS
+
+MODE 'stream'    — elastic_sample_stream (journal BEFORE hand-off:
+                   exactly-once between samples, at-most-once margin of 1)
+MODE 'afterstep' — consume then report_progress (journal AFTER the step:
+                   at-least-once margin of 1, the AsyncExecutor contract)
+
+Each consumed sample id is appended (flushed) to OUT_FILE; on epoch
+completion the sentinel EPOCH_DONE is written.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.reader.elastic import TaskService, elastic_sample_stream
+
+TASKS = ['t%d' % i for i in range(4)]
+SAMPLES_PER_TASK = 25
+
+
+def read_task(task):
+    base = int(task[1:]) * 100
+    for i in range(SAMPLES_PER_TASK):
+        yield base + i
+
+
+def main():
+    mode, journal, out_path, sleep_ms = sys.argv[1:5]
+    delay = float(sleep_ms) / 1000.0
+    svc = TaskService(TASKS, journal_path=journal, lease_timeout_s=30.0)
+    out = open(out_path, 'a')
+    if mode == 'stream':
+        for s in elastic_sample_stream(svc, read_task):
+            out.write('%d\n' % s)
+            out.flush()
+            if delay:
+                time.sleep(delay)
+    elif mode == 'afterstep':
+        while not svc.epoch_done:
+            leased = svc.get_task()
+            if leased is None:
+                time.sleep(0.02)
+                continue
+            task_id, task, skip = leased
+            n = 0
+            for s in read_task(task):
+                n += 1
+                if n <= skip:
+                    continue
+                out.write('%d\n' % s)   # "train" on the batch...
+                out.flush()
+                if delay:
+                    time.sleep(delay)
+                svc.report_progress(task_id, n)  # ...then journal
+            svc.task_finished(task_id)
+    else:
+        raise SystemExit('unknown mode %r' % mode)
+    out.write('EPOCH_DONE\n')
+    out.flush()
+    svc.close()
+
+
+if __name__ == '__main__':
+    main()
