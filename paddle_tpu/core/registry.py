@@ -111,8 +111,12 @@ def _unprobe_dim(d, had_probe):
     if not had_probe:
         return int(d)
     if d % _PROBE == 0 and d != 0:
-        q = d // _PROBE
-        return -1 if q == 1 else d  # k*probe with k>1: ambiguous, keep static? mark -1
+        # any multiple of the probe derives from the dynamic dim (probe*k
+        # from tiling/expanding it k times) — a coincidental static
+        # multiple of the large prime probe is vanishingly unlikely, and
+        # keeping it static poisons downstream inference (a 49156-row
+        # "static" expand output broke reshape/concat/fc chains)
+        return -1
     return int(d)
 
 

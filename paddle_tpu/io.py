@@ -263,6 +263,22 @@ def save_vars(executor, dirname, main_program=None, vars=None,
     present = [(v, scope.get(v.name)) for v in vars]
     present = [(v, val) for v, val in present if val is not None]
     if pcount > 1:  # collective gather: same order on every process
+        # the per-var gathers below are collectives issued in list order:
+        # if scope contents ever diverge across hosts, the orders differ
+        # and the job DEADLOCKS instead of erroring — verify the name
+        # lists agree first (one fixed-size allgather, always safe)
+        import hashlib
+        from jax.experimental import multihost_utils
+        digest = hashlib.sha256(
+            '\0'.join(v.name for v, _ in present).encode()).digest()
+        all_d = multihost_utils.process_allgather(
+            np.frombuffer(digest, np.uint8))
+        if not (all_d == all_d[0]).all():
+            raise RuntimeError(
+                "save_vars: per-process variable sets diverge across "
+                "hosts (scope contents differ) — the per-var gather "
+                "collectives would deadlock, not error. This process's "
+                "vars: %r" % [v.name for v, _ in present])
         present = [(v, _full_value(val)) for v, val in present]
     written = []
     save_err = None

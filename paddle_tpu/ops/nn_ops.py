@@ -198,6 +198,12 @@ def _max_pool2d_with_index(ctx, ins):
         arg = jnp.argmax(flat, axis=-1)
         return {'Out': [jnp.max(flat, axis=-1).reshape(n, c, 1, 1)],
                 'Mask': [arg.astype(jnp.int32).reshape(n, c, 1, 1)]}
+    if ph >= kh or pw >= kw:
+        raise ValueError(
+            "max_pool2d_with_index: paddings must be smaller than ksize "
+            "(got ksize=%r paddings=%r) — the reference constraint "
+            "(pool_with_index_op.cc); a window lying entirely in padding "
+            "has no valid argmax index" % ((kh, kw), (ph, pw)))
     n, c, h, w = x.shape
     oh = (h + 2 * ph - kh) // sh + 1
     ow = (w + 2 * pw - kw) // sw + 1
@@ -205,7 +211,7 @@ def _max_pool2d_with_index(ctx, ins):
         else jnp.iinfo(x.dtype).min
     xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
                  constant_values=neg)
-    vals, idxs = [], []
+    vals, idxs, valid = [], [], []
     for i in range(kh):
         for j in range(kw):
             sl = jax.lax.slice(
@@ -216,14 +222,27 @@ def _max_pool2d_with_index(ctx, ins):
             row = jnp.arange(oh) * sh + i - ph      # input-plane coords
             col = jnp.arange(ow) * sw + j - pw
             idxs.append(row[:, None] * w + col[None, :])
+            valid.append((row[:, None] >= 0) & (row[:, None] < h)
+                         & (col[None, :] >= 0) & (col[None, :] < w))
     stack_v = jnp.stack(vals, axis=-1)              # [N, C, OH, OW, K]
     stack_i = jnp.stack(idxs, axis=-1)              # [OH, OW, K]
-    arg = jnp.argmax(stack_v, axis=-1)
+    stack_m = jnp.broadcast_to(jnp.stack(valid, axis=-1), stack_v.shape)
+    # padded slots must never win the argmax: a real value equal to
+    # dtype-min would TIE the padding fill and an earlier padded slot
+    # would emit its out-of-plane index — pick the first max that is
+    # also a valid in-plane slot (every window has one: paddings < ksize)
+    eff = jnp.where(stack_m, stack_v, neg)
+    mx = jnp.max(eff, axis=-1, keepdims=True)
+    score = (eff == mx) & stack_m
+    # NaN window: eff == mx is all-False (NaN != NaN) — fall back to the
+    # first VALID slot so the Mask stays in-plane while the NaN value
+    # propagates through Out
+    pick = jnp.where(score.any(axis=-1, keepdims=True), score, stack_m)
+    arg = jnp.argmax(pick, axis=-1)
     mask = jnp.take_along_axis(
         jnp.broadcast_to(stack_i, stack_v.shape), arg[..., None],
         axis=-1)[..., 0]
-    return {'Out': [jnp.max(stack_v, axis=-1)],
-            'Mask': [mask.astype(jnp.int32)]}
+    return {'Out': [mx[..., 0]], 'Mask': [mask.astype(jnp.int32)]}
 
 
 @register('unpool')

@@ -198,8 +198,42 @@ def _resolve_reshape(x, shape):
             out.append(int(s))
     return out
 
+def _reshape_infer(op, block):
+    """Direct shape inference: the generic probe-based path cannot
+    evaluate a STATIC target reshape of a dynamic(-1)-dim input (probe
+    sizes mismatch), which left output shapes None inside decode loops
+    (array_read -> embedding -> reshape -> concat -> fc chains)."""
+    shape = list(op.attrs.get('shape', ()))
+    if not shape or (op.inputs.get('Shape') and op.inputs['Shape'][0]):
+        return  # runtime shape tensor: leave to the generic path
+    xv = block._find_var_recursive(op.inputs['X'][0])
+    out = []
+    for i, s in enumerate(shape):
+        if s == 0:  # copy this dim from the input (reference semantics)
+            if xv is None or xv.shape is None or i >= len(xv.shape):
+                return
+            out.append(xv.shape[i])
+        else:
+            out.append(int(s))
+    if -1 in out and xv is not None and xv.shape is not None \
+            and all(d not in (-1, None) for d in xv.shape):
+        # fully-static input: resolve -1 to numel // prod(known dims)
+        known = 1
+        for d in out:
+            if d != -1:
+                known *= d
+        numel = int(np.prod(xv.shape)) if len(xv.shape) else 1
+        if known > 0 and numel % known == 0:
+            out[out.index(-1)] = numel // known
+    for n in op.outputs.get('Out', []):
+        v = block._find_var_recursive(n)
+        if v is not None:
+            v.shape = tuple(out)
+            if xv is not None and xv.dtype:
+                v.dtype = xv.dtype
 
-@register('reshape')
+
+@register('reshape', infer_shape=_reshape_infer)
 def _reshape(ctx, ins):
     x = X(ins)
     if ins.get('Shape') and ins['Shape'][0] is not None:
@@ -209,7 +243,7 @@ def _reshape(ctx, ins):
     return {'Out': [x.reshape(_resolve_reshape(x, shape))]}
 
 
-@register('reshape2')
+@register('reshape2', infer_shape=_reshape_infer)
 def _reshape2(ctx, ins):
     x = X(ins)
     if ins.get('Shape') and ins['Shape'][0] is not None:
@@ -707,3 +741,4 @@ def _fake_dequantize_max_abs(ctx, ins):
     scale = ins['Scale'][0].reshape(())
     max_range = float(ctx.attr('max_range', 127))
     return {'Out': [x * scale / max_range]}
+

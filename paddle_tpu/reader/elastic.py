@@ -35,6 +35,13 @@ except ImportError:          # non-POSIX: no advisory locking available
     fcntl = None
 
 
+class Lease(tuple):
+    """(task_id, task, skip) plus a `.gen` lease generation. Reports that
+    carry the generation are ignored when stale — a worker whose lease
+    expired (and whose task was re-leased to someone else) must not
+    clobber the live lease-holder's state."""
+
+
 class TaskService(object):
     """todo/pending/done task dispatch with leases, timeout re-queue, a
     failure cap, and an optional journal for crash recovery."""
@@ -47,6 +54,7 @@ class TaskService(object):
         self._lock = threading.Lock()
         self._todo = list(self._all)          # FIFO of task ids
         self._pending = {}                    # id -> lease deadline
+        self._lease_gen = {}                  # id -> generation counter
         self._done = set()
         self._dropped = set()                 # failure cap exceeded
         self._failures = {}                   # id -> count
@@ -164,15 +172,25 @@ class TaskService(object):
                         or task_id in self._done:
                     continue  # stale queue entry: never lease these
                 self._pending[task_id] = now + self._lease_timeout
-                return (task_id, self._all[task_id],
-                        self._progress.get(task_id, 0))
+                gen = self._lease_gen.get(task_id, 0) + 1
+                self._lease_gen[task_id] = gen
+                leased = Lease((task_id, self._all[task_id],
+                                self._progress.get(task_id, 0)))
+                leased.gen = gen
+                return leased
             return None
 
-    def report_progress(self, task_id, count):
+    def _stale(self, task_id, gen):
+        return gen is not None and gen != self._lease_gen.get(task_id)
+
+    def report_progress(self, task_id, count, gen=None):
         """Journal that `count` samples of task are consumed (monotonic).
         Doubles as the lease heartbeat: a long task that keeps reporting
-        progress is alive and must not be re-queued under another worker."""
+        progress is alive and must not be re-queued under another worker.
+        `gen` (from the Lease) makes stale reports no-ops."""
         with self._lock:
+            if self._stale(task_id, gen):
+                return
             self._progress[task_id] = count
             if task_id in self._pending:
                 self._pending[task_id] = time.monotonic() \
@@ -180,11 +198,13 @@ class TaskService(object):
             self._journal({'event': 'progress', 'task': task_id,
                            'count': count})
 
-    def renew_lease(self, task_id):
+    def renew_lease(self, task_id, gen=None):
         """Heartbeat without journaling progress: a producer that is still
         enqueuing a task's work (but whose consumer hasn't trained on it
         yet) must keep the lease from expiring into a duplicate dispatch."""
         with self._lock:
+            if self._stale(task_id, gen):
+                return
             if task_id in self._pending:
                 self._pending[task_id] = time.monotonic() \
                     + self._lease_timeout
@@ -209,15 +229,22 @@ class TaskService(object):
         with self._lock:
             return self._epoch
 
-    def task_finished(self, task_id):
+    def task_finished(self, task_id, gen=None):
         with self._lock:
+            if self._stale(task_id, gen):
+                return
             self._pending.pop(task_id, None)
             self._done.add(task_id)
             self._progress.pop(task_id, None)
             self._journal({'event': 'done', 'task': task_id})
 
-    def task_failed(self, task_id):
+    def task_failed(self, task_id, gen=None):
+        """Report a failure. With `gen`, a late report from an expired
+        lease (whose task may already be re-leased) is a no-op instead of
+        popping the NEW holder's live lease and double-queueing the task."""
         with self._lock:
+            if self._stale(task_id, gen):
+                return
             self._pending.pop(task_id, None)
             self._fail_locked(task_id, 'reported')
 
@@ -277,6 +304,7 @@ def elastic_sample_stream(service, read_task, progress_every=1):
             time.sleep(0.05)  # someone else holds leases; wait for requeue
             continue
         task_id, task, skip = leased
+        gen = getattr(leased, 'gen', None)
         try:
             n = 0
             for sample in read_task(task):
@@ -287,11 +315,11 @@ def elastic_sample_stream(service, read_task, progress_every=1):
                 # the moment the trainer receives it, so a consumer killed
                 # between samples never sees a replay
                 if (n - skip) % progress_every == 0:
-                    service.report_progress(task_id, n)
+                    service.report_progress(task_id, n, gen=gen)
                 yield sample
-            service.task_finished(task_id)
+            service.task_finished(task_id, gen=gen)
         except GeneratorExit:
             raise  # consumer died: lease expires / journal has progress
         except Exception:
-            service.task_failed(task_id)
+            service.task_failed(task_id, gen=gen)
             raise

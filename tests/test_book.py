@@ -149,3 +149,344 @@ def test_book_fit_a_line(tmp_path):
 
     _train_save_infer(build, feeds, str(tmp_path / 'line'), steps=20,
                       converge=0.5)
+
+
+def test_book_word2vec(tmp_path):
+    """test_word2vec.py: N-gram LM — 4 context words through a SHARED
+    embedding table predict the 5th."""
+    from paddle_tpu.dataset import imikolov
+    word_dict = imikolov.build_dict()
+    V, EMB = len(word_dict), 32
+
+    def build():
+        ws = [fluid.layers.data(name='w%d' % i, shape=[1], dtype='int64')
+              for i in range(4)]
+        label = fluid.layers.data(name='nextw', shape=[1], dtype='int64')
+        embs = [fluid.layers.reshape(
+                    fluid.layers.embedding(
+                        w, size=[V, EMB],
+                        param_attr=fluid.param_attr.ParamAttr(
+                            name='shared_emb_w')),
+                    shape=[-1, EMB]) for w in ws]
+        hidden = fluid.layers.fc(fluid.layers.concat(embs, axis=1),
+                                 size=128, act='sigmoid')
+        probs = fluid.layers.fc(hidden, size=V, act='softmax')
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(input=probs,
+                                                            label=label))
+        fluid.optimizer.Adam(2e-3).minimize(loss)
+        return ['w0', 'w1', 'w2', 'w3'], probs, loss
+
+    reader = fluid.layers.batch(imikolov.train(word_dict), 64)
+    batch = np.asarray(next(iter(reader())), dtype=np.int64)   # [B, 5]
+    feed = {('w%d' % i): batch[:, i:i + 1] for i in range(4)}
+    feed['nextw'] = batch[:, 4:5]
+
+    def feeds(n):
+        for _ in range(n):
+            yield dict(feed)
+
+    _train_save_infer(build, feeds, str(tmp_path / 'w2v'), steps=15,
+                      converge=0.95)
+
+
+def test_book_recommender_system(tmp_path):
+    """test_recommender_system.py: user/movie towers -> cos_sim rating
+    regression on movielens shapes (categories/title are LoD)."""
+    from paddle_tpu.dataset import movielens
+
+    def build():
+        def din(name, lod=0):
+            return fluid.layers.data(name=name, shape=[1], dtype='int64',
+                                     lod_level=lod)
+        uid, gender, age, job = din('uid'), din('gender'), din('age'), \
+            din('job')
+        mid, cat, title = din('mid'), din('cat', 1), din('title', 1)
+        score = fluid.layers.data(name='score', shape=[1], dtype='float32')
+
+        def emb(x, vocab, dim=16):
+            return fluid.layers.reshape(
+                fluid.layers.embedding(x, size=[vocab, dim]), [-1, dim])
+
+        usr = fluid.layers.fc(fluid.layers.concat(
+            [emb(uid, movielens.max_user_id() + 1), emb(gender, 2),
+             emb(age, len(movielens.age_table())),
+             emb(job, movielens.max_job_id() + 1)], axis=1),
+            size=32, act='tanh')
+        cat_pool = fluid.layers.sequence_pool(
+            fluid.layers.embedding(cat, size=[18, 16]), 'sum')
+        title_pool = fluid.layers.sequence_pool(
+            fluid.layers.embedding(title, size=[5174, 16]), 'sum')
+        mov = fluid.layers.fc(fluid.layers.concat(
+            [emb(mid, movielens.max_movie_id() + 1), cat_pool, title_pool],
+            axis=1), size=32, act='tanh')
+        pred = fluid.layers.scale(fluid.layers.cos_sim(usr, mov), scale=5.0)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred,
+                                                                score))
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+        return ['uid', 'gender', 'age', 'job', 'mid', 'cat', 'title'], \
+            pred, loss
+
+    reader = fluid.layers.batch(movielens.train(), 32)
+
+    def feeds(n):
+        it = reader()
+        for _ in range(n):
+            rows = next(it)
+            col = lambda i: np.asarray([[r[i]] for r in rows], np.int64)
+            cat_lens = [len(r[5]) for r in rows]
+            title_lens = [len(r[6]) for r in rows]
+            yield {
+                'uid': col(0), 'gender': col(1), 'age': col(2),
+                'job': col(3), 'mid': col(4),
+                'cat': fluid.create_lod_tensor(
+                    np.concatenate([r[5] for r in rows]).reshape(-1, 1)
+                    .astype(np.int64), [cat_lens]),
+                'title': fluid.create_lod_tensor(
+                    np.concatenate([r[6] for r in rows]).reshape(-1, 1)
+                    .astype(np.int64), [title_lens]),
+                'score': np.asarray([[r[7]] for r in rows], np.float32),
+            }
+
+    _train_save_infer(build, feeds, str(tmp_path / 'rec'), steps=12,
+                      converge=0.98)
+
+
+def test_book_label_semantic_roles(tmp_path):
+    """test_label_semantic_roles.py: conll05 SRL — per-slot embeddings ->
+    BiLSTM -> emission -> linear_chain_crf loss, crf_decoding served."""
+    from paddle_tpu.dataset import conll05
+    W, P, L, M = (conll05.WORD_DICT_LEN, conll05.PRED_DICT_LEN,
+                  conll05.LABEL_DICT_LEN, conll05.MARK_DICT_LEN)
+    EMB, H = 16, 32
+    slots = ['word', 'ctx_n2', 'ctx_n1', 'ctx_0', 'ctx_p1', 'ctx_p2',
+             'verb', 'mark']
+
+    def build():
+        ins = [fluid.layers.data(name=s, shape=[1], dtype='int64',
+                                 lod_level=1) for s in slots]
+        target = fluid.layers.data(name='target', shape=[1], dtype='int64',
+                                   lod_level=1)
+        word_attr = fluid.param_attr.ParamAttr(name='word_emb_w')
+        embs = [fluid.layers.embedding(v, size=[W, EMB],
+                                       param_attr=word_attr)
+                for v in ins[:6]]
+        embs.append(fluid.layers.embedding(ins[6], size=[P, EMB]))
+        embs.append(fluid.layers.embedding(ins[7], size=[M, EMB]))
+        feat = fluid.layers.fc(fluid.layers.concat(embs, axis=1),
+                               size=H, act='tanh')
+        fwd, _ = fluid.layers.dynamic_lstm(
+            fluid.layers.fc(feat, size=4 * H), size=4 * H,
+            use_peepholes=False)
+        rev, _ = fluid.layers.dynamic_lstm(
+            fluid.layers.fc(feat, size=4 * H), size=4 * H,
+            use_peepholes=False, is_reverse=True)
+        emission = fluid.layers.fc(
+            fluid.layers.concat([fwd, rev], axis=1), size=L)
+        crf_cost = fluid.layers.linear_chain_crf(
+            input=emission, label=target,
+            param_attr=fluid.param_attr.ParamAttr(name='crfw'))
+        loss = fluid.layers.mean(crf_cost)
+        decode = fluid.layers.crf_decoding(
+            input=emission,
+            param_attr=fluid.param_attr.ParamAttr(name='crfw'))
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+        return slots, decode, loss
+
+    reader = fluid.layers.batch(conll05.train(), 8)
+
+    def feeds(n):
+        it = reader()
+        for _ in range(n):
+            rows = next(it)
+            lens = [len(r[0]) for r in rows]
+
+            def lod_col(i):
+                return fluid.create_lod_tensor(
+                    np.concatenate([r[i] for r in rows]).reshape(-1, 1)
+                    .astype(np.int64), [lens])
+            feed = {s: lod_col(i) for i, s in enumerate(slots)}
+            feed['target'] = lod_col(8)
+            yield feed
+
+    _train_save_infer(build, feeds, str(tmp_path / 'srl'), steps=10,
+                      converge=0.98)
+
+
+def test_book_machine_translation(tmp_path):
+    """test_machine_translation.py: GRU encoder-decoder trained with
+    teacher forcing, then BEAM-SEARCH decoding through a separate infer
+    program sharing the trained parameters (by name, the reference's
+    pattern), save/load/serve round-trip on the decode program."""
+    PA = fluid.param_attr.ParamAttr
+    V, E, H, K, T = 64, 16, 32, 4, 6
+    BOS, EOS = 1, 0
+
+    def encoder(src):
+        src_emb = fluid.layers.embedding(src, size=[V, E],
+                                         param_attr=PA(name='src_emb_w'))
+        enc_in = fluid.layers.fc(src_emb, size=3 * H,
+                                 param_attr=PA(name='enc_proj_w'),
+                                 bias_attr=PA(name='enc_proj_b'))
+        enc_in.lod_level = src_emb.lod_level
+        enc = fluid.layers.dynamic_gru(enc_in, size=H,
+                                       param_attr=PA(name='enc_gru_w'),
+                                       bias_attr=PA(name='enc_gru_b'))
+        return fluid.layers.sequence_pool(enc, 'last')      # [B, H]
+
+    def dec_step_proj(emb2d, ctx2d):
+        return fluid.layers.fc(
+            fluid.layers.concat([emb2d, ctx2d], axis=1), size=3 * H,
+            param_attr=PA(name='dec_proj_w'),
+            bias_attr=PA(name='dec_proj_b'))
+
+    # ---- train program: teacher forcing ----
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup_p.random_seed = 9
+    with fluid.program_guard(main_p, startup_p):
+        src = fluid.layers.data(name='src', shape=[1], dtype='int64',
+                                lod_level=1)
+        tgt = fluid.layers.data(name='tgt', shape=[1], dtype='int64',
+                                lod_level=1)
+        tgt_next = fluid.layers.data(name='tgt_next', shape=[1],
+                                     dtype='int64', lod_level=1)
+        enc_last = encoder(src)
+        tgt_emb = fluid.layers.embedding(tgt, size=[V, E],
+                                         param_attr=PA(name='tgt_emb_w'))
+        ctx = fluid.layers.sequence_expand(enc_last, tgt_emb)
+        dec_in = dec_step_proj(tgt_emb, ctx)
+        dec_in.lod_level = tgt_emb.lod_level
+        dec = fluid.layers.dynamic_gru(dec_in, size=H,
+                                       param_attr=PA(name='dec_gru_w'),
+                                       bias_attr=PA(name='dec_gru_b'))
+        logits = fluid.layers.fc(dec, size=V,
+                                 param_attr=PA(name='dec_out_w'),
+                                 bias_attr=PA(name='dec_out_b'))
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            logits=logits, label=tgt_next))
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+
+    rng = np.random.RandomState(3)
+    lens = [5, 7, 4]
+
+    def make_feed():
+        src_toks = np.concatenate([rng.randint(2, V, l) for l in lens])
+        # toy task: target = source tokens (copy), learnable fast
+        tgt_in, tgt_out = [], []
+        src_pos = 0
+        for l in lens:
+            s = src_toks[src_pos:src_pos + l]
+            src_pos += l
+            tgt_in.append(np.concatenate([[BOS], s]))
+            tgt_out.append(np.concatenate([s, [EOS]]))
+        return {
+            'src': fluid.create_lod_tensor(
+                src_toks.reshape(-1, 1).astype(np.int64), [lens]),
+            'tgt': fluid.create_lod_tensor(
+                np.concatenate(tgt_in).reshape(-1, 1).astype(np.int64),
+                [[l + 1 for l in lens]]),
+            'tgt_next': fluid.create_lod_tensor(
+                np.concatenate(tgt_out).reshape(-1, 1).astype(np.int64),
+                [[l + 1 for l in lens]]),
+        }
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    feed = make_feed()
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        losses = []
+        for _ in range(15):
+            l, = exe.run(main_p, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(l).reshape(-1)[0]))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0], losses
+
+    # ---- infer program: beam search over the SHARED parameters ----
+    infer_p, infer_start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(infer_p, infer_start):
+        layers = fluid.layers
+        src = layers.data(name='src', shape=[1], dtype='int64',
+                          lod_level=1)
+        enc_last = encoder(src)                              # [1, H]
+        ctx_k = layers.expand(enc_last, expand_times=[K, 1])  # [K, H]
+
+        i = layers.fill_constant([1], 'int64', 0)
+        limit = layers.fill_constant([1], 'int64', T)
+        ids_arr = layers.array_write(
+            layers.fill_constant([K, 1], 'int64', BOS), i)
+        scores_arr = layers.array_write(
+            layers.fill_constant([K, 1], 'float32', 0.0), i)
+        parents_arr = layers.array_write(
+            layers.fill_constant([K], 'int32', 0), i)
+        hidden_arr = layers.array_write(
+            layers.fill_constant([K, H], 'float32', 0.0), i)
+        layers.increment(i, 1)
+        cond = layers.less_than(i, limit)
+        w = layers.While(cond)
+        with w.block():
+            t = layers.elementwise_sub(
+                i, layers.fill_constant([1], 'int64', 1))
+            pre_ids = layers.array_read(ids_arr, t)
+            pre_scores = layers.array_read(scores_arr, t)
+            pre_hidden = layers.array_read(hidden_arr, t)
+            emb = layers.reshape(
+                layers.embedding(pre_ids, size=[V, E],
+                                 param_attr=PA(name='tgt_emb_w')),
+                shape=[K, E])
+            # reshape pins static [K, .] shapes for fc's param inference
+            # inside the While block (array_read/expand infer no shape)
+            step_in = dec_step_proj(emb, layers.reshape(ctx_k, [K, H]))
+            h, _, _ = fluid.layers.gru_unit(
+                step_in, pre_hidden, 3 * H,
+                param_attr=PA(name='dec_gru_w'),
+                bias_attr=PA(name='dec_gru_b'))
+            logits = layers.fc(h, size=V,
+                               param_attr=PA(name='dec_out_w'),
+                               bias_attr=PA(name='dec_out_b'))
+            acc = layers.elementwise_add(
+                layers.log(layers.softmax(logits)), pre_scores)
+            sel_ids, sel_scores, parent = layers.beam_search(
+                pre_ids, pre_scores, None, acc, beam_size=K, end_id=EOS,
+                return_parent_idx=True)
+            layers.array_write(sel_ids, i, array=ids_arr)
+            layers.array_write(sel_scores, i, array=scores_arr)
+            layers.array_write(parent, i, array=parents_arr)
+            # beams reorder on selection: hidden follows its parent beam
+            layers.array_write(layers.gather(h, parent), i,
+                               array=hidden_arr)
+            layers.increment(i, 1)
+            layers.less_than(i, limit, cond=cond)
+        sent_ids, sent_scores = layers.beam_search_decode(
+            ids_arr, scores_arr, beam_size=K, end_id=EOS,
+            parents=parents_arr)
+
+    one_src = fluid.create_lod_tensor(
+        np.asarray([[5], [9], [3]], np.int64), [[3]])
+    with fluid.scope_guard(scope):   # trained params, by name
+        want_ids, want_scores = exe.run(
+            infer_p, feed={'src': one_src},
+            fetch_list=[sent_ids, sent_scores], return_numpy=False)
+        want_ids = np.asarray(want_ids.data if hasattr(want_ids, 'data')
+                              else want_ids)
+        # save the DECODE program: the served artifact is the translator
+        d = str(tmp_path / 'nmt')
+        fluid.io.save_inference_model(d, ['src'], [sent_ids, sent_scores],
+                                      exe, main_program=infer_p)
+    scope2 = fluid.core.Scope()
+    with fluid.scope_guard(scope2):
+        prog, fnames, fvars = fluid.load_inference_model(d, exe)
+        got_ids, got_scores = exe.run(
+            prog, feed={'src': one_src},
+            fetch_list=[f.name for f in fvars], return_numpy=False)
+        got_ids = np.asarray(got_ids.data if hasattr(got_ids, 'data')
+                             else got_ids)
+        got_scores = np.asarray(got_scores.data
+                                if hasattr(got_scores, 'data')
+                                else got_scores)
+    np.testing.assert_array_equal(got_ids, want_ids)
+    want_scores = np.asarray(want_scores.data
+                             if hasattr(want_scores, 'data')
+                             else want_scores)
+    np.testing.assert_allclose(got_scores, want_scores,
+                               rtol=1e-5, atol=1e-6)
+    assert want_ids.size >= K   # K hypotheses came back

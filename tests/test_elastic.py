@@ -378,3 +378,27 @@ def test_dropped_poison_task_survives_restart(tmp_path):
     assert leased is not None and leased[0] == 'good'
     assert svc2.get_task() is None     # poison never re-dispatches
     svc2.close()
+
+
+def test_stale_lease_reports_ignored():
+    """A worker whose lease expired (and whose task was re-leased) must
+    not clobber the live holder: its task_failed/report_progress/finish
+    are no-ops once the generation moved on."""
+    from paddle_tpu.reader.elastic import TaskService
+    svc = TaskService(['t'], lease_timeout_s=0.01, max_failures=10)
+    a = svc.get_task()
+    assert a is not None and a[0] == 't'
+    time.sleep(0.05)                       # A's lease expires
+    b = svc.get_task()                     # requeued + re-leased to B
+    assert b is not None and b[0] == 't' and b.gen != a.gen
+
+    svc.report_progress('t', 1, gen=b.gen)     # B is at sample 1
+    svc.task_failed('t', gen=a.gen)            # A's LATE failure report
+    # B's lease must still be live and t must not be double-queued
+    assert svc.counts['pending'] == 1 and svc.counts['todo'] == 0
+    svc.report_progress('t', 99, gen=a.gen)    # stale progress: ignored
+    assert svc.get_task() is None              # nothing leasable
+    svc.task_finished('t', gen=a.gen)          # stale finish: ignored
+    assert svc.counts['done'] == 0
+    svc.task_finished('t', gen=b.gen)          # the live holder finishes
+    assert svc.counts['done'] == 1 and svc.epoch_done

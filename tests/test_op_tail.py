@@ -7,6 +7,7 @@ generic vjp path (core/lowering.py), mirroring the reference's
 test_hinge_loss_op.py et al. methodology (op_test.py:303/:414).
 """
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from op_test import OpTest
@@ -120,6 +121,54 @@ def test_max_pool2d_with_index_padded():
     _check('max_pool2d_with_index', {'X': x},
            {'ksize': [3, 3], 'strides': [2, 2], 'paddings': [1, 1]},
            {'Out': out, 'Mask': mask})
+
+
+def test_max_pool2d_with_index_dtype_min_tie():
+    """A real value equal to dtype-min must win over a padded slot (the
+    pad fill ties it; ADVICE r4 nn_ops.py:196): the Mask must stay an
+    in-plane index, never a negative/out-of-plane one."""
+    x = np.full((1, 1, 2, 2), np.finfo(np.float32).min, np.float32)
+    out, mask = _np_max_pool_with_index(x, 2, 1, 1)
+    # numpy oracle scans valid coords only -> in-plane indices
+    assert (mask >= 0).all() and (mask < 4).all()
+    _check('max_pool2d_with_index', {'X': x},
+           {'ksize': [2, 2], 'strides': [1, 1], 'paddings': [1, 1]},
+           {'Out': out, 'Mask': mask})
+
+
+def test_max_pool2d_with_index_nan_keeps_mask_in_plane():
+    """A NaN in a padded border window must not push the argmax onto a
+    padded slot: Out propagates the NaN, Mask stays in-plane."""
+    t = OpTest()
+    t.op_type = 'max_pool2d_with_index'
+    x = np.zeros((1, 1, 2, 2), np.float32)
+    x[0, 0, 0, 0] = np.nan
+    t.inputs = {'X': x}
+    t.attrs = {'ksize': [2, 2], 'strides': [1, 1], 'paddings': [1, 1]}
+    t.outputs = {'Out': np.zeros((1, 1, 3, 3), np.float32),
+                 'Mask': np.zeros((1, 1, 3, 3), np.int32)}
+    main, startup, feed, out_names, _ = t._build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        o, m = exe.run(main, feed=feed,
+                       fetch_list=[out_names['Out'][0],
+                                   out_names['Mask'][0]])
+    m = np.asarray(m)
+    assert (m >= 0).all() and (m < 4).all(), m
+    assert np.isnan(np.asarray(o)).any()   # NaN propagates in Out
+
+
+def test_max_pool2d_with_index_pad_ge_kernel_rejected():
+    """paddings >= ksize would create windows entirely inside padding
+    (no valid argmax) — rejected, the reference's constraint."""
+    x = np.zeros((1, 1, 4, 4), np.float32)
+    with pytest.raises(Exception, match='paddings must be smaller'):
+        _check('max_pool2d_with_index', {'X': x},
+               {'ksize': [2, 2], 'strides': [1, 1], 'paddings': [2, 2]},
+               {'Out': np.zeros((1, 1, 7, 7), np.float32),
+                'Mask': np.zeros((1, 1, 7, 7), np.int32)})
 
 
 def test_max_pool2d_with_index_global():
