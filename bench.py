@@ -212,12 +212,17 @@ def bench_transformer():
     batch = int(os.environ.get('PTPU_BENCH_TRANS_BATCH', '64'))
     seq_len = int(os.environ.get('PTPU_BENCH_TRANS_SEQ', '256'))
     steps = int(os.environ.get('PTPU_BENCH_TRANS_STEPS', '20'))
+    # ablation knobs (PERF_NOTES.md dropout-tax section)
+    dropout = float(os.environ.get('PTPU_BENCH_TRANS_DROPOUT', '0.1'))
+    ad_env = os.environ.get('PTPU_BENCH_TRANS_ATTN_DROPOUT', '')
+    attn_dropout = float(ad_env) if ad_env else None
 
     main_p, startup_p = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_p, startup_p):
         feeds, loss, flops_per_tok = build_transformer_train(
             src_vocab=32000, trg_vocab=32000, max_len=seq_len,
-            d_model=512, d_ff=2048, n_head=8, n_layer=6)
+            d_model=512, d_ff=2048, n_head=8, n_layer=6,
+            dropout=dropout, attn_dropout=attn_dropout)
     fluid.contrib.mixed_precision.enable_bf16(main_p)
 
     exe, dev = _device()

@@ -82,21 +82,24 @@ def ffn(x, d_model, d_ff):
     return fluid.layers.fc(h, size=d_model, num_flatten_dims=2)
 
 
-def encoder_layer(x, n_head, d_model, d_ff, seq, dropout):
+def encoder_layer(x, n_head, d_model, d_ff, seq, dropout,
+                  attn_dropout=None):
+    ad = dropout if attn_dropout is None else attn_dropout
     x = _residual_ln(x, multi_head_attention(x, x, n_head, d_model, seq, seq,
-                                             dropout=dropout), dropout)
+                                             dropout=ad), dropout)
     return _residual_ln(x, ffn(x, d_model, d_ff), dropout)
 
 
 def decoder_layer(x, enc_out, n_head, d_model, d_ff, trg_len, src_len,
-                  causal_mask, dropout):
+                  causal_mask, dropout, attn_dropout=None):
+    ad = dropout if attn_dropout is None else attn_dropout
     x = _residual_ln(x, multi_head_attention(x, x, n_head, d_model, trg_len,
                                              trg_len, mask=causal_mask,
-                                             dropout=dropout, causal=True),
+                                             dropout=ad, causal=True),
                      dropout)
     x = _residual_ln(x, multi_head_attention(x, enc_out, n_head, d_model,
                                              trg_len, src_len,
-                                             dropout=dropout), dropout)
+                                             dropout=ad), dropout)
     return _residual_ln(x, ffn(x, d_model, d_ff), dropout)
 
 
@@ -113,7 +116,7 @@ def _embed(ids, vocab, d_model, seq, name):
 
 def build_transformer_train(src_vocab=32000, trg_vocab=32000, max_len=256,
                             d_model=512, d_ff=2048, n_head=8, n_layer=6,
-                            dropout=0.1, lr=None):
+                            dropout=0.1, attn_dropout=None, lr=None):
     """Returns (feeds, avg_loss, train_flops_per_token).
 
     feeds = [(name, per-sample shape, dtype)]; sequences arrive padded to
@@ -137,7 +140,8 @@ def build_transformer_train(src_vocab=32000, trg_vocab=32000, max_len=256,
         enc = fluid.layers.dropout(enc, dropout_prob=dropout,
                                    dropout_implementation='upscale_in_train')
     for _ in range(n_layer):
-        enc = encoder_layer(enc, n_head, d_model, d_ff, S, dropout)
+        enc = encoder_layer(enc, n_head, d_model, d_ff, S, dropout,
+                            attn_dropout=attn_dropout)
 
     dec = _embed(trg, trg_vocab, d_model, S, 'trg_emb')
     if dropout:
@@ -145,7 +149,8 @@ def build_transformer_train(src_vocab=32000, trg_vocab=32000, max_len=256,
                                    dropout_implementation='upscale_in_train')
     for _ in range(n_layer):
         dec = decoder_layer(dec, enc, n_head, d_model, d_ff, S, S,
-                            causal_mask, dropout)
+                            causal_mask, dropout,
+                            attn_dropout=attn_dropout)
 
     logits = fluid.layers.fc(dec, size=trg_vocab, num_flatten_dims=2,
                              bias_attr=False)

@@ -179,7 +179,21 @@ def _dropout(ctx, ins):
     if ctx.is_test:
         out = x if impl == 'upscale_in_train' else x * (1.0 - p)
         return {'Out': [out], 'Mask': [jnp.ones_like(x)]}
-    keep = jax.random.bernoulli(ctx.rng(), 1.0 - p, x.shape)
+    from ..core import config as _config
+    bits = int(_config.get_flag('dropout_bits') or 0)
+    if bits in (8, 16):
+        # low-bit keep decision (FLAGS_dropout_bits): threshold compare on
+        # uint8/16 random bits — quantizes p to 1/2^bits (bernoulli itself
+        # quantizes to f32's 2^-24), generating/holding 4x/2x less random
+        # material per element than the 32-bit default. Measured ablation
+        # in PERF_NOTES.md (transformer dropout-tax section).
+        dt = jnp.uint8 if bits == 8 else jnp.uint16
+        # clamp: p ~ 1 would round to 2^bits, which wraps to 0 in the
+        # unsigned compare and silently kept EVERYTHING
+        thresh = min(int(round(p * (1 << bits))), (1 << bits) - 1)
+        keep = jax.random.bits(ctx.rng(), x.shape, dt) >= thresh
+    else:
+        keep = jax.random.bernoulli(ctx.rng(), 1.0 - p, x.shape)
     if impl == 'upscale_in_train':
         scale = 0.0 if p >= 1.0 else 1.0 / (1.0 - p)
         out = jnp.where(keep, x * scale, 0.0)

@@ -103,3 +103,35 @@ def test_amp_params_stay_fp32():
             if arr is not None and np.issubdtype(
                     np.asarray(arr).dtype, np.floating):
                 assert np.asarray(arr).dtype == np.float32, v.name
+
+
+def test_dropout_bits_flag_numerics():
+    """FLAGS_dropout_bits low-bit keep-decision (PERF_NOTES dropout-tax
+    ablation): keep rate tracks 1-p and kept values upscale by 1/(1-p)."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.core import config as cfg
+
+    x = np.ones((64, 512), np.float32)
+    exe = fluid.Executor(fluid.CPUPlace())
+    prev = cfg.get_flag('dropout_bits')
+    try:
+        for bits in (8, 16):
+            # flags are consumed at trace time: a fresh program per value
+            # (the executor cache is not keyed on flags)
+            cfg.set_flags({'dropout_bits': bits})
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                inp = fluid.layers.data('xb', shape=[512],
+                                        dtype='float32')
+                out = fluid.layers.dropout(
+                    inp, dropout_prob=0.25,
+                    dropout_implementation='upscale_in_train')
+            o, = exe.run(main, feed={'xb': x}, fetch_list=[out])
+            o = np.asarray(o)
+            kept = o != 0.0
+            rate = kept.mean()
+            assert abs(rate - 0.75) < 0.03, (bits, rate)
+            np.testing.assert_allclose(o[kept], 1.0 / 0.75, rtol=1e-5)
+    finally:
+        cfg.set_flags({'dropout_bits': prev})
