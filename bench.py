@@ -456,6 +456,40 @@ def bench_ocr():
                           'star is "end-to-end training runs", BASELINE.md)')
 
 
+def bench_smallnet():
+    """SmallNet (cifar-quick) vs the committed row: 33.113 ms/batch at
+    bs256 on a K40m (benchmark/README.md:58). Reported in the baseline's
+    unit (ms/batch, lower is better); vs_baseline = baseline/measured."""
+    import paddle_tpu as fluid
+    from models.smallnet import build_train_net
+
+    batch = int(os.environ.get('PTPU_BENCH_SMALLNET_BATCH', '256'))
+    steps = int(os.environ.get('PTPU_BENCH_SMALLNET_STEPS', '50'))
+
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup_p):
+        images, label, loss, acc = build_train_net()
+    fluid.contrib.mixed_precision.enable_bf16(main_p)
+
+    exe, dev = _device()
+    exe.run(startup_p)
+    import jax
+    import jax.numpy as jnp
+    xs = jax.device_put(
+        jnp.asarray(np.random.randn(batch, 3, 32, 32), jnp.float32), dev)
+    lab = jax.device_put(
+        jnp.asarray(np.random.randint(0, 10, (batch, 1)), jnp.int32), dev)
+    feed = {'data': xs, 'label': lab}
+
+    dt = _timed_steps(exe, main_p, feed, loss, steps, warmup=4)
+    ms_batch = dt / steps * 1000.0
+    base_ms = 33.113 * batch / 256.0
+    return _line('smallnet_cifar_ms_batch', ms_batch, 'ms/batch',
+                 base_ms / ms_batch, dtype='bf16', batch=batch,
+                 baseline='33.113 ms/batch at batch 256 on K40m '
+                          '(benchmark/README.md:58), scaled by batch/256')
+
+
 def bench_stacked_lstm():
     """Stacked-LSTM text classification vs the committed RNN benchmark row
     (benchmark/README.md:119: 2 LSTM layers + fc, hidden 256, batch 64,
@@ -567,6 +601,7 @@ BENCHES = [
     ('stacked_lstm_text_cls_ms_batch', bench_stacked_lstm),
     ('googlenet_train_img_s_per_chip', bench_googlenet),
     ('googlenet_infer_img_s_per_chip', bench_googlenet_infer),
+    ('smallnet_cifar_ms_batch', bench_smallnet),
 ]
 
 # PTPU_BENCH_ONLY token -> metric-name prefix; indices derive from BENCHES
@@ -576,7 +611,7 @@ _SHORT_PREFIX = {
     'bert': 'bert', 'ctr': 'ctr', 'ocr': 'ocr', 'vgg': 'vgg',
     'alexnet': 'alexnet', 'infer': 'resnet50_infer',
     'lstm': 'stacked_lstm', 'googlenet': 'googlenet_train',
-    'ginfer': 'googlenet_infer',
+    'ginfer': 'googlenet_infer', 'smallnet': 'smallnet',
 }
 _SHORT = {tok: next(i for i, (n, _) in enumerate(BENCHES)
                     if n.startswith(pref))

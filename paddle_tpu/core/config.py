@@ -71,6 +71,11 @@ FLAGS = {
     'deterministic': os.environ.get('FLAGS_deterministic', '1') == '1',
     'tensor_array_capacity': int(
         os.environ.get('FLAGS_tensor_array_capacity', '128')),
+    # per-step PRNG implementation override (rng_impl() docstring)
+    'rng_impl': os.environ.get('FLAGS_rng_impl', '') or None,
+    # low-bit dropout keep-decision (0 = off; 8/16 = threshold compare on
+    # that many random bits — the PERF_NOTES dropout-tax ablation knob)
+    'dropout_bits': int(os.environ.get('FLAGS_dropout_bits', '0')),
 }
 
 

@@ -164,13 +164,15 @@ class Executor(object):
         if not seed:
             seed = 1234567 if _config.get_flag('deterministic') \
                 else _process_entropy()
-        with jax.default_device(self._device) if self._device is not None \
-                else _nullcontext():
-            # carried as RAW key data (uint32) so multi-host placement can
-            # treat it like any other array; step() re-wraps it
-            impl = _config.rng_impl()
-            rng = jax.random.key_data(
-                jax.random.fold_in(jax.random.key(seed, impl=impl), step))
+        # carried as RAW key data (uint32) so multi-host placement can
+        # treat it like any other array; step() re-wraps it. Computed on
+        # the HOST cpu backend: the eager key->fold_in->key_data chain on
+        # an accelerator is 2-3 tiny dispatches per step, measured ~20 ms
+        # through the axon tunnel — it throttled every small-model step
+        # (PERF_NOTES.md smallnet note). Key derivation is deterministic
+        # math, so the stream is identical wherever it is computed.
+        impl = _config.rng_impl()
+        rng = self._host_rng(seed, impl, step)
 
         from . import profiler as _profiler
         prof_ctx = (_profiler.record_event('executor_run#%d' % program._uid)
@@ -193,6 +195,25 @@ class Executor(object):
 
     def close(self):
         self._cache.clear()
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _host_rng(seed, impl, step):
+        """Per-step raw key data, derived on the host cpu backend (numpy
+        result). Cached base key per (seed, impl)."""
+        cache = Executor._host_rng_cache
+        base = cache.get((seed, impl))
+        if base is None:
+            cpu = jax.local_devices(backend='cpu')[0]
+            with jax.default_device(cpu):
+                base = jax.random.key(seed, impl=impl)
+            cache[(seed, impl)] = base
+        cpu = jax.local_devices(backend='cpu')[0]
+        with jax.default_device(cpu):
+            return np.asarray(jax.random.key_data(
+                jax.random.fold_in(base, step)))
+
+    _host_rng_cache = {}
 
     # ------------------------------------------------------------------
     def _feed_var(self, program, name):
@@ -241,12 +262,17 @@ class Executor(object):
         return (tuple(np.shape(v)), str(getattr(v, 'dtype', type(v).__name__)))
 
     def _cache_key(self, program, feed_vals, fetch_names, state, out_names):
+        from .core import config as _config
         return (program._uid, program._build_epoch,
                 tuple((n, self._sig(v)) for n, v in sorted(feed_vals.items())),
                 tuple(fetch_names),
                 tuple((n, self._sig(v)) for n, v in sorted(state.items())),
                 out_names, bool(getattr(program, '_amp_bf16', False)),
-                int(getattr(program, '_grad_accum_k', 1) or 1))
+                int(getattr(program, '_grad_accum_k', 1) or 1),
+                # trace-time flags that change the compiled numerics:
+                # toggling them must recompile, not silently reuse
+                _config.rng_impl(),
+                int(_config.get_flag('dropout_bits') or 0))
 
     @staticmethod
     def _ga_partition(program, fetch_names):

@@ -209,10 +209,15 @@ class CompiledTrainer(object):
                 for n, v in zip(self._state_names, self._state)}
 
     def _rng(self):
+        # derived on the host cpu backend: eager key math on a remote
+        # accelerator costs dispatch round-trips per step (the Executor
+        # does the same; PERF_NOTES.md r5 note). Bit-identical anywhere.
         import jax
-        key = jax.random.key(self._seed, impl=self._impl)
-        return jax.random.key_data(jax.random.fold_in(key,
-                                                      self._step_count))
+        cpu = jax.local_devices(backend='cpu')[0]
+        with jax.default_device(cpu):
+            key = jax.random.key(self._seed, impl=self._impl)
+            return np.asarray(jax.random.key_data(
+                jax.random.fold_in(key, self._step_count)))
 
     def step(self, inputs):
         """Run one train step. inputs: list (feed order) or dict.

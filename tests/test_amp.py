@@ -117,8 +117,8 @@ def test_dropout_bits_flag_numerics():
     prev = cfg.get_flag('dropout_bits')
     try:
         for bits in (8, 16):
-            # flags are consumed at trace time: a fresh program per value
-            # (the executor cache is not keyed on flags)
+            # fresh program per value (belt; the executor cache is ALSO
+            # keyed on the flag now, asserted below)
             cfg.set_flags({'dropout_bits': bits})
             main, startup = fluid.Program(), fluid.Program()
             with fluid.program_guard(main, startup):
@@ -133,5 +133,11 @@ def test_dropout_bits_flag_numerics():
             rate = kept.mean()
             assert abs(rate - 0.75) < 0.03, (bits, rate)
             np.testing.assert_allclose(o[kept], 1.0 / 0.75, rtol=1e-5)
+        # same program, flag toggled: the compile cache must miss (the
+        # key includes trace-time rng flags), not silently reuse
+        n0 = len(exe._cache)
+        cfg.set_flags({'dropout_bits': 0})
+        exe.run(main, feed={'xb': x}, fetch_list=[out])
+        assert len(exe._cache) == n0 + 1
     finally:
         cfg.set_flags({'dropout_bits': prev})
