@@ -174,8 +174,12 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
     (bipartite + per-prediction), mine hard negatives, localization
     smooth-L1 + confidence cross-entropy."""
     helper = LayerHelper('ssd_loss')
-    if mining_type != 'max_negative':
-        raise NotImplementedError("ssd_loss: only mining_type='max_negative'")
+    if mining_type not in ('max_negative', 'hard_example'):
+        raise ValueError("ssd_loss: mining_type must be 'max_negative' or "
+                         "'hard_example' (ref mine_hard_examples_op.cc)")
+    if mining_type == 'hard_example' and not sample_size:
+        raise ValueError("ssd_loss: hard_example mining requires "
+                         "sample_size > 0 (ref mine_hard_examples_op.cc)")
     # 1. match (overlap_threshold gates per-prediction matches, ref
     # ssd_loss -> bipartite_match(iou, match_type, overlap_threshold))
     iou = iou_similarity(x=gt_box, y=prior_box)
@@ -189,17 +193,35 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
     cls_loss = nn.cross_entropy(conf_sm, tensor.cast(gt_lbl, 'int64'))
     cls_loss2d = nn.reshape(cls_loss, shape=[-1, confidence.shape[1]])
     # 3. mine hard negatives
+    enc_gt = box_coder(prior_box=prior_box, prior_box_var=prior_box_var,
+                       target_box=gt_box, code_type='encode_center_size')
+    mine_inputs = {'ClsLoss': cls_loss2d, 'MatchIndices': matched_indices,
+                   'MatchDist': matched_dist}
+    if mining_type == 'hard_example':
+        # hard_example ranks priors by cls + loc loss (the kernel's
+        # LocLoss input, mine_hard_examples_op.cc:99); the pre-mining
+        # loc loss uses targets from the FIRST match, WEIGHTED so
+        # unmatched priors contribute cls loss only (their assign target
+        # is the mismatch fill, not a real box)
+        loc_tgt0, loc_w0 = target_assign(enc_gt, matched_indices)
+        loc_tgt0.stop_gradient = True
+        loc_w0.stop_gradient = True
+        pre_loc = nn.smooth_l1(nn.reshape(location, shape=[-1, 4]),
+                               nn.reshape(loc_tgt0, shape=[-1, 4]))
+        pre_loc = pre_loc * nn.reshape(loc_w0, shape=[-1, 1])
+        mine_inputs['LocLoss'] = nn.reshape(
+            pre_loc, shape=[-1, confidence.shape[1]])
     neg_indices = _out(helper, 'int32')
     neg_indices.lod_level = 1
     updated = _out(helper, 'int32')
     helper.append_op(
         type='mine_hard_examples',
-        inputs={'ClsLoss': cls_loss2d, 'MatchIndices': matched_indices,
-                'MatchDist': matched_dist},
+        inputs=mine_inputs,
         outputs={'NegIndices': neg_indices,
                  'UpdatedMatchIndices': updated},
         attrs={'neg_pos_ratio': neg_pos_ratio,
                'neg_dist_threshold': neg_overlap,
+               'sample_size': int(sample_size or 0),
                'mining_type': mining_type}, infer_shape=False)
     # 4. targets with negatives enabled
     gt_lbl2, conf_w = target_assign(gt_label, updated,
@@ -207,9 +229,7 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
                                     mismatch_value=background_label)
     gt_lbl2.stop_gradient = True
     conf_w.stop_gradient = True
-    enc_gt = box_coder(prior_box=prior_box, prior_box_var=prior_box_var,
-                       target_box=gt_box, code_type='encode_center_size')
-    loc_tgt, loc_w = target_assign(enc_gt, updated)
+    loc_tgt, loc_w = target_assign(enc_gt, updated)  # enc_gt from step 3
     loc_tgt.stop_gradient = True
     loc_w.stop_gradient = True
     # 5. losses over flattened [B*M, .] rows (reference __reshape_to_2d)

@@ -1361,6 +1361,20 @@ def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
     if sample_weight is not None:
         inputs['SampleWeight'] = sample_weight
     S = int(num_neg_samples) if num_neg_samples else 10
+    attrs = {'num_total_classes': int(num_total_classes),
+             'num_neg_samples': S, 'seed': seed,
+             'sampler': _NCE_SAMPLERS[sampler], 'is_sparse': is_sparse}
+    if sampler == 'custom_dist':
+        # static probs become an XLA-constant CDF (ref CustomSampler's
+        # host alias table, math/sampler.cc)
+        if custom_dist is None:
+            raise ValueError("nce sampler='custom_dist' requires "
+                             "custom_dist (per-class probabilities)")
+        if len(custom_dist) != int(num_total_classes):
+            raise ValueError(
+                "nce custom_dist must have num_total_classes=%d entries, "
+                "got %d" % (num_total_classes, len(custom_dist)))
+        attrs['custom_probs'] = [float(p) for p in custom_dist]
     cost = helper.create_variable_for_type_inference(input.dtype)
     sample_logits = helper.create_variable_for_type_inference(input.dtype)
     sample_labels = helper.create_variable_for_type_inference('int64')
@@ -1368,32 +1382,39 @@ def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
         type='nce', inputs=inputs,
         outputs={'Cost': cost, 'SampleLogits': sample_logits,
                  'SampleLabels': sample_labels},
-        attrs={'num_total_classes': int(num_total_classes),
-               'num_neg_samples': S, 'seed': seed,
-               'sampler': _NCE_SAMPLERS[sampler], 'is_sparse': is_sparse})
+        attrs=attrs)
     return cost
 
 
 def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
              name=None, path_table=None, path_code=None, is_custom=False,
              is_sparse=False):
-    """Hierarchical sigmoid over a complete binary class tree
-    (ref hierarchical_sigmoid_op.cc). Cost is O(log2 C) dots per example."""
-    if is_custom or path_table is not None or path_code is not None:
-        raise NotImplementedError(
-            "hsigmoid custom trees (path_table/path_code) are not "
-            "supported; the default complete binary tree covers the "
-            "reference's non-custom path")
+    """Hierarchical sigmoid over a complete binary class tree, or a
+    user-supplied tree via path_table/path_code (ref
+    hierarchical_sigmoid_op.cc, math/matrix_bit_code.h CustomCode).
+    Cost is O(log2 C) (or path length) dots per example.
+
+    Custom trees: path_table [N, L] holds each sample's leaf->root rows
+    into W (-1 padding after the path ends), path_code [N, L] the target
+    bit per node; num_classes is then the NON-LEAF node count (W rows),
+    matching the reference's contract."""
+    custom = is_custom or path_table is not None or path_code is not None
+    if custom and (path_table is None or path_code is None):
+        raise ValueError("hsigmoid custom trees need BOTH path_table and "
+                         "path_code (ref layers.hsigmoid contract)")
     helper = LayerHelper('hierarchical_sigmoid', param_attr=param_attr,
                          bias_attr=bias_attr, name=name)
     dim = input.shape[-1]
+    rows = int(num_classes) if custom else int(num_classes) - 1
     w = helper.create_parameter(attr=helper.param_attr,
-                                shape=[num_classes - 1, dim],
-                                dtype=input.dtype)
+                                shape=[rows, dim], dtype=input.dtype)
     inputs = {'X': input, 'Label': label, 'W': w}
+    if custom:
+        inputs['PathTable'] = path_table
+        inputs['PathCode'] = path_code
     battr = helper.bias_attr
     if battr:
-        b = helper.create_parameter(attr=battr, shape=[1, num_classes - 1],
+        b = helper.create_parameter(attr=battr, shape=[1, rows],
                                     dtype=input.dtype, is_bias=True)
         inputs['Bias'] = b
     out = helper.create_variable_for_type_inference(input.dtype)

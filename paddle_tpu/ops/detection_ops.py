@@ -420,6 +420,29 @@ def _mine_hard_examples(ctx, ins):
     neg_overlap = float(ctx.attr('neg_dist_threshold', 0.5))
     B, M = cls_loss.shape
     loss = cls_loss if loc_loss is None else cls_loss + loc_loss
+    if ctx.attr('mining_type', 'max_negative') == 'hard_example':
+        # ref mine_hard_examples_op.cc kHardExample: EVERY prior is
+        # eligible; take the top-min(sample_size, M) by (cls+loc) loss,
+        # DEMOTE matched priors that did not make the cut (match -> -1),
+        # and emit the selected unmatched ones as negatives (ascending
+        # prior ids, like the reference's std::set ordering)
+        sample_size = int(ctx.attr('sample_size', 0) or 0)
+        if sample_size <= 0:
+            raise ValueError(
+                "mine_hard_examples: sample_size must be > 0 in "
+                "hard_example mode (ref mine_hard_examples_op.cc:240)")
+        neg_sel = min(sample_size, M)                 # static bound
+        ranks = jnp.argsort(jnp.argsort(-loss, axis=1),
+                            axis=1).astype(jnp.int32)  # desc position
+        sel = ranks < neg_sel                          # [B, M]
+        updated = jnp.where((match >= 0) & ~sel, -1, match)
+        negm = (match < 0) & sel
+        vals = jnp.where(negm, jnp.arange(M, dtype=jnp.int32)[None, :], M)
+        vals = jnp.sort(vals, axis=1)
+        neg_idx = jnp.where(vals < M, vals, -1)
+        lod = lengths_to_offsets([M] * B)
+        return {'NegIndices': [LoDArray(neg_idx.reshape(-1, 1), (lod,))],
+                'UpdatedMatchIndices': [updated]}
     dist = None
     if ins.get('MatchDist') and ins['MatchDist'][0] is not None:
         dist = unwrap(ins['MatchDist'][0])

@@ -23,12 +23,15 @@ from ..core.lod import unwrap
 # ---------------------------------------------------------------------------
 # samplers (ref operators/math/sampler.cc): probability of drawing class c
 # ---------------------------------------------------------------------------
-def _sample_ids(rng, sampler, shape, num_classes):
-    if sampler == 2:
-        raise NotImplementedError(
-            "nce sampler='custom_dist' is not supported; use 'uniform' or "
-            "'log_uniform' (CustomDistProbs would need a host-side alias "
-            "table)")
+def _sample_ids(rng, sampler, shape, num_classes, probs=None):
+    if sampler == 2:  # custom distribution (ref CustomSampler): the
+        # reference builds a host-side alias table; TPU-native the static
+        # probs become an XLA-constant CDF and sampling is one
+        # searchsorted over it — same O(1)-per-draw on the VPU
+        cdf = jnp.cumsum(jnp.asarray(probs, jnp.float32))
+        u = jax.random.uniform(rng, shape) * cdf[-1]
+        ids = jnp.searchsorted(cdf, u, side='right').astype(jnp.int32)
+        return jnp.clip(ids, 0, num_classes - 1)
     if sampler == 1:  # log-uniform (Zipfian), ref LogUniformSampler
         u = jax.random.uniform(rng, shape)
         ids = jnp.exp(u * np.log(num_classes + 1.0)).astype(jnp.int32) - 1
@@ -36,7 +39,10 @@ def _sample_ids(rng, sampler, shape, num_classes):
     return jax.random.randint(rng, shape, 0, num_classes)  # uniform
 
 
-def _sample_prob(sampler, ids, num_classes):
+def _sample_prob(sampler, ids, num_classes, probs=None):
+    if sampler == 2:
+        p = jnp.asarray(probs, jnp.float32)
+        return p[ids] / jnp.sum(p)
     if sampler == 1:
         idf = ids.astype(jnp.float32)
         return (jnp.log((idf + 2.0) / (idf + 1.0))
@@ -62,13 +68,17 @@ def _nce_parts(ctx, ins):
     C = int(ctx.attr('num_total_classes'))
     S = int(ctx.attr('num_neg_samples', 10))
     sampler = int(ctx.attr('sampler', 0))
+    probs = ctx.attr('custom_probs', None)
+    if sampler == 2 and not probs:
+        raise ValueError("nce sampler='custom_dist' needs custom_dist "
+                         "probabilities (layers.nce custom_dist=...)")
     B = x.shape[0]
     num_true = label.shape[-1] if label.ndim > 1 else 1
     label = label.reshape(B, num_true)
-    neg = _sample_ids(ctx.rng(), sampler, (B, S), C)
+    neg = _sample_ids(ctx.rng(), sampler, (B, S), C, probs)
     ids = jnp.concatenate([label, neg], axis=1)      # [B, T+S]
     logits = _nce_logits(x, w, b, ids)
-    q = _sample_prob(sampler, ids, C)
+    q = _sample_prob(sampler, ids, C, probs)
     # P(sampled|x) model: o/(o + k·q); in log space l = logit - log(k·q)
     k = float(S)
     l = logits - jnp.log(k * q)
@@ -148,21 +158,33 @@ def _hsigmoid_parts(ctx, ins):
     (math/matrix_bit_code.h): for label c, node index at depth j is
     ((c + C) >> (j + 1)) - 1 and the target bit is ((c + C) >> j) & 1,
     with path length floor(log2(c + C)). Everything is a fixed [B, Lmax]
-    program with a depth mask, so XLA sees static shapes for any labels."""
+    program with a depth mask, so XLA sees static shapes for any labels.
+
+    CUSTOM trees (ref CustomCode, hierarchical_sigmoid_op.h): the caller
+    supplies PathTable [B, L] (rows into W, leaf->root, -1 padding) and
+    PathCode [B, L] (target bits) — the same fixed-shape masked program,
+    just with table-driven indices instead of the SimpleCode bit math."""
     x = unwrap(ins['X'][0])
-    label = unwrap(ins['Label'][0]).astype(jnp.int32).reshape(-1)
-    w = ins['W'][0]            # [C-1, D]
+    w = ins['W'][0]            # [C-1, D] (default) / [non-leaf, D] (custom)
     b = ins['Bias'][0] if ins.get('Bias') and ins['Bias'][0] is not None \
         else None
-    C = int(ctx.attr('num_classes'))
-    Lmax = int(np.floor(np.log2(2 * C - 1)))
-    code = label + C                                   # [B]
-    j = jnp.arange(Lmax, dtype=jnp.int32)              # [Lmax]
-    idx = (code[:, None] >> (j[None, :] + 1)) - 1      # [B, Lmax]
-    bit = ((code[:, None] >> j[None, :]) & 1).astype(x.dtype)
-    length = 31 - jax.lax.clz(code)                    # floor(log2(code))
-    mask = (j[None, :] < length[:, None]).astype(x.dtype)
-    idx = jnp.clip(idx, 0, w.shape[0] - 1)
+    pt = ins.get('PathTable')
+    if pt and pt[0] is not None:
+        idx_raw = unwrap(pt[0]).astype(jnp.int32)      # [B, L], -1 = pad
+        bit = unwrap(ins['PathCode'][0]).astype(x.dtype)
+        mask = (idx_raw >= 0).astype(x.dtype)
+        idx = jnp.clip(idx_raw, 0, w.shape[0] - 1)
+    else:
+        label = unwrap(ins['Label'][0]).astype(jnp.int32).reshape(-1)
+        C = int(ctx.attr('num_classes'))
+        Lmax = int(np.floor(np.log2(2 * C - 1)))
+        code = label + C                                   # [B]
+        j = jnp.arange(Lmax, dtype=jnp.int32)              # [Lmax]
+        idx = (code[:, None] >> (j[None, :] + 1)) - 1      # [B, Lmax]
+        bit = ((code[:, None] >> j[None, :]) & 1).astype(x.dtype)
+        length = 31 - jax.lax.clz(code)                # floor(log2(code))
+        mask = (j[None, :] < length[:, None]).astype(x.dtype)
+        idx = jnp.clip(idx, 0, w.shape[0] - 1)
     pre = jnp.einsum('bld,bd->bl', w[idx], x)          # [B, Lmax]
     if b is not None:
         pre = pre + b.reshape(-1)[idx]

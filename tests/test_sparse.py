@@ -236,3 +236,105 @@ def test_sharded_embedding_parity():
     base = run(False)
     sharded = run(True)
     np.testing.assert_allclose(base, sharded, rtol=2e-5, atol=2e-5)
+
+
+def test_nce_custom_dist_sampler():
+    """sampler='custom_dist' (ref math/sampler.cc CustomSampler): the
+    CDF-searchsorted draw follows the supplied distribution, and an nce
+    net trains with it."""
+    from paddle_tpu.ops.sparse_ops import _sample_ids
+    import jax
+
+    probs = [0.7, 0.1, 0.1, 0.05, 0.05]
+    ids = np.asarray(_sample_ids(jax.random.key(0), 2, (20000,), 5,
+                                 probs))
+    freq = np.bincount(ids, minlength=5) / 20000.0
+    np.testing.assert_allclose(freq, probs, atol=0.02)
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[8], dtype='float32')
+        y = fluid.layers.data('y', shape=[1], dtype='int64')
+        emb = fluid.layers.fc(x, size=16)
+        cost = fluid.layers.nce(input=emb, label=y, num_total_classes=20,
+                                num_neg_samples=5, sampler='custom_dist',
+                                custom_dist=[1.0 / 20] * 10
+                                + [0.05] * 10)
+        loss = fluid.layers.mean(cost)
+        fluid.optimizer.Adam(5e-2).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {'x': rng.randn(32, 8).astype(np.float32),
+            'y': rng.randint(0, 20, (32, 1)).astype(np.int64)}
+    ls = [float(np.asarray(exe.run(main, feed=feed,
+                                   fetch_list=[loss])[0]).reshape(-1)[0])
+          for _ in range(12)]
+    assert np.isfinite(ls).all()
+    assert ls[-1] < ls[0]
+
+    with pytest.raises(ValueError, match='custom_dist'):
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            x2 = fluid.layers.data('x2', shape=[8], dtype='float32')
+            y2 = fluid.layers.data('y2', shape=[1], dtype='int64')
+            fluid.layers.nce(input=x2, label=y2, num_total_classes=20,
+                             sampler='custom_dist')
+
+
+def test_hsigmoid_custom_tree_matches_default():
+    """A custom tree that encodes the SAME complete binary tree must
+    reproduce default-mode losses exactly (ref CustomCode vs SimpleCode,
+    math/matrix_bit_code.h): path_table rows + path_code bits computed
+    host-side, -1 padding."""
+    C, D, B = 12, 6, 8
+    rng = np.random.RandomState(3)
+    xs = rng.randn(B, D).astype(np.float32)
+    labels = rng.randint(0, C, (B, 1)).astype(np.int64)
+
+    # SimpleCode in numpy: leaf->root node rows + bits, -1 padded
+    Lmax = int(np.floor(np.log2(2 * C - 1)))
+    table = -np.ones((B, Lmax), np.int64)
+    codes = np.zeros((B, Lmax), np.int64)
+    for i, c in enumerate(labels[:, 0]):
+        code = int(c) + C
+        length = int(np.floor(np.log2(code)))
+        for j in range(length):
+            table[i, j] = (code >> (j + 1)) - 1
+            codes[i, j] = (code >> j) & 1
+
+    def run(custom):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 7
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data('x', shape=[D], dtype='float32')
+            y = fluid.layers.data('y', shape=[1], dtype='int64')
+            feed = {'x': xs, 'y': labels}
+            if custom:
+                pt = fluid.layers.data('pt', shape=[Lmax], dtype='int64')
+                pc = fluid.layers.data('pc', shape=[Lmax], dtype='int64')
+                out = fluid.layers.hsigmoid(
+                    input=x, label=y, num_classes=C - 1,  # non-leaf count
+                    path_table=pt, path_code=pc, is_custom=True)
+                feed['pt'], feed['pc'] = table, codes
+            else:
+                out = fluid.layers.hsigmoid(input=x, label=y,
+                                            num_classes=C)
+            loss = fluid.layers.mean(out)
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        scope = fluid.core.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            # identical weights for both modes
+            for p in main.global_block().all_parameters():
+                shape = tuple(p.shape)
+                wr = np.random.RandomState(hash(shape) % 1000)
+                scope.set(p.name, wr.randn(*shape).astype(np.float32)
+                          * 0.1)
+            return [float(np.asarray(
+                exe.run(main, feed=feed, fetch_list=[loss])[0])
+                .reshape(-1)[0]) for _ in range(4)]
+
+    np.testing.assert_allclose(run(False), run(True), rtol=1e-5,
+                               atol=1e-6)
