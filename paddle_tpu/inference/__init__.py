@@ -9,7 +9,11 @@
   vice versa.
 - export/serve: the non-Python deploy path (ref inference/api/paddle_api.h
   C++ API): export.py AOT-compiles the program to a `jax.export` artifact
-  with params baked in; serve.py loads and runs it without the tracer.
+  with params baked in (optionally several batch-size buckets per dir);
+  serve.py loads and runs it without the tracer.
+- batching: BatchingPredictor — dynamic request coalescing over the
+  compiled artifacts (multi-bucket selection, async double-buffered
+  dispatch, serving metrics through profiler).
 The reference's analysis/TensorRT/MKLDNN pass zoo is subsumed by XLA:
 clone(for_test) freezes BN/dropout, XLA does the fusion.
 """
@@ -20,10 +24,12 @@ from .ref_format import (load_reference_inference_model,
 from .export import export_compiled, export_train_step
 from .serve import (CompiledPredictor, load_compiled,
                     CompiledTrainer, load_trainer)
+from .batching import BatchingPredictor, ServingStats, load_batching
 
 __all__ = ['Config', 'Predictor', 'create_predictor',
            'load_reference_inference_model',
            'save_reference_inference_model',
            'load_reference_persistables',
            'export_compiled', 'CompiledPredictor', 'load_compiled',
-           'export_train_step', 'CompiledTrainer', 'load_trainer']
+           'export_train_step', 'CompiledTrainer', 'load_trainer',
+           'BatchingPredictor', 'ServingStats', 'load_batching']

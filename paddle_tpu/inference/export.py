@@ -15,20 +15,26 @@ Artifact layout (out_dir/):
   signature.json     {"feeds": [{name, shape, dtype}...], "fetches": [...]}
 
 Shapes are fixed at export (XLA compiles static shapes); export one artifact
-per served batch shape, as with any AOT deployment.
+per served batch shape, as with any AOT deployment. With
+`batch_sizes=[1, 8, 32, ...]` ONE artifact dir carries several compiled
+batch buckets (dense feeds only): each bucket is a complete standard
+artifact under bucket_<n>/, and the top level mirrors the LARGEST bucket
+plus a "buckets" signature key — so CompiledPredictor(out_dir) keeps
+working unchanged while batching.BatchingPredictor picks the smallest
+bucket that fits each coalesced batch.
 """
 from __future__ import annotations
 
 import json
 import os
+import shutil
 
 import numpy as np
 
-_SIGNATURE = 'signature.json'
-_MODULE = 'module.jaxexport'
-_TRAIN_SIGNATURE = 'train_signature.json'
-_TRAIN_MODULE = 'train_module.jaxexport'
-_TRAIN_STATE0 = 'train_state0.npz'
+# the artifact layout contract lives in serve.py (the loader); export
+# writes exactly what serve reads
+from .serve import (_SIGNATURE, _MODULE, _BUCKET_DIR, _TRAIN_SIGNATURE,
+                    _TRAIN_MODULE, _TRAIN_STATE0)
 
 
 def _normalize_lod_sample(name, value, lod_level):
@@ -58,7 +64,7 @@ def _normalize_lod_sample(name, value, lod_level):
     return data, [o.astype(np.int32).reshape(-1) for o in offs]
 
 
-def export_compiled(predictor, sample_inputs, out_dir):
+def export_compiled(predictor, sample_inputs, out_dir, batch_sizes=None):
     """Export `predictor`'s program as a tracer-free compiled artifact.
 
     sample_inputs: list (feed order) or dict of arrays fixing shapes and
@@ -71,8 +77,76 @@ def export_compiled(predictor, sample_inputs, out_dir):
     signature.json (the reference's PaddleTensor.lod contract,
     inference/api/paddle_api.h:1).
 
+    batch_sizes: optional list of batch buckets (e.g. [1, 8, 32, 128]) for
+    a MULTI-BUCKET artifact (dense feeds only): the program is exported
+    once per bucket into out_dir/bucket_<n>/, the top level mirrors the
+    largest bucket (backward-compatible with CompiledPredictor), and the
+    top signature records the bucket list for batching.BatchingPredictor.
+
     Returns out_dir. Load with inference/serve.py (no framework imports).
     """
+    program = predictor._program
+    feed_names = list(predictor._feed_names)
+    if isinstance(sample_inputs, (list, tuple)):
+        sample = dict(zip(feed_names, sample_inputs))
+    else:
+        sample = dict(sample_inputs)
+    missing = [n for n in feed_names if n not in sample]
+    if missing:
+        raise ValueError("sample_inputs missing feeds: %r" % missing)
+    if batch_sizes is None:
+        return _export_single(predictor, sample, out_dir)
+
+    sizes = sorted({int(b) for b in batch_sizes})
+    if not sizes or sizes[0] < 1:
+        raise ValueError("batch_sizes must be positive ints, got %r"
+                         % (batch_sizes,))
+    for name in feed_names:
+        v = program.global_block().var(name)
+        if int(getattr(v, 'lod_level', 0) or 0):
+            raise ValueError(
+                "multi-bucket export serves dense feeds only; feed %r "
+                "carries lod — export one artifact per lod bucket "
+                "instead (the Executor's bucket_rows discipline)" % name)
+    arrs = {n: np.asarray(sample[n]) for n in feed_names}
+    flat = [n for n, a in arrs.items() if a.ndim < 1]
+    if flat:
+        raise ValueError("feeds %r have no batch dimension to bucket on"
+                         % flat)
+    lead = {a.shape[0] for a in arrs.values()}
+    if len(lead) != 1:
+        raise ValueError(
+            "multi-bucket export needs one uniform leading batch dim; "
+            "sample feeds disagree: %s" % sorted(lead))
+    os.makedirs(out_dir, exist_ok=True)
+    for b in sizes:
+        # np.resize tiles the sample rows up/down to the bucket — only
+        # shapes and dtypes matter for the export trace
+        resized = {n: np.resize(a, (b,) + a.shape[1:])
+                   for n, a in arrs.items()}
+        _export_single(predictor, resized,
+                       os.path.join(out_dir, _BUCKET_DIR % b))
+    # top level mirrors the LARGEST bucket so CompiledPredictor(out_dir)
+    # keeps working unchanged on a multi-bucket dir
+    top = os.path.join(out_dir, _BUCKET_DIR % sizes[-1])
+    top_module = os.path.join(out_dir, _MODULE)
+    if os.path.exists(top_module):
+        os.remove(top_module)
+    try:  # params are baked in: the module can be ~100MB — link, not copy
+        os.link(os.path.join(top, _MODULE), top_module)
+    except OSError:  # cross-device or no-hardlink filesystem
+        shutil.copyfile(os.path.join(top, _MODULE), top_module)
+    with open(os.path.join(top, _SIGNATURE)) as f:
+        sig = json.load(f)
+    sig['buckets'] = sizes
+    with open(os.path.join(out_dir, _SIGNATURE), 'w') as f:
+        json.dump(sig, f, indent=1)
+    return out_dir
+
+
+def _export_single(predictor, sample, out_dir):
+    """One fixed-shape export (the original export_compiled body);
+    `sample` is a {feed name: value} dict covering every feed."""
     import jax
     from jax import export as jexport
     from ..core.lowering import Tracer
@@ -81,13 +155,6 @@ def export_compiled(predictor, sample_inputs, out_dir):
     program = predictor._program
     feed_names = list(predictor._feed_names)
     fetch_names = [v.name for v in predictor._fetch_vars]
-    if isinstance(sample_inputs, (list, tuple)):
-        sample = dict(zip(feed_names, sample_inputs))
-    else:
-        sample = dict(sample_inputs)
-    missing = [n for n in feed_names if n not in sample]
-    if missing:
-        raise ValueError("sample_inputs missing feeds: %r" % missing)
 
     # flat calling convention: per feed, data then one int32 offsets array
     # per lod level (traced mode — offsets are runtime data)
@@ -135,22 +202,28 @@ def export_compiled(predictor, sample_inputs, out_dir):
         tracer.run_block(program.global_block())
         return tuple(tracer.env[n] for n in fetch_names)
 
-    # the export trace below records which fetches are LoD and with how
-    # many levels — the output flattening must be plain arrays (the
-    # serving process has no LoDArray class to unflatten into)
+    # the export trace below records which fetches are LoD, with how many
+    # levels, and their shapes (serve.py uses fetch shapes to pre-flag
+    # row-count-dependent fetches when padding partial dense batches) —
+    # the output flattening must be plain arrays (the serving process has
+    # no LoDArray class to unflatten into)
     fetch_levels = []
+    fetch_shapes = []
 
     def fn(*flat):
         outs = run_env(*flat)
         del fetch_levels[:]
+        del fetch_shapes[:]
         flat_out = []
         for o in outs:
             if isinstance(o, LoDArray):
                 fetch_levels.append(o.nlevels)
+                fetch_shapes.append(list(o.data.shape))
                 flat_out.append(o.data)
                 flat_out.extend(o.off_t(i) for i in range(o.nlevels))
             else:
                 fetch_levels.append(0)
+                fetch_shapes.append(list(np.shape(o)))
                 flat_out.append(o)
         return tuple(flat_out)
 
@@ -162,9 +235,10 @@ def export_compiled(predictor, sample_inputs, out_dir):
     os.makedirs(out_dir, exist_ok=True)
     with open(os.path.join(out_dir, _MODULE), 'wb') as f:
         f.write(exp.serialize())
-    fetch_sig = [{'name': n, 'lod_levels': ll}
-                 for n, ll in zip(fetch_names, fetch_levels)]
-    sig = {'version': 2, 'feeds': feed_sig, 'fetches': fetch_sig}
+    fetch_sig = [{'name': n, 'lod_levels': ll, 'shape': shp}
+                 for n, ll, shp in zip(fetch_names, fetch_levels,
+                                       fetch_shapes)]
+    sig = {'version': 3, 'feeds': feed_sig, 'fetches': fetch_sig}
     with open(os.path.join(out_dir, _SIGNATURE), 'w') as f:
         json.dump(sig, f, indent=1)
     return out_dir

@@ -15,11 +15,13 @@ framework modules never load).
 import json
 import os
 import sys
+import time
 
 import numpy as np
 
 _SIGNATURE = 'signature.json'
 _MODULE = 'module.jaxexport'
+_BUCKET_DIR = 'bucket_%05d'  # per-bucket subdir of a multi-bucket artifact
 _TRAIN_SIGNATURE = 'train_signature.json'
 _TRAIN_MODULE = 'train_module.jaxexport'
 _TRAIN_STATE0 = 'train_state0.npz'
@@ -45,11 +47,18 @@ def _split_lod_value(name, value, levels):
         % (name, levels))
 
 
-def _build_args(sig_feeds, feed_names, inputs):
+def _build_args(sig_feeds, feed_names, inputs, allow_pad=False):
     """Normalize list-or-dict inputs against the artifact signature:
     feed-order list, dtype cast, fixed-shape check; LoD feeds contribute
     their data plus one int32 offsets array per level. Shared by
-    CompiledPredictor.run and CompiledTrainer.step."""
+    CompiledPredictor.run and CompiledTrainer.step.
+
+    With allow_pad, a PARTIAL dense batch — every dense feed arriving with
+    the same rows r below the artifact's (uniform) leading batch dim B —
+    is zero-padded up to B, the dense analog of the LoD bucket_rows
+    padding below. Returns (args, pad) where pad is None or (rows, B) so
+    the caller can slice batch-led fetches back to r (and error loudly on
+    row-count-dependent fetches)."""
     if isinstance(inputs, (list, tuple)):
         if len(inputs) != len(feed_names):
             raise ValueError("artifact expects %d inputs (%s), got %d"
@@ -61,6 +70,22 @@ def _build_args(sig_feeds, feed_names, inputs):
     if missing:
         raise ValueError("missing feeds: %r (artifact expects %s)"
                          % (missing, feed_names))
+    pad = None
+    dense_arrs = {}
+    if allow_pad:
+        dense = [(e, np.asarray(feed[e['name']],
+                                dtype=np.dtype(e['dtype'])))
+                 for e in sig_feeds if not int(e.get('lod_levels', 0))]
+        dense_arrs = {e['name']: a for e, a in dense}
+        if dense and all(
+                e['shape'] and a.ndim == len(e['shape'])
+                and list(a.shape[1:]) == e['shape'][1:] for e, a in dense):
+            expect = {int(e['shape'][0]) for e, _ in dense}
+            got = {int(a.shape[0]) for _, a in dense}
+            if len(expect) == 1 and len(got) == 1:
+                bucket, rows = expect.pop(), got.pop()
+                if 0 < rows < bucket:
+                    pad = (rows, bucket)
     args = []
     for e in sig_feeds:
         levels = int(e.get('lod_levels', 0))
@@ -77,9 +102,9 @@ def _build_args(sig_feeds, feed_names, inputs):
                     and list(data.shape[1:]) == e['shape'][1:]:
                 # pad up to the bucket capacity (the executor's
                 # bucket_rows discipline, core/lod.py create_lod_array)
-                pad = np.zeros((bucket_rows - rows,) + data.shape[1:],
-                               data.dtype)
-                data = np.concatenate([data, pad], axis=0)
+                fill = np.zeros((bucket_rows - rows,) + data.shape[1:],
+                                data.dtype)
+                data = np.concatenate([data, fill], axis=0)
             if list(data.shape) != e['shape']:
                 raise ValueError(
                     "feed %r: expected bucket shape %s, got %s"
@@ -94,14 +119,20 @@ def _build_args(sig_feeds, feed_names, inputs):
                         % (e['name'], i, want, want - 1, o.shape[0]))
                 args.append(o)
             continue
-        arr = np.asarray(value, dtype=np.dtype(e['dtype']))
+        arr = dense_arrs.get(e['name'])
+        if arr is None:
+            arr = np.asarray(value, dtype=np.dtype(e['dtype']))
+        if pad is not None and arr.shape[0] == pad[0]:
+            arr = np.concatenate(
+                [arr, np.zeros((pad[1] - pad[0],) + arr.shape[1:],
+                               arr.dtype)], axis=0)
         if list(arr.shape) != e['shape']:
             raise ValueError(
                 "feed %r: expected shape %s (artifacts are compiled for "
                 "fixed shapes), got %s"
                 % (e['name'], e['shape'], list(arr.shape)))
         args.append(arr)
-    return args
+    return args, pad
 
 
 def _fetch_entries(sig):
@@ -152,18 +183,62 @@ class CompiledPredictor(object):
     def get_output_names(self):
         return [e['name'] for e in _fetch_entries(self._sig)]
 
-    def run(self, inputs):
-        """inputs: list (feed order) or dict name -> array; LoD feeds as
-        (values, offsets) pairs. Returns a list with a numpy array per
-        dense fetch and a (values, [offsets...]) pair per LoD fetch."""
-        args = _build_args(self._sig['feeds'], self._feed_names, inputs)
+    def _call_flat(self, args):
+        """Dispatch the exported module on the pinned device; returns the
+        FLAT device outputs without a host sync (async serving loops —
+        e.g. batching.BatchingPredictor — sync once at delivery)."""
         if self._device is not None:
             import jax
             with jax.default_device(self._device):
-                outs = self._exported.call(*args)
-        else:
-            outs = self._exported.call(*args)
-        return _structure_outputs(self._sig, outs)
+                return self._exported.call(*args)
+        return self._exported.call(*args)
+
+    def run(self, inputs, pad_partial=True):
+        """inputs: list (feed order) or dict name -> array; LoD feeds as
+        (values, offsets) pairs. Returns a list with a numpy array per
+        dense fetch and a (values, [offsets...]) pair per LoD fetch.
+
+        A PARTIAL dense batch (every dense feed with the same rows r below
+        the compiled batch dim B) is zero-padded up to B and batch-led
+        fetches are sliced back to r; fetches whose leading dim is NOT the
+        batch (e.g. a batch reduction — their value depends on the padded
+        row count) error loudly, flagged ahead of dispatch when the
+        signature records fetch shapes (v3 exports) and at delivery
+        otherwise. Caveat: a shape-preserving CROSS-ROW op (rows coupled
+        but the fetch stays batch-led, e.g. x - mean(x, axis=0)) is
+        undetectable from shapes — such programs would fold the zero rows
+        into every result; pass pad_partial=False to restore the strict
+        fixed-shape rejection."""
+        args, pad = _build_args(self._sig['feeds'], self._feed_names,
+                                inputs, allow_pad=pad_partial)
+        if pad is not None:
+            for e in _fetch_entries(self._sig):
+                shape = e.get('shape')
+                if int(e.get('lod_levels', 0)) or (
+                        shape is not None
+                        and (not shape or int(shape[0]) != pad[1])):
+                    raise ValueError(
+                        "feed rows were padded %d->%d but fetch %r (shape "
+                        "%s in the signature) is not batch-aligned — its "
+                        "value would depend on the padded rows; run with "
+                        "the exact compiled batch" % (pad + (e['name'],
+                                                             shape)))
+        outs = _structure_outputs(self._sig, self._call_flat(args))
+        if pad is None:
+            return outs
+        rows, bucket = pad
+        sliced = []
+        for e, o in zip(_fetch_entries(self._sig), outs):
+            if isinstance(o, tuple) or o.ndim < 1 or o.shape[0] != bucket:
+                raise ValueError(
+                    "feed rows were padded %d->%d but fetch %r has shape "
+                    "%s — not batch-aligned, its value depends on the "
+                    "padded row count (e.g. a batch reduction); run with "
+                    "the exact compiled batch"
+                    % (rows, bucket, e['name'],
+                       'lod' if isinstance(o, tuple) else list(o.shape)))
+            sliced.append(o[:rows])
+        return sliced
 
 
 def load_compiled(artifact_dir):
@@ -221,8 +296,10 @@ class CompiledTrainer(object):
 
     def step(self, inputs):
         """Run one train step. inputs: list (feed order) or dict.
-        Advances the carried state and rng; returns numpy fetches."""
-        args = _build_args(self._sig['feeds'], self._feed_names, inputs)
+        Advances the carried state and rng; returns numpy fetches.
+        Strict shapes: a train step never pads (padded rows would corrupt
+        the loss and every batch statistic)."""
+        args, _ = _build_args(self._sig['feeds'], self._feed_names, inputs)
 
         def call():
             return self._exported.call(self._state, args, self._rng())
@@ -261,7 +338,70 @@ def load_trainer(artifact_dir, platform=None, seed=None):
     return CompiledTrainer(artifact_dir, platform=platform, seed=seed)
 
 
+def _bench_cli(argv):
+    # serve.py bench ARTIFACT_DIR IN.npz N_REQUESTS [TIMEOUT_MS]
+    # replays IN.npz N times through the dynamic batcher and prints
+    # throughput + latency percentiles, with a sequential
+    # one-request-per-run reference — serving perf measurable without the
+    # full bench.py harness.
+    if len(argv) not in (5, 6):
+        print("usage: serve.py bench ARTIFACT_DIR IN.npz N_REQUESTS "
+              "[TIMEOUT_MS]", file=sys.stderr)
+        return 2
+    artifact_dir, in_path, n = argv[2], argv[3], int(argv[4])
+    timeout_ms = float(argv[5]) if len(argv) == 6 else 5.0
+    try:
+        from . import batching
+    except ImportError:  # run by file path: batching.py sits alongside
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import batching
+    with np.load(in_path) as z:
+        feed = {k: z[k] for k in z.files}
+    rows = int(next(iter(feed.values())).shape[0])
+
+    batcher = batching.BatchingPredictor(artifact_dir,
+                                         batch_timeout_ms=timeout_ms)
+    batcher.warmup()
+    # sequential reference: the old serving path, one run() per request
+    # (pads each request up to the compiled batch)
+    seq = CompiledPredictor(artifact_dir)
+    k = min(n, 8)
+    seq.run(feed)  # warm
+    t0 = time.perf_counter()
+    for _ in range(k):
+        seq.run(feed)
+    seq_req_s = k / (time.perf_counter() - t0)
+
+    t0 = time.perf_counter()
+    futs = [batcher.submit(feed) for _ in range(n)]
+    for f in futs:
+        f.result()
+    wall = time.perf_counter() - t0
+    snap = batcher.stats.snapshot()
+    batcher.close()
+    req_s = n / wall
+    print("buckets=%s requests=%d rows/request=%d" %
+          (batcher.buckets, n, rows))
+    print("batched:    %10.1f req/s  %10.1f rows/s  (%d batches, "
+          "occupancy %.2f)" % (req_s, req_s * rows, snap['batches'],
+                               snap['occupancy']))
+    print("sequential: %10.1f req/s  %10.1f rows/s  (CompiledPredictor."
+          "run per request)" % (seq_req_s, seq_req_s * rows))
+    print("latency ms: p50=%.2f p95=%.2f p99=%.2f" %
+          (snap['p50_ms'], snap['p95_ms'], snap['p99_ms']))
+    print(json.dumps({'req_s': round(req_s, 2),
+                      'rows_s': round(req_s * rows, 2),
+                      'seq_req_s': round(seq_req_s, 2),
+                      'speedup': round(req_s / seq_req_s, 2),
+                      'occupancy': snap['occupancy'],
+                      'p50_ms': snap['p50_ms'], 'p95_ms': snap['p95_ms'],
+                      'p99_ms': snap['p99_ms']}))
+    return 0
+
+
 def main(argv):
+    if len(argv) >= 2 and argv[1] == 'bench':
+        return _bench_cli(argv)
     if len(argv) >= 2 and argv[1] == 'train':
         # serve.py train ARTIFACT_DIR FEEDS.npz OUT.npz STEPS [CKPT.npz]
         # runs STEPS train steps on the (fixed) feeds; OUT.npz holds each
@@ -284,7 +424,9 @@ def main(argv):
     if len(argv) != 4:
         print("usage: serve.py ARTIFACT_DIR IN.npz OUT.npz\n"
               "       serve.py train ARTIFACT_DIR FEEDS.npz OUT.npz STEPS "
-              "[CKPT.npz]", file=sys.stderr)
+              "[CKPT.npz]\n"
+              "       serve.py bench ARTIFACT_DIR IN.npz N_REQUESTS "
+              "[TIMEOUT_MS]", file=sys.stderr)
         return 2
     artifact_dir, in_path, out_path = argv[1:]
     pred = CompiledPredictor(artifact_dir)

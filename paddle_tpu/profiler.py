@@ -51,6 +51,8 @@ def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
     jax.profiler.stop_trace()
     _active = False
     _print_report(sorted_key)
+    if _serving_sources:
+        serving_report()
     print("[paddle_tpu.profiler] device trace written to %s "
           "(open with TensorBoard / Perfetto); host events: "
           "export_chrome_tracing(path)" % _trace_dir)
@@ -94,6 +96,50 @@ def export_chrome_tracing(path):
 def reset_profiler():
     global _events
     _events = []
+
+
+# -- serving metrics ---------------------------------------------------------
+# Dynamic-batching predictors (inference/batching.py) register a zero-arg
+# snapshot callable here; serving_report() renders the queue depth, batch
+# occupancy, and request-latency percentiles per live source, and
+# stop_profiler appends the same table to the host-event report.
+_serving_sources = {}
+
+
+def register_serving_source(name, snapshot):
+    """Register a serving-metrics source: `snapshot()` -> dict with
+    queue_depth, requests, batches, occupancy, p50/p95/p99_ms (the
+    contract of batching.ServingStats.snapshot)."""
+    _serving_sources[name] = snapshot
+
+
+def unregister_serving_source(name):
+    _serving_sources.pop(name, None)
+
+
+def serving_report():
+    """Print serving metrics for every registered source and return them
+    as {source name: snapshot dict}."""
+    out = {}
+    rows = []
+    for name in sorted(_serving_sources):
+        try:
+            snap = _serving_sources[name]()
+        except Exception:
+            continue  # a closing batcher must not break the report
+        out[name] = snap
+        rows.append((name, snap))
+    if rows:
+        print("%-32s %6s %8s %8s %5s %9s %9s %9s" %
+              ('Serving source', 'queue', 'requests', 'batches', 'occ',
+               'p50(ms)', 'p95(ms)', 'p99(ms)'))
+        for name, s in rows:
+            print("%-32s %6d %8d %8d %5.2f %9.2f %9.2f %9.2f" %
+                  (name[:32], s.get('queue_depth', 0),
+                   s.get('requests', 0), s.get('batches', 0),
+                   s.get('occupancy', 0.0), s.get('p50_ms', 0.0),
+                   s.get('p95_ms', 0.0), s.get('p99_ms', 0.0)))
+    return out
 
 
 @contextlib.contextmanager

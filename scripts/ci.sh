@@ -11,12 +11,18 @@ make -C paddle_tpu/native
 echo "== api surface =="
 python tools/print_signatures.py --check API.spec
 
-echo "== tests (8-device virtual cpu mesh) =="
-python -m pytest tests/ -q
+echo "== tests (8-device virtual cpu mesh, tier-1: not slow) =="
+python -m pytest tests/ -q -m 'not slow'
+
+echo "== slow tier (threaded stress, Poisson serving scenario) =="
+python -m pytest tests/ -q -m slow
 
 echo "== bench smoke (tiny config) =="
 PTPU_BENCH_ONLY=resnet PTPU_BENCH_BATCH=16 PTPU_BENCH_STEPS=3 \
 PTPU_PLATFORM=cpu python bench.py
+
+echo "== serving bench smoke (serve.py bench on a tiny artifact) =="
+python scripts/serve_bench_smoke.py
 
 echo "== tpu smoke tier (when a real chip is visible) =="
 if env -u JAX_PLATFORMS -u PTPU_PLATFORM -u XLA_FLAGS python - <<'EOF'

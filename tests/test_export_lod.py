@@ -73,6 +73,26 @@ def test_lod_feed_export_two_buckets(tmp_path):
                                    rtol=1e-5, atol=1e-6)
 
 
+def test_lod_feed_partial_bucket_pads_in_serve(tmp_path):
+    """A LoD feed arriving BELOW the bucket capacity is padded up by
+    serve.py itself (the executor's bucket_rows discipline) — the values
+    array does not need host-side pre-padding. Regression: the dense
+    partial-batch pad detection must not clobber this path."""
+    model_dir = str(tmp_path / 'model')
+    _build_text_model(model_dir)
+    cfg = Config(model_dir)
+    cfg.disable_gpu()
+    pred = create_predictor(cfg)
+    bucket_rows, lens = 12, [3, 5, 2]
+    lt, (padded, offs) = _ids_batch(lens, bucket_rows, seed=3)
+    want, = pred.run([lt])
+    art = str(tmp_path / 'artifact')
+    export_compiled(pred, {'ids': (padded, offs)}, art)
+    served = load_compiled(art)
+    got, = served.run({'ids': (padded[:sum(lens)], offs)})  # 10 < 12 rows
+    np.testing.assert_allclose(got[:len(lens)], want, rtol=1e-5, atol=1e-6)
+
+
 # ---------------------------------------------------------------------------
 # LoD FETCHES: CRNN serves tracer-free (north star #4)
 # ---------------------------------------------------------------------------
