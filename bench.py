@@ -152,6 +152,29 @@ def _device():
     return exe, dev
 
 
+def _timed_multi_steps(exe, program, feed, loss, dispatches, k, warmup=2):
+    """Warmup + `dispatches` timed run_steps dispatches (K steps each,
+    'final' fetch thinning), one host sync at the end — the multi-step
+    counterpart of _timed_steps. Returns elapsed seconds."""
+    for _ in range(warmup):
+        out = exe.run_steps(program=program, feed=feed, fetch_list=[loss],
+                            steps=k, return_numpy=False)
+    np.asarray(out[0])  # block on compile + warmup
+    t0 = time.perf_counter()
+    for _ in range(dispatches):
+        out = exe.run_steps(program=program, feed=feed, fetch_list=[loss],
+                            steps=k, return_numpy=False)
+    _ = float(np.asarray(out[0]).reshape(-1)[0])  # sync
+    return time.perf_counter() - t0
+
+
+def _stack_k(feed, k):
+    """Tile a single-step device feed into a [K, ...] stacked group (the
+    shapes are what is benched; contents repeat)."""
+    import jax.numpy as jnp
+    return {n: jnp.stack([v] * k) for n, v in feed.items()}
+
+
 def _bench_image_train(metric, build, batch, steps, flops_per_img,
                        baseline_img_s, baseline, use_bf16=True, warmup=4,
                        class_dim=1000):
@@ -633,6 +656,151 @@ def bench_stacked_lstm():
                           '(benchmark/README.md:119), scaled by batch/64')
 
 
+def bench_smallnet_multistep():
+    """SmallNet with K steps per dispatch (ISSUE 2 headline scenario):
+    the smallnet step carries <1 ms of compute against a per-dispatch
+    floor (~22 ms through the axon tunnel, PERF_NOTES r5), so ms/batch is
+    dispatch-bound and run_steps(K) divides the floor by K. Same-session
+    A/B: the single-step path is measured first and reported alongside.
+    CPU caveat (PERF_NOTES round 6): XLA:CPU runs CONV bodies inside
+    lax.scan ~10x slower than at top level, so this metric is only
+    meaningful on the accelerator; the CPU dispatch-overhead proxy is
+    scripts/multi_step_smoke.py's fc model."""
+    import paddle_tpu as fluid
+    from models.smallnet import build_train_net
+
+    batch = int(os.environ.get('PTPU_BENCH_SMALLNET_BATCH', '256'))
+    k = int(os.environ.get('PTPU_BENCH_SMALLNET_K', '16'))
+    dispatches = int(os.environ.get('PTPU_BENCH_SMALLNET_DISPATCHES', '8'))
+
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup_p):
+        images, label, loss, acc = build_train_net()
+    fluid.contrib.mixed_precision.enable_bf16(main_p)
+
+    exe, dev = _device()
+    exe.run(startup_p)
+    import jax
+    import jax.numpy as jnp
+    feed = {'data': jax.device_put(jnp.asarray(
+                np.random.randn(batch, 3, 32, 32), jnp.float32), dev),
+            'label': jax.device_put(jnp.asarray(
+                np.random.randint(0, 10, (batch, 1)), jnp.int32), dev)}
+
+    dt1 = _timed_steps(exe, main_p, feed, loss, 30, warmup=4)
+    single_ms = dt1 / 30 * 1000.0
+    dt = _timed_multi_steps(exe, main_p, _stack_k(feed, k), loss,
+                            dispatches, k)
+    ms_batch = dt / (dispatches * k) * 1000.0
+    base_ms = 33.113 * batch / 256.0
+    return _line('smallnet_cifar_multistep_ms_batch', ms_batch, 'ms/batch',
+                 base_ms / ms_batch, dtype='bf16', batch=batch,
+                 steps_per_dispatch=k,
+                 single_step_ms_batch=round(single_ms, 2),
+                 speedup_vs_single=round(single_ms / ms_batch, 2),
+                 baseline='33.113 ms/batch at batch 256 on K40m '
+                          '(benchmark/README.md:58), scaled by batch/256; '
+                          'single-step path A/B measured same-session')
+
+
+def bench_stacked_lstm_multistep():
+    """Stacked-LSTM with K steps per dispatch — the second dispatch-bound
+    training metric (25.8 ms/batch single-step through the tunnel, r5).
+    Matmul-dominated, so unlike smallnet the CPU scan body is not
+    penalized and the A/B is meaningful on both platforms."""
+    import paddle_tpu as fluid
+    from models.stacked_lstm import build_stacked_lstm_train
+
+    batch = int(os.environ.get('PTPU_BENCH_LSTM_BATCH', '64'))
+    k = int(os.environ.get('PTPU_BENCH_LSTM_K', '8'))
+    dispatches = int(os.environ.get('PTPU_BENCH_LSTM_DISPATCHES', '6'))
+
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup_p):
+        ids, label, loss, flops_per_batch = build_stacked_lstm_train(batch)
+    fluid.contrib.mixed_precision.enable_bf16(main_p)
+
+    exe, dev = _device()
+    exe.run(startup_p)
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    feed = {'ids': jax.device_put(jnp.asarray(
+                rng.randint(1, 30000, (batch, 100)).astype(np.int32)), dev),
+            'label': jax.device_put(jnp.asarray(
+                rng.randint(0, 2, (batch, 1)).astype(np.int32)), dev)}
+
+    dt1 = _timed_steps(exe, main_p, feed, loss, 20, warmup=3)
+    single_ms = dt1 / 20 * 1000.0
+    dt = _timed_multi_steps(exe, main_p, _stack_k(feed, k), loss,
+                            dispatches, k)
+    ms_batch = dt / (dispatches * k) * 1000.0
+    base_ms = 83.0 * batch / 64.0
+    return _line('stacked_lstm_multistep_ms_batch', ms_batch, 'ms/batch',
+                 base_ms / ms_batch, dtype='bf16', batch=batch,
+                 steps_per_dispatch=k,
+                 single_step_ms_batch=round(single_ms, 2),
+                 speedup_vs_single=round(single_ms / ms_batch, 2),
+                 baseline='83 ms/batch at batch 64 on K40m '
+                          '(benchmark/README.md:119), scaled by batch/64; '
+                          'single-step path A/B measured same-session')
+
+
+def bench_ocr_multistep():
+    """CRNN+CTC OCR with K steps per dispatch: the LoD-label path through
+    run_steps (labels stack in STATIC-lod form — CRNN's decode ops need
+    host offsets, so every step in a group shares one lod pattern). OCR
+    steps are ~25 ms through the tunnel and swing 2-4x with session
+    health (r5 note), so the same-session single-step A/B is the only
+    meaningful comparison."""
+    import paddle_tpu as fluid
+    from models.crnn import build_crnn_train
+
+    batch = int(os.environ.get('PTPU_BENCH_OCR_BATCH', '64'))
+    k = int(os.environ.get('PTPU_BENCH_OCR_K', '8'))
+    dispatches = int(os.environ.get('PTPU_BENCH_OCR_DISPATCHES', '6'))
+
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup_p):
+        images, label, avg_cost, decoded, edit = build_crnn_train(
+            num_classes=95, img_h=32, img_w=96, rnn_hidden=96)
+    fluid.contrib.mixed_precision.enable_bf16(main_p)
+
+    exe, dev = _device()
+    exe.run(startup_p)
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    imgs = jax.device_put(jnp.asarray(
+        rng.randn(batch, 1, 32, 96), jnp.float32), dev)
+    lens = rng.randint(3, 12, batch)
+    toks = rng.randint(0, 95, int(lens.sum())).astype(np.int32)
+    lbl = fluid.create_lod_tensor(toks.reshape(-1, 1), [list(lens)])
+    feed = {'pixel': imgs, 'label': lbl}
+
+    dt1 = _timed_steps(exe, main_p, feed, avg_cost, 20, warmup=3)
+    single_ms = dt1 / 20 * 1000.0
+    # LoD labels cannot pre-stack into one array: run_steps stacks the K
+    # per-step LoDTensors. CRNN's block contains host-lod ops
+    # (ctc_greedy_decoder / edit_distance: output shapes depend on lod
+    # CONTENT), so its groups must share one lod pattern and stack in
+    # STATIC form — varying patterns would route to traced-offset
+    # stacking, which this program cannot trace (same constraint as
+    # single-step run()). The traced-stack path is exercised by
+    # tests/test_multi_step.py's varying-pattern test instead.
+    multi_feed = {'pixel': jnp.stack([imgs] * k), 'label': [lbl] * k}
+    dt = _timed_multi_steps(exe, main_p, multi_feed, avg_cost,
+                            dispatches, k)
+    img_s = batch * dispatches * k / dt
+    single_img_s = batch / (single_ms / 1000.0)
+    return _line('ocr_crnn_multistep_img_s_per_chip', img_s, 'img/s',
+                 1.0, dtype='bf16', batch=batch, steps_per_dispatch=k,
+                 single_step_img_s=round(single_img_s, 2),
+                 speedup_vs_single=round(img_s / single_img_s, 2),
+                 baseline='self (reference commits no OCR number); '
+                          'single-step path A/B measured same-session')
+
+
 def bench_ctr():
     import paddle_tpu as fluid
     from models.deepfm import build_deepfm_train
@@ -703,6 +871,11 @@ BENCHES = [
     ('googlenet_train_img_s_per_chip', bench_googlenet),
     ('googlenet_infer_img_s_per_chip', bench_googlenet_infer),
     ('smallnet_cifar_ms_batch', bench_smallnet),
+    # multi-step dispatch variants (ISSUE 2): K steps per device program,
+    # same-session single-step A/B in each line
+    ('smallnet_cifar_multistep_ms_batch', bench_smallnet_multistep),
+    ('stacked_lstm_multistep_ms_batch', bench_stacked_lstm_multistep),
+    ('ocr_crnn_multistep_img_s_per_chip', bench_ocr_multistep),
 ]
 
 # PTPU_BENCH_ONLY token -> metric-name prefix; indices derive from BENCHES
@@ -712,8 +885,10 @@ _SHORT_PREFIX = {
     'bert': 'bert', 'ctr': 'ctr', 'ocr': 'ocr', 'vgg': 'vgg',
     'alexnet': 'alexnet', 'infer': 'resnet50_infer',
     'serving': 'resnet50_serving',
-    'lstm': 'stacked_lstm', 'googlenet': 'googlenet_train',
-    'ginfer': 'googlenet_infer', 'smallnet': 'smallnet',
+    'lstm': 'stacked_lstm_text', 'googlenet': 'googlenet_train',
+    'ginfer': 'googlenet_infer', 'smallnet': 'smallnet_cifar_ms',
+    'smallnet_k': 'smallnet_cifar_multistep',
+    'lstm_k': 'stacked_lstm_multistep', 'ocr_k': 'ocr_crnn_multistep',
 }
 _SHORT = {tok: next(i for i, (n, _) in enumerate(BENCHES)
                     if n.startswith(pref))

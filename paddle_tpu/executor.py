@@ -42,6 +42,65 @@ _analysis_cache = {}
 _entropy_seed = None
 
 
+def _np_threefry2x32(k0, k1, c0, c1):
+    """Vectorized numpy Threefry-2x32 — bit-identical to jax's
+    threefry2x32 for the same key/count words (validated against the jax
+    cpu derivation in tests/test_multi_step.py). Used when no cpu backend
+    is registered (JAX_PLATFORMS=tpu), where the host-side key derivation
+    below would otherwise raise (ADVICE r5 item 3)."""
+    rot = ((13, 15, 26, 6), (17, 29, 16, 24))
+    with np.errstate(over='ignore'):
+        ks = (k0, k1, k0 ^ k1 ^ np.uint32(0x1BD11BDA))
+        x0 = c0 + ks[0]
+        x1 = c1 + ks[1]
+        for i in range(5):
+            for r in rot[i % 2]:
+                x0 = x0 + x1
+                x1 = (x1 << np.uint32(r)) | (x1 >> np.uint32(32 - r))
+                x1 = x0 ^ x1
+            x0 = x0 + ks[(i + 1) % 3]
+            x1 = x1 + ks[(i + 2) % 3] + np.uint32(i + 1)
+    return x0, x1
+
+
+def _np_threefry_key_words(seed):
+    """key(seed)'s two uint32 words, mirroring jax's seed
+    canonicalization: with x64 disabled (the default) a python int seed
+    becomes int32, so the upper word is ZERO — keeping `seed >> 32` there
+    would derive a different stream than the jax-present path for seeds
+    >= 2^32 and break the fallback's bit-identity contract."""
+    seed = int(seed)
+    if jax.config.jax_enable_x64:
+        hi = np.uint32((seed >> 32) & 0xFFFFFFFF)
+    else:
+        hi = np.uint32(0)
+    return hi, np.uint32(seed & 0xFFFFFFFF)
+
+
+def _np_threefry_key_group(seed, step0, k):
+    """fold_in(key(seed), step) raw key data for steps [step0, step0+k)
+    with numpy only: fold_in computes threefry2x32(key, [0, step])."""
+    hi, lo = _np_threefry_key_words(seed)
+    k0 = np.full((k,), hi)
+    k1 = np.full((k,), lo)
+    steps = np.arange(step0, step0 + k, dtype=np.uint32)
+    x0, x1 = _np_threefry2x32(k0, k1, np.zeros_like(steps), steps)
+    return np.stack([x0, x1], axis=1)
+
+
+# jitted once: derive the whole dispatch group's keys in ONE host-side
+# executable instead of k eager fold_in chains
+_FOLD_KEYS = None
+
+
+def _fold_keys(base, steps):
+    global _FOLD_KEYS
+    if _FOLD_KEYS is None:
+        _FOLD_KEYS = jax.jit(lambda b, s: jax.vmap(
+            lambda i: jax.random.key_data(jax.random.fold_in(b, i)))(s))
+    return _FOLD_KEYS(base, steps)
+
+
 def _process_entropy():
     """Random seed root drawn once per JOB (used when a program has no
     random_seed and FLAGS deterministic is off). Under multi-host, every
@@ -98,6 +157,10 @@ class Executor(object):
                 self._device = None
         self._cache = {}
         self._step_counters = {}
+        # multi-step dispatch counters (profiler.training_report contract)
+        self._dispatch_stats = {'dispatches': 0, 'steps': 0,
+                                'tail_flushes': 0, 'host_stall_s': 0.0}
+        self._prof_registered = False
 
     # ------------------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, feed_var_name='feed',
@@ -131,27 +194,15 @@ class Executor(object):
                             v, self._feed_var(program, n))
 
         # persistable state present in scope
-        persist, persist_written = _program_analysis(program)
-        state = {}
-        for name in persist:
-            val = scope.get(name)
-            if val is not None:
-                state[name] = val
-
-        out_state_names = tuple(sorted(set(state) | set(persist_written)))
+        state, persist_written, out_state_names = self._gather_state(
+            program, scope)
 
         mesh_key = (tuple(mesh.shape.items()) if mesh is not None else None)
         key = self._cache_key(program, feed_vals, fetch_names, state,
                               out_state_names) + (mesh_key,)
         fn = self._cache.get(key)
         if fn is None:
-            # evict compiled steps for older epochs of this program: a
-            # mutate-then-run loop would otherwise leak one XLA executable
-            # per mutation
-            stale = [k for k in self._cache
-                     if k[0] == program._uid and k[1] != program._build_epoch]
-            for k in stale:
-                del self._cache[k]
+            self._evict_stale(program)
             fn = self._build(program, tuple(sorted(feed_vals)), tuple(fetch_names),
                              tuple(sorted(state)), out_state_names, mesh,
                              feed_vals)
@@ -160,10 +211,6 @@ class Executor(object):
         step = self._step_counters.get(program._uid, 0)
         self._step_counters[program._uid] = step + 1
         from .core import config as _config
-        seed = program.random_seed
-        if not seed:
-            seed = 1234567 if _config.get_flag('deterministic') \
-                else _process_entropy()
         # carried as RAW key data (uint32) so multi-host placement can
         # treat it like any other array; step() re-wraps it. Computed on
         # the HOST cpu backend: the eager key->fold_in->key_data chain on
@@ -171,11 +218,48 @@ class Executor(object):
         # through the axon tunnel — it throttled every small-model step
         # (PERF_NOTES.md smallnet note). Key derivation is deterministic
         # math, so the stream is identical wherever it is computed.
-        impl = _config.rng_impl()
-        rng = self._host_rng(seed, impl, step)
+        rng = self._host_rng(self._step_seed(program), _config.rng_impl(),
+                             step)
 
+        fetches, new_state = self._dispatch(
+            fn, state, feed_vals, rng, 'executor_run#%d' % program._uid)
+        return self._finish(scope, new_state, fetches, return_numpy)
+
+    # -- shared run()/run_steps() plumbing -----------------------------
+    def _gather_state(self, program, scope):
+        """(scope-present persistable state, persistable∩written set,
+        out_state_names) — the step function's state contract."""
+        persist, persist_written = _program_analysis(program)
+        state = {}
+        for name in persist:
+            val = scope.get(name)
+            if val is not None:
+                state[name] = val
+        out_names = tuple(sorted(set(state) | set(persist_written)))
+        return state, set(persist_written), out_names
+
+    def _evict_stale(self, program):
+        """Evict compiled steps for older epochs of this program: a
+        mutate-then-run loop would otherwise leak one XLA executable per
+        mutation."""
+        stale = [k for k in self._cache
+                 if k[0] == program._uid and k[1] != program._build_epoch]
+        for k in stale:
+            del self._cache[k]
+
+    @staticmethod
+    def _step_seed(program):
+        from .core import config as _config
+        seed = program.random_seed
+        if not seed:
+            seed = 1234567 if _config.get_flag('deterministic') \
+                else _process_entropy()
+        return seed
+
+    def _dispatch(self, fn, state, feed_vals, rng, tag):
+        from .core import config as _config
         from . import profiler as _profiler
-        prof_ctx = (_profiler.record_event('executor_run#%d' % program._uid)
+        prof_ctx = (_profiler.record_event(tag)
                     if _profiler.is_profiling() else _nullcontext())
         with prof_ctx:
             if _config.get_flag('check_nan_inf'):
@@ -183,35 +267,404 @@ class Executor(object):
                 # (operator.cc:896-905); jax.debug_nans re-runs the step
                 # un-jitted on a nan/inf and pinpoints the producing op
                 with jax.debug_nans(True):
-                    fetches, new_state = fn(state, feed_vals, rng)
-            else:
-                fetches, new_state = fn(state, feed_vals, rng)
+                    return fn(state, feed_vals, rng)
+            return fn(state, feed_vals, rng)
+
+    @staticmethod
+    def _finish(scope, new_state, fetches, return_numpy):
         for name, val in new_state.items():
             scope.set(name, val)
-
         if return_numpy:
             return [np.asarray(unwrap(v)) for v in fetches]
         return list(fetches)
 
     def close(self):
         self._cache.clear()
+        if self._prof_registered:
+            from . import profiler as _profiler
+            _profiler.unregister_training_source('executor@%x' % id(self))
+            self._prof_registered = False
+
+    # ------------------------------------------------------------------
+    def run_steps(self, program=None, reader=None, fetch_list=None,
+                  steps=None, feed=None, scope=None, return_numpy=True,
+                  fetch_policy='final'):
+        """Run K training steps in ONE device dispatch (in-graph loop).
+
+        The traced step body is wrapped in a lax.scan over K pre-staged
+        input batches, so optimizer state advances K steps per dispatch
+        and the fixed per-dispatch cost (the remote-tunnel round-trip
+        floor, PERF_NOTES.md) divides by K. Bit-identical to K sequential
+        run() calls: the same per-step rng stream (fold_in over ONE shared
+        step counter — run() and run_steps() interleave freely), the same
+        state flow, the same op graph per step.
+
+        Feed sources, first match wins:
+          * feed= dict name -> stacked [K, ...] array, or a list/tuple of
+            K per-step values (LoD values allowed when every step shares
+            one bucket shape — data and offsets stack in traced-lod form).
+          * reader= a PyReader. In prefetch_to_device(K) mode one staged
+            [K, ...] group is popped per call; otherwise `steps` batches
+            are pulled and stacked on the spot.
+          * neither: the program's attached py_readers (layers.py_reader).
+
+        At EOF a PARTIAL tail group (m < K batches) is flushed through a
+        separately compiled m-step program (the multi-bucket discipline of
+        inference/export.py); core.EOFException then surfaces on the NEXT
+        call, exactly like run().
+
+        fetch_policy: 'final' returns only the LAST step's fetches (the
+        every-K thinning a periodic-logging loop wants); 'stack' returns
+        every fetch stacked over a leading K axis, bit-matching the K
+        sequential per-step fetch values.
+        """
+        if fetch_policy not in ('final', 'stack'):
+            raise ValueError("fetch_policy must be 'final' or 'stack', "
+                             "got %r" % (fetch_policy,))
+        if steps is not None and int(steps) < 1:
+            raise ValueError("run_steps: steps must be >= 1, got %d"
+                             % int(steps))
+        program = program if program is not None else default_main_program()
+        if hasattr(program, '_ptpu_compiled_program'):
+            raise NotImplementedError(
+                "run_steps drives single-device programs; the dispatch "
+                "floor it amortizes is the per-run() round-trip. Run mesh "
+                "(CompiledProgram) programs through Executor.run.")
+        scope = scope if scope is not None else global_scope()
+        fetch_list = fetch_list or []
+        if isinstance(fetch_list, (Variable, str)):
+            fetch_list = [fetch_list]
+        fetch_names = [_fetch_name(f) for f in fetch_list]
+
+        import time as _time
+        t0 = _time.perf_counter()
+        feed_vals, k, want = self._gather_step_group(program, reader, feed,
+                                                     steps)
+        stall = _time.perf_counter() - t0
+
+        state, persist_written, out_state_names = self._gather_state(
+            program, scope)
+        missing = sorted(persist_written - set(state))
+        if missing:
+            raise RuntimeError(
+                "run_steps: state %r is written by the program but absent "
+                "from the scope — run the startup program first so every "
+                "state var is materialized (a scan carry cannot create "
+                "entries mid-loop)" % (missing,))
+
+        key = self._cache_key(program, feed_vals, fetch_names, state,
+                              out_state_names) + ('multi', k, fetch_policy)
+        fn = self._cache.get(key)
+        if fn is None:
+            self._evict_stale(program)
+            fn = self._build_multi(program, tuple(fetch_names),
+                                   out_state_names, k, fetch_policy)
+            self._cache[key] = fn
+
+        step0 = self._step_counters.get(program._uid, 0)
+        self._step_counters[program._uid] = step0 + k
+        from .core import config as _config
+        rngs = self._host_rng_group(self._step_seed(program),
+                                    _config.rng_impl(), step0, k)
+
+        fetches, new_state = self._dispatch(
+            fn, state, feed_vals, rngs,
+            'executor_run_steps#%d' % program._uid)
+
+        st = self._dispatch_stats
+        st['dispatches'] += 1
+        st['steps'] += k
+        if k < want:  # EOF tail group ran through a smaller bucket
+            st['tail_flushes'] += 1
+        st['host_stall_s'] += stall
+        self._register_profiler_source()
+        return self._finish(scope, new_state, fetches, return_numpy)
+
+    def _register_profiler_source(self):
+        if self._prof_registered:
+            return
+        self._prof_registered = True
+        import weakref
+        from . import profiler as _profiler
+        # weakref: an executor dropped without close() must not pin its
+        # stats in the module-global registry forever (and a recycled
+        # id() must not resurrect a dead executor's row)
+        ref = weakref.ref(self)
+        name = 'executor@%x' % id(self)
+
+        def snap():
+            ex = ref()
+            if ex is None:
+                _profiler.unregister_training_source(name)
+                raise ReferenceError('executor collected')
+            st = ex._dispatch_stats
+            d = max(st['dispatches'], 1)
+            return {'dispatches': st['dispatches'], 'steps': st['steps'],
+                    'steps_per_dispatch': st['steps'] / d,
+                    'tail_flushes': st['tail_flushes'],
+                    'host_stall_ms': st['host_stall_s'] * 1e3}
+        _profiler.register_training_source(name, snap)
+
+    def _gather_step_group(self, program, reader, feed, steps):
+        """Resolve one K-step input group to ({name: stacked device
+        value} with leading dim K, realized K, intended K) — realized <
+        intended only at an EOF tail flush (the intended size comes from
+        `steps` or the reader's configured group)."""
+        from .core import EOFException
+        if feed:
+            groups, ks = {}, set()
+            for name, value in feed.items():
+                var = self._feed_var(program, name)
+                if isinstance(value, (list, tuple)):
+                    groups[name] = self._stack_step_values(
+                        name, list(value), var)
+                    ks.add(len(value))
+                    continue
+                v = self._to_device_value(value, var)
+                if isinstance(v, LoDArray):
+                    raise TypeError(
+                        "run_steps feed %r: pass LoD values as a list of K "
+                        "per-step LoDTensors (one stacked array cannot "
+                        "carry per-step offsets)" % name)
+                if getattr(v, 'ndim', 0) < 1:
+                    raise ValueError(
+                        "run_steps feed %r has no leading step dimension"
+                        % name)
+                groups[name] = v
+                ks.add(int(v.shape[0]))
+            if len(ks) != 1:
+                raise ValueError(
+                    "run_steps: feeds disagree on the step dimension: %s"
+                    % sorted(ks))
+            k = ks.pop()
+            if steps is not None and int(steps) != k:
+                raise ValueError(
+                    "run_steps(steps=%d) but the feed carries %d stacked "
+                    "steps" % (int(steps), k))
+            return groups, k, k
+
+        readers = [reader] if reader is not None else \
+            list(getattr(program, '_py_readers', []))
+        if not readers:
+            raise ValueError(
+                "run_steps needs a feed source: pass feed= (stacked "
+                "arrays or K-lists), reader=, or attach a py_reader to "
+                "the program")
+        groups, ks, wants = {}, set(), set()
+        for r in readers:
+            # the mode the reader's last start() ran with; before any
+            # start() fall back to the configured mode so the steps
+            # validation and not-started errors surface on the right path
+            pre_k = getattr(r, '_mode_k', 0)
+            if not pre_k and getattr(r, '_thread', None) is None:
+                pre_k = getattr(r, '_prefetch_k', None) or 0
+            if pre_k:
+                if steps is not None and int(steps) != pre_k:
+                    raise ValueError(
+                        "run_steps(steps=%d) but the reader prefetches "
+                        "groups of %d — configure prefetch_to_device "
+                        "with the dispatch size" % (int(steps), pre_k))
+                batch, k = r._next_group()  # EOFException when drained
+                for n, v in batch.items():
+                    groups[n] = self._to_device_value(
+                        v, self._feed_var(program, n))
+                ks.add(k)
+                wants.add(pre_k)
+                continue
+            if steps is None:
+                raise ValueError(
+                    "run_steps(steps=K) is required when the reader does "
+                    "not prefetch fixed-size groups")
+            if getattr(r, '_pending_eof', False):
+                r._pending_eof = False
+                raise EOFException("py_reader reached end of data")
+            pulled = []
+            try:
+                for _ in range(int(steps)):
+                    pulled.append(r._next_batch())
+            except EOFException:
+                if not pulled:
+                    raise
+                r._pending_eof = True  # tail flush now, EOF on next call
+            for n in pulled[0]:
+                groups[n] = self._stack_step_values(
+                    n, [b[n] for b in pulled], self._feed_var(program, n))
+            ks.add(len(pulled))
+            wants.add(int(steps))
+        if len(ks) != 1:
+            raise ValueError("run_steps: attached readers disagree on the "
+                             "group size: %s" % sorted(ks))
+        return groups, ks.pop(), max(wants)
+
+    def _stack_step_values(self, name, values, var):
+        """Stack K per-step feed values into one [K, ...] device value.
+
+        LoD values follow the executor's static/traced duality: when every
+        step carries the IDENTICAL static lod pattern, the group stacks in
+        STATIC form (offsets stay host structure, so ops whose output
+        shape depends on lod content — CTC, sequence_expand — keep
+        working); otherwise the group stacks in TRACED form (data + one
+        offsets array per level), which requires every step to share one
+        bucket shape — the bucket_by_length discipline — and traced-lod
+        capable ops."""
+        vals = [self._to_device_value(v, var) for v in values]
+        if isinstance(vals[0], LoDArray):
+            nlv = vals[0].nlevels
+            shapes = {tuple(v.data.shape) for v in vals
+                      if isinstance(v, LoDArray)}
+            if (any(not isinstance(v, LoDArray) or v.nlevels != nlv
+                    for v in vals) or len(shapes) != 1):
+                raise ValueError(
+                    "run_steps feed %r: every step in a group must share "
+                    "one LoD bucket shape (pad/bucket the reader, e.g. "
+                    "bucket_by_length); got data shapes %s"
+                    % (name, sorted(shapes)))
+            if (all(not v.is_traced for v in vals)
+                    and len({v.lod for v in vals}) == 1):
+                # identical static pattern across the group: the scan
+                # slices data while the offsets ride the pytree STRUCTURE
+                return LoDArray(jnp.stack([v.data for v in vals]),
+                                vals[0].lod)
+            offs = []
+            for lvl in range(nlv):
+                level = [v.off_t(lvl) for v in vals]
+                if len({int(o.shape[0]) for o in level}) != 1:
+                    raise ValueError(
+                        "run_steps feed %r lod level %d: offset counts "
+                        "differ across the group (nseq must match the "
+                        "bucket)" % (name, lvl))
+                offs.append(jnp.stack(level))
+            return LoDArray.traced(jnp.stack([v.data for v in vals]), offs)
+        if any(isinstance(v, LoDArray) for v in vals):
+            raise ValueError("run_steps feed %r mixes LoD and dense "
+                             "values across the group" % name)
+        return jnp.stack(vals)
+
+    def _build_multi(self, program, fetch_names, out_state_names, k,
+                     fetch_policy):
+        """Compile a K-step dispatch: the single-step trace body wrapped
+        in a lax.scan over stacked feeds + per-step rng keys. One cache
+        entry per (signature, K) — an EOF tail group of m < K steps
+        compiles its own smaller bucket, the multi-bucket discipline of
+        inference/export.py. Gradient merge composes: each scanned step
+        runs the existing micro-batch scan inside it."""
+        self._check_host_callbacks(program)
+        step = self._trace_step_fn(program, fetch_names, out_state_names,
+                                   None)
+
+        def step_k(state, feed, rngs):
+            def one(st, feed_i, rng_i):
+                fetches, new_state = step(st, feed_i, rng_i)
+                st = dict(st)
+                st.update(new_state)
+                return st, fetches
+
+            # 'final' thinning carries the fetches through the scan (no
+            # K-stacked fetch buffer); seed the carry with zeros of the
+            # fetch avals
+            feed0 = jax.tree.map(lambda x: x[0], feed)
+            f_sh = jax.eval_shape(lambda s, f, r: one(s, f, r)[1],
+                                  state, feed0, rngs[0])
+            zero_f = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                                  f_sh)
+
+            def body(carry, xs):
+                st, _ = carry
+                feed_i, rng_i = xs
+                st, fetches = one(st, feed_i, rng_i)
+                ys = fetches if fetch_policy == 'stack' else None
+                return (st, fetches), ys
+
+            (st, last_f), ys = jax.lax.scan(body, (state, zero_f),
+                                            (feed, rngs))
+            fetches = ys if fetch_policy == 'stack' else last_f
+            new_state = {n: st[n] for n in out_state_names if n in st}
+            return fetches, new_state
+
+        return self._pin_and_call(jax.jit(step_k, donate_argnums=(0,)))
+
+    def _pin_and_call(self, jitted):
+        """Wrap a jitted (state, feed, rng) callable so every input is
+        pinned to this executor's device, COMMITTED — keeps
+        avals/shardings identical across runs (no silent pjit recompiles)
+        and gathers state left sharded across a mesh by an earlier
+        ParallelExecutor run on the same scope. Shared by the single-step
+        and multi-step build paths."""
+        dev = self._device
+
+        def _pin(v):
+            # device_put through a remote-tunnel backend is an RPC even
+            # when it's a no-op; skip arrays already committed here
+            data = v.data if isinstance(v, LoDArray) else v
+            s = getattr(data, 'sharding', None)
+            if s is not None and s.device_set == {dev}:
+                return v
+            return jax.device_put(v, dev)
+
+        def call(state, feed, rng):
+            if dev is not None:
+                state = {n: _pin(v) for n, v in state.items()}
+                feed = {n: _pin(v) for n, v in feed.items()}
+                rng = _pin(rng)
+                with jax.default_device(dev):
+                    return jitted(state, feed, rng)
+            return jitted(state, feed, rng)
+        return call
 
     # ------------------------------------------------------------------
     @staticmethod
     def _host_rng(seed, impl, step):
         """Per-step raw key data, derived on the host cpu backend (numpy
         result). Cached base key per (seed, impl)."""
-        cache = Executor._host_rng_cache
-        base = cache.get((seed, impl))
-        if base is None:
-            cpu = jax.local_devices(backend='cpu')[0]
-            with jax.default_device(cpu):
-                base = jax.random.key(seed, impl=impl)
-            cache[(seed, impl)] = base
-        cpu = jax.local_devices(backend='cpu')[0]
-        with jax.default_device(cpu):
+        cpu = Executor._host_cpu()
+        if cpu is None and impl == 'threefry2x32':
+            # no cpu backend registered (JAX_PLATFORMS=tpu, ADVICE r5
+            # item 3): numpy-side derivation, bit-identical to jax's
+            return _np_threefry_key_group(seed, step, 1)[0]
+        base = Executor._base_key(seed, impl, cpu)
+        with (jax.default_device(cpu) if cpu is not None
+              else _nullcontext()):
             return np.asarray(jax.random.key_data(
                 jax.random.fold_in(base, step)))
+
+    @staticmethod
+    def _host_rng_group(seed, impl, step0, k):
+        """Raw key data for steps [step0, step0+k), stacked [k, ...]: ONE
+        host-side derivation feeds a whole multi-step dispatch, and each
+        row is bit-identical to _host_rng(seed, impl, step0 + i) — the
+        K-step program consumes the same rng stream K sequential run()
+        calls would."""
+        cpu = Executor._host_cpu()
+        if cpu is None and impl == 'threefry2x32':
+            return _np_threefry_key_group(seed, step0, k)
+        base = Executor._base_key(seed, impl, cpu)
+        with (jax.default_device(cpu) if cpu is not None
+              else _nullcontext()):
+            steps = jnp.arange(step0, step0 + k, dtype=jnp.int32)
+            return np.asarray(_fold_keys(base, steps))
+
+    @staticmethod
+    def _host_cpu():
+        """The host cpu device, or None when the cpu platform is not
+        registered (JAX_PLATFORMS=tpu) — callers fall back to numpy-side
+        key math (threefry) or the default device (rbg et al.; key
+        derivation is deterministic math, so the stream is identical
+        wherever it is computed)."""
+        try:
+            return jax.local_devices(backend='cpu')[0]
+        except RuntimeError:
+            return None
+
+    @staticmethod
+    def _base_key(seed, impl, cpu):
+        cache = Executor._host_rng_cache
+        base = cache.get((seed, impl, cpu is None))
+        if base is None:
+            with (jax.default_device(cpu) if cpu is not None
+                  else _nullcontext()):
+                base = jax.random.key(seed, impl=impl)
+            cache[(seed, impl, cpu is None)] = base
+        return base
 
     _host_rng_cache = {}
 
@@ -412,8 +865,7 @@ class Executor(object):
         new_state = {n: env[n] for n in out_state_names if n in env}
         return fetches, new_state
 
-    def _build(self, program, feed_names, fetch_names, state_names,
-               out_state_names, mesh=None, feed_vals=None):
+    def _check_host_callbacks(self, program):
         if any(op.type == 'py_func' for b in program.blocks for op in b.ops):
             # fail at build time with guidance, not at run time with the
             # plugin's raw UNIMPLEMENTED (VERDICT r3 weak #5: the axon
@@ -428,6 +880,11 @@ class Executor(object):
                     "tunnel is one such backend). Run this program on "
                     "CPUPlace, or replace the py_func with native ops."
                     % (dev,))
+
+    def _trace_step_fn(self, program, fetch_names, out_state_names, mesh):
+        """The traced (state, feed, rng_raw) -> (fetches, new_state) step
+        body — shared by the single-step _build and the K-step
+        _build_multi (which wraps it in a lax.scan)."""
         amp_on = bool(getattr(program, '_amp_bf16', False))
         k = int(getattr(program, '_grad_accum_k', 1) or 1)
 
@@ -463,33 +920,16 @@ class Executor(object):
                 new_state = {n: tracer.env[n] for n in out_state_names
                              if n in tracer.env}
             return fetches, new_state
+        return step
+
+    def _build(self, program, feed_names, fetch_names, state_names,
+               out_state_names, mesh=None, feed_vals=None):
+        self._check_host_callbacks(program)
+        step = self._trace_step_fn(program, fetch_names, out_state_names,
+                                   mesh)
 
         if mesh is None:
-            jitted = jax.jit(step, donate_argnums=(0,))
-            dev = self._device
-
-            def _pin(v):
-                # device_put through a remote-tunnel backend is an RPC even
-                # when it's a no-op; skip arrays already committed here
-                data = v.data if isinstance(v, LoDArray) else v
-                s = getattr(data, 'sharding', None)
-                if s is not None and s.device_set == {dev}:
-                    return v
-                return jax.device_put(v, dev)
-
-            def run_single(state, feed, rng):
-                # Pin every input to this executor's device, COMMITTED —
-                # keeps avals/shardings identical across runs (no silent
-                # pjit recompiles) and gathers state left sharded across a
-                # mesh by an earlier ParallelExecutor run on the same scope.
-                if dev is not None:
-                    state = {n: _pin(v) for n, v in state.items()}
-                    feed = {n: _pin(v) for n, v in feed.items()}
-                    rng = _pin(rng)
-                    with jax.default_device(dev):
-                        return jitted(state, feed, rng)
-                return jitted(state, feed, rng)
-            return run_single
+            return self._pin_and_call(jax.jit(step, donate_argnums=(0,)))
 
         # SPMD: batch-shard the feeds over the data axis; state replicated
         # unless a parameter carries a sharding_spec (TP/EP annotation);

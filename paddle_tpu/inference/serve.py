@@ -19,6 +19,35 @@ import time
 
 import numpy as np
 
+def _np_threefry_fold(seed, step):
+    """fold_in(key(seed), step) raw key data with numpy only — the
+    Threefry-2x32 core, bit-identical to jax's (the same math as
+    executor.py's _np_threefry_key_group, duplicated because this module
+    must import only json/numpy/jax and also run by file path). Used when
+    no cpu backend is registered (JAX_PLATFORMS=tpu): eager key math on a
+    remote accelerator would cost dispatch round-trips per step."""
+    rot = ((13, 15, 26, 6), (17, 29, 16, 24))
+    seed = int(seed)
+    import jax
+    with np.errstate(over='ignore'):
+        # mirror jax's seed canonicalization: with x64 disabled (the
+        # default) an int seed becomes int32, so the upper word is zero
+        k0 = (np.uint32((seed >> 32) & 0xFFFFFFFF)
+              if jax.config.jax_enable_x64 else np.uint32(0))
+        k1 = np.uint32(seed & 0xFFFFFFFF)
+        ks = (k0, k1, k0 ^ k1 ^ np.uint32(0x1BD11BDA))
+        x0 = np.uint32(0) + ks[0]
+        x1 = np.uint32(step) + ks[1]
+        for i in range(5):
+            for r in rot[i % 2]:
+                x0 = x0 + x1
+                x1 = (x1 << np.uint32(r)) | (x1 >> np.uint32(32 - r))
+                x1 = x0 ^ x1
+            x0 = x0 + ks[(i + 1) % 3]
+            x1 = x1 + ks[(i + 2) % 3] + np.uint32(i + 1)
+    return np.stack([x0, x1])
+
+
 _SIGNATURE = 'signature.json'
 _MODULE = 'module.jaxexport'
 _BUCKET_DIR = 'bucket_%05d'  # per-bucket subdir of a multi-bucket artifact
@@ -284,12 +313,23 @@ class CompiledTrainer(object):
                 for n, v in zip(self._state_names, self._state)}
 
     def _rng(self):
-        # derived on the host cpu backend: eager key math on a remote
-        # accelerator costs dispatch round-trips per step (the Executor
-        # does the same; PERF_NOTES.md r5 note). Bit-identical anywhere.
+        # derived on the host cpu backend when one is registered: eager
+        # key math on a remote accelerator costs dispatch round-trips per
+        # step (the Executor does the same; PERF_NOTES.md r5 note).
+        # Under JAX_PLATFORMS=tpu the cpu platform is absent (ADVICE r5
+        # item 3): threefry keys derive numpy-side (bit-identical,
+        # dispatch-free); other impls fall back to the default device —
+        # derivation is deterministic math, same stream either way.
+        import contextlib
         import jax
-        cpu = jax.local_devices(backend='cpu')[0]
-        with jax.default_device(cpu):
+        try:
+            dev_ctx = jax.default_device(
+                jax.local_devices(backend='cpu')[0])
+        except RuntimeError:
+            if self._impl == 'threefry2x32':
+                return _np_threefry_fold(self._seed, self._step_count)
+            dev_ctx = contextlib.nullcontext()
+        with dev_ctx:
             key = jax.random.key(self._seed, impl=self._impl)
             return np.asarray(jax.random.key_data(
                 jax.random.fold_in(key, self._step_count)))

@@ -172,7 +172,14 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
              sample_size=None):
     """SSD training loss (ref detection.py ssd_loss): match priors to gt
     (bipartite + per-prediction), mine hard negatives, localization
-    smooth-L1 + confidence cross-entropy."""
+    smooth-L1 + confidence cross-entropy.
+
+    Both mining types rank candidates by the CONFIDENCE loss only: the
+    mine_hard_examples kernel accepts an optional LocLoss input
+    (mine_hard_examples_op.cc:99), but the reference Python layer always
+    passes LocLoss=None (detection.py:944), so for numeric parity this
+    layer leaves it unset too — hard_example mining selects the
+    sample_size highest-cls-loss priors."""
     helper = LayerHelper('ssd_loss')
     if mining_type not in ('max_negative', 'hard_example'):
         raise ValueError("ssd_loss: mining_type must be 'max_negative' or "
@@ -195,22 +202,12 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
     # 3. mine hard negatives
     enc_gt = box_coder(prior_box=prior_box, prior_box_var=prior_box_var,
                        target_box=gt_box, code_type='encode_center_size')
+    # NO LocLoss input: the reference layer mines on cls loss only
+    # (detection.py:944 passes LocLoss=None; ADVICE r5 item 1) — feeding
+    # the kernel's optional LocLoss would change WHICH priors are mined
+    # vs the upstream layer and break numeric parity
     mine_inputs = {'ClsLoss': cls_loss2d, 'MatchIndices': matched_indices,
                    'MatchDist': matched_dist}
-    if mining_type == 'hard_example':
-        # hard_example ranks priors by cls + loc loss (the kernel's
-        # LocLoss input, mine_hard_examples_op.cc:99); the pre-mining
-        # loc loss uses targets from the FIRST match, WEIGHTED so
-        # unmatched priors contribute cls loss only (their assign target
-        # is the mismatch fill, not a real box)
-        loc_tgt0, loc_w0 = target_assign(enc_gt, matched_indices)
-        loc_tgt0.stop_gradient = True
-        loc_w0.stop_gradient = True
-        pre_loc = nn.smooth_l1(nn.reshape(location, shape=[-1, 4]),
-                               nn.reshape(loc_tgt0, shape=[-1, 4]))
-        pre_loc = pre_loc * nn.reshape(loc_w0, shape=[-1, 1])
-        mine_inputs['LocLoss'] = nn.reshape(
-            pre_loc, shape=[-1, confidence.shape[1]])
     neg_indices = _out(helper, 'int32')
     neg_indices.lod_level = 1
     updated = _out(helper, 'int32')

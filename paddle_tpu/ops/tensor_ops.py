@@ -187,11 +187,23 @@ def _dropout(ctx, ins):
         # quantizes to f32's 2^-24), generating/holding 4x/2x less random
         # material per element than the 32-bit default. Measured ablation
         # in PERF_NOTES.md (transformer dropout-tax section).
-        dt = jnp.uint8 if bits == 8 else jnp.uint16
-        # clamp: p ~ 1 would round to 2^bits, which wraps to 0 in the
-        # unsigned compare and silently kept EVERYTHING
-        thresh = min(int(round(p * (1 << bits))), (1 << bits) - 1)
-        keep = jax.random.bits(ctx.rng(), x.shape, dt) >= thresh
+        #
+        # Train/eval contract under this flag + downgrade_in_infer: the
+        # TRAIN keep-rate is (1-p) quantized to 1/2^bits while eval
+        # scales by the EXACT (1-p) — a ~2^-bits expectation mismatch.
+        # upscale_in_train does not share it (the train-time rescale uses
+        # the same quantized keep decision it drew). ADVICE r5 item 4.
+        if p >= 1.0:
+            # p == 1 drops everything exactly (bernoulli semantics);
+            # rounding it to 2^bits would wrap to 0 in the unsigned
+            # compare below and silently keep EVERYTHING
+            keep = jnp.zeros(x.shape, bool)
+        else:
+            dt = jnp.uint8 if bits == 8 else jnp.uint16
+            # clamp: p ~ 1 rounds to 2^bits, which wraps to 0 in the
+            # unsigned compare
+            thresh = min(int(round(p * (1 << bits))), (1 << bits) - 1)
+            keep = jax.random.bits(ctx.rng(), x.shape, dt) >= thresh
     else:
         keep = jax.random.bernoulli(ctx.rng(), 1.0 - p, x.shape)
     if impl == 'upscale_in_train':
@@ -245,6 +257,16 @@ def _reshape_infer(op, block):
             v.shape = tuple(out)
             if xv is not None and xv.dtype:
                 v.dtype = xv.dtype
+    if xv is not None and xv.shape is not None:
+        # reshape2's XShape output declares (0,) + x.shape (reference
+        # reshape_op.cc InferShape); the generic probe path populated it
+        # and this direct path must too (ADVICE r5 item 2)
+        for n in op.outputs.get('XShape', []):
+            v = block._find_var_recursive(n)
+            if v is not None:
+                v.shape = (0,) + tuple(xv.shape)
+                if xv.dtype:
+                    v.dtype = xv.dtype
 
 
 @register('reshape', infer_shape=_reshape_infer)

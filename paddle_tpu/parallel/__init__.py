@@ -2,5 +2,5 @@ from .mesh import make_mesh, DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS, \
     EXPERT_AXIS  # noqa: F401
 from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy  # noqa
 from .parallel_executor import ParallelExecutor  # noqa: F401
-from .api import shard_parameter, shard_embedding  # noqa: F401
+from .api import shard_parameter, shard_embedding, MultiStepTrainer  # noqa: F401,E501
 from .ring_attention import ring_attention  # noqa: F401

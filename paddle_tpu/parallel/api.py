@@ -31,3 +31,80 @@ def shard_embedding(param, axis=0, mesh_axis=EXPERT_AXIS):
     spec = [None] * len(param.shape)
     spec[axis] = mesh_axis
     return shard_parameter(param, spec)
+
+
+class MultiStepTrainer(object):
+    """Multi-step training dispatch driver (the training-side counterpart
+    of inference.BatchingPredictor, with a CompiledTrainer-style surface):
+    owns the executor, the steps-per-dispatch policy, and the epoch loop
+    with EOF tail flushing over Executor.run_steps — one device dispatch
+    advances optimizer state K steps, so dispatch-bound workloads divide
+    the per-run() floor by K (PERF_NOTES.md "Training dispatch floor").
+
+        trainer = MultiStepTrainer(main_prog, steps_per_dispatch=16,
+                                   fetch_list=[loss])
+        trainer.startup(startup_prog)
+        reader.prefetch_to_device(16)          # optional fast path
+        for fetches in trainer.iter_epoch(reader):
+            ...                                # one entry per DISPATCH
+    """
+
+    def __init__(self, program, steps_per_dispatch=8, fetch_list=None,
+                 fetch_policy='final', place=None, scope=None,
+                 executor=None):
+        from ..executor import Executor
+        from ..framework import TPUPlace
+        if int(steps_per_dispatch) < 1:
+            raise ValueError("steps_per_dispatch must be >= 1, got %d"
+                             % int(steps_per_dispatch))
+        self.program = program
+        self.steps_per_dispatch = int(steps_per_dispatch)
+        self.fetch_list = list(fetch_list or [])
+        self.fetch_policy = fetch_policy
+        self.scope = scope
+        self.executor = executor if executor is not None else Executor(
+            place if place is not None else TPUPlace())
+
+    def startup(self, startup_program):
+        """Run the startup program so every state var the K-step scan
+        carries is materialized (run_steps refuses to create scan-carry
+        entries mid-loop). Returns self."""
+        self.executor.run(startup_program, scope=self.scope)
+        return self
+
+    def step_group(self, feed=None, reader=None, steps=None):
+        """One dispatch of up to steps_per_dispatch steps; returns the
+        fetches per fetch_policy ('final': last step only; 'stack':
+        [K, ...] per fetch)."""
+        return self.executor.run_steps(
+            self.program, reader=reader, feed=feed,
+            fetch_list=self.fetch_list,
+            steps=int(steps) if steps is not None
+            else self.steps_per_dispatch,
+            scope=self.scope, fetch_policy=self.fetch_policy)
+
+    def iter_epoch(self, reader):
+        """Drive one epoch from a PyReader, yielding fetches per dispatch;
+        starts the reader when needed, flushes the EOF tail group through
+        its smaller compiled bucket, and resets the reader on exit."""
+        from ..core import EOFException
+        # start when never started OR drained (EOF consumed: _closed is
+        # set but the dead feeder thread object lingers until reset —
+        # skipping start() there would block forever on the empty queue)
+        if getattr(reader, '_thread', None) is None \
+                or getattr(reader, '_closed', True):
+            reader.start()
+        try:
+            while True:
+                try:
+                    yield self.step_group(reader=reader)
+                except EOFException:
+                    return
+        finally:
+            reader.reset()
+
+    @property
+    def stats(self):
+        """Per-dispatch counters (dispatches, steps, tail_flushes,
+        host_stall_s) — also surfaced by profiler.training_report()."""
+        return dict(self.executor._dispatch_stats)

@@ -53,6 +53,8 @@ def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
     _print_report(sorted_key)
     if _serving_sources:
         serving_report()
+    if _training_sources:
+        training_report()
     print("[paddle_tpu.profiler] device trace written to %s "
           "(open with TensorBoard / Perfetto); host events: "
           "export_chrome_tracing(path)" % _trace_dir)
@@ -139,6 +141,49 @@ def serving_report():
                    s.get('requests', 0), s.get('batches', 0),
                    s.get('occupancy', 0.0), s.get('p50_ms', 0.0),
                    s.get('p95_ms', 0.0), s.get('p99_ms', 0.0)))
+    return out
+
+
+# -- multi-step training dispatch metrics ------------------------------------
+# Executors running run_steps (multi-step dispatch, ISSUE 2) register a
+# zero-arg snapshot callable here; training_report() renders per-dispatch
+# step counts, EOF tail flushes, and host-stall time (waiting on the
+# prefetch ring), and stop_profiler appends the same table to the report.
+_training_sources = {}
+
+
+def register_training_source(name, snapshot):
+    """Register a multi-step-dispatch metrics source: `snapshot()` -> dict
+    with dispatches, steps, steps_per_dispatch, tail_flushes,
+    host_stall_ms (the contract of Executor.run_steps' counters)."""
+    _training_sources[name] = snapshot
+
+
+def unregister_training_source(name):
+    _training_sources.pop(name, None)
+
+
+def training_report():
+    """Print multi-step training dispatch metrics for every registered
+    source and return them as {source name: snapshot dict}."""
+    out = {}
+    rows = []
+    for name in sorted(_training_sources):
+        try:
+            snap = _training_sources[name]()
+        except Exception:
+            continue  # a closing executor must not break the report
+        out[name] = snap
+        rows.append((name, snap))
+    if rows:
+        print("%-32s %10s %8s %10s %6s %12s" %
+              ('Training source', 'dispatches', 'steps', 'steps/disp',
+               'tails', 'stall(ms)'))
+        for name, s in rows:
+            print("%-32s %10d %8d %10.2f %6d %12.2f" %
+                  (name[:32], s.get('dispatches', 0), s.get('steps', 0),
+                   s.get('steps_per_dispatch', 0.0),
+                   s.get('tail_flushes', 0), s.get('host_stall_ms', 0.0)))
     return out
 
 

@@ -7,11 +7,19 @@ A feeding thread converts python batches and stages them to the device
 data vars are not covered by an explicit feed. EOF surfaces as
 fluid.core.EOFException exactly like the reference (read_op throws on a
 closed queue).
+
+`prefetch_to_device(steps)` upgrades the per-batch queue to a STAGED GROUP
+RING for multi-step dispatch (Executor.run_steps): the feeder thread
+stacks `steps` host batches into one [K, ...] device buffer per feed var
+while the previous K-step program executes — one device transfer per K
+steps, double-buffered by queue depth. EOF flushes a partial tail group
+(m < K) for the consumer's smaller compiled bucket.
 """
 from __future__ import annotations
 
 import queue as _q
 import threading
+import time as _time
 
 import numpy as np
 
@@ -32,6 +40,38 @@ class PyReader(object):
         self._closed = True
         self._exc = None
         self._converter = feed_converter
+        self._prefetch_k = None
+        self._prefetch_depth = 2
+        self._mode_k = 0        # group size the LAST start() ran with
+        self._pending_eof = False
+        self.prefetch_stats = {'groups': 0, 'tail_groups': 0,
+                               'stage_s': 0.0}
+
+    def prefetch_to_device(self, steps, depth=2):
+        """Stage fixed groups of `steps` stacked batches to the device.
+
+        The feeder thread accumulates `steps` host batches, stacks them
+        into one [steps, ...] buffer per feed var, and stages the stack
+        with ONE device_put per var — while the consumer's previous
+        K-step dispatch (Executor.run_steps) executes. `depth` is the
+        number of staged groups the ring holds (2 = double buffering: the
+        next group stages under the current group's execution). At EOF a
+        partial tail group (fewer than `steps` batches) is flushed so the
+        consumer can run it through a smaller compiled bucket. Dense
+        ndarray feeds only — LoD batches have per-batch offsets that
+        cannot stack into one ring buffer (bucket + pad first).
+
+        Returns self (chainable); takes effect at the next start()."""
+        steps = int(steps)
+        if steps < 1:
+            raise ValueError("prefetch_to_device: steps must be >= 1, "
+                             "got %d" % steps)
+        if int(depth) < 1:
+            raise ValueError("prefetch_to_device: depth must be >= 1, "
+                             "got %d" % int(depth))
+        self._prefetch_k = steps
+        self._prefetch_depth = int(depth)
+        return self
 
     # -- graph side --------------------------------------------------------
     def read(self):
@@ -70,32 +110,110 @@ class PyReader(object):
             "call decorate_paddle_reader/decorate_tensor_provider first")
         self._closed = False
         self._exc = None
-        self._queue = _q.Queue(maxsize=self.capacity)
-
-        def work():
-            try:
-                import jax
-                for feed in self._feeder_fn():
-                    if self._closed:
-                        return
-                    if self.use_double_buffer:
-                        # stage to device from the feeding thread so the
-                        # consumer finds data already resident (the
-                        # double_buffer/buffered_reader prefetch)
-                        feed = {k: (v if not isinstance(v, np.ndarray)
-                                    else jax.device_put(v))
-                                for k, v in feed.items()}
-                    self._queue.put(feed)
-                self._queue.put(_EOF)
-            except Exception as e:  # surface in consumer
-                self._exc = e
-                self._queue.put(_EOF)
-
-        self._thread = threading.Thread(target=work, daemon=True)
+        self._pending_eof = False  # a consumer-side tail-flush marker
+        # snapshot the mode: prefetch_to_device takes effect HERE, not
+        # mid-epoch (the pop guards check what this start() staged)
+        self._mode_k = self._prefetch_k or 0
+        if self._mode_k:
+            self._queue = _q.Queue(maxsize=self._prefetch_depth)
+            self.prefetch_stats = {'groups': 0, 'tail_groups': 0,
+                                   'stage_s': 0.0}
+            target = self._prefetch_work
+        else:
+            self._queue = _q.Queue(maxsize=self.capacity)
+            target = self._work
+        # the worker captures ITS epoch's queue: a stale thread that
+        # outlives a mid-epoch reset()+start() (join timed out, or it was
+        # inside a device_put) can only ever write to its own dead queue,
+        # never interleave into the new epoch's
+        self._thread = threading.Thread(target=target, args=(self._queue,),
+                                        daemon=True)
         self._thread.start()
+
+    def _work(self, q):
+        try:
+            import jax
+            for feed in self._feeder_fn():
+                if self._closed or self._queue is not q:
+                    return
+                if self.use_double_buffer:
+                    # stage to device from the feeding thread so the
+                    # consumer finds data already resident (the
+                    # double_buffer/buffered_reader prefetch)
+                    feed = {k: (v if not isinstance(v, np.ndarray)
+                                else jax.device_put(v))
+                            for k, v in feed.items()}
+                q.put(feed)
+            q.put(_EOF)
+        except Exception as e:  # surface in consumer
+            if self._queue is q:  # a stale thread must not poison the
+                self._exc = e     # NEW epoch's error slot
+            q.put(_EOF)
+
+    def _stage_group(self, group, stats):
+        """Stack a list of host batches into one [k, ...] buffer per feed
+        var and stage it — the ring's unit of transfer is one device_put
+        per var per K steps instead of K. `stats` is the OWNING epoch's
+        counter dict, captured at thread start (a stale thread surviving
+        a mid-epoch reset must not bump the new epoch's counters)."""
+        import jax
+        t0 = _time.perf_counter()
+        out = {}
+        for name in group[0]:
+            vals = [b[name] for b in group]
+            if any(not isinstance(v, (np.ndarray, jax.Array))
+                   for v in vals):
+                raise TypeError(
+                    "prefetch_to_device stages dense ndarray feeds only; "
+                    "feed %r is %s — LoD/structured batches carry "
+                    "per-batch offsets that cannot stack into one "
+                    "[K, ...] ring buffer (bucket + pad first)"
+                    % (name, type(vals[0]).__name__))
+            shapes = {np.shape(v) for v in vals}
+            if len(shapes) != 1:
+                raise ValueError(
+                    "prefetch_to_device: feed %r batch shapes differ "
+                    "within a group (%s) — pad/bucket the reader so every "
+                    "group stacks to one [K, ...] buffer"
+                    % (name, sorted(shapes)))
+            if any(isinstance(v, jax.Array) for v in vals):
+                # already-on-device batches: stack device-side — pulling
+                # them to host first would cost K D2H round-trips per
+                # group (each an RPC through a remote tunnel)
+                import jax.numpy as jnp
+                out[name] = jnp.stack(vals)
+                continue
+            stacked = np.stack(vals)
+            out[name] = (jax.device_put(stacked) if self.use_double_buffer
+                         else stacked)
+        stats['stage_s'] += _time.perf_counter() - t0
+        return out, len(group)
+
+    def _prefetch_work(self, q):
+        stats = self.prefetch_stats  # this epoch's counters, captured
+        try:
+            group = []
+            for feed in self._feeder_fn():
+                if self._closed or self._queue is not q:
+                    return
+                group.append(feed)
+                if len(group) == self._mode_k:
+                    q.put(self._stage_group(group, stats))
+                    stats['groups'] += 1
+                    group = []
+            if group:  # EOF mid-group: flush the partial tail
+                q.put(self._stage_group(group, stats))
+                stats['groups'] += 1
+                stats['tail_groups'] += 1
+            q.put(_EOF)
+        except Exception as e:  # surface in consumer
+            if self._queue is q:  # a stale thread must not poison the
+                self._exc = e     # NEW epoch's error slot
+            q.put(_EOF)
 
     def reset(self):
         self._closed = True
+        self._pending_eof = False
         try:
             while True:
                 self._queue.get_nowait()
@@ -106,6 +224,31 @@ class PyReader(object):
         self._thread = None
 
     def _next_batch(self):
+        if self._mode_k:
+            raise RuntimeError(
+                "py_reader was started in prefetch_to_device mode (staged "
+                "[K, ...] groups): drive it with Executor.run_steps, or "
+                "drop the prefetch_to_device call before start()")
+        return self._pop()
+
+    def _next_group(self):
+        """Pop one staged group: ({name: [k, ...] stacked value}, k).
+        k is smaller than the configured group size only for the EOF tail
+        flush; EOFException raises when the epoch is drained (read_op
+        semantics, like _next_batch)."""
+        if self._prefetch_k is None and not self._mode_k:
+            raise RuntimeError(
+                "py_reader is not in prefetch mode: call "
+                "prefetch_to_device(steps) before start()")
+        if not self._mode_k:
+            if self._thread is None:
+                raise EOFException("py_reader not started")
+            raise RuntimeError(
+                "py_reader was started in per-batch mode; "
+                "prefetch_to_device takes effect at the next start()")
+        return self._pop()
+
+    def _pop(self):
         if self._thread is None and self._closed:
             raise EOFException("py_reader not started")
         item = self._queue.get()

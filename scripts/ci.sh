@@ -12,7 +12,13 @@ echo "== api surface =="
 python tools/print_signatures.py --check API.spec
 
 echo "== tests (8-device virtual cpu mesh, tier-1: not slow) =="
+# tier-1 includes tests/test_multi_step.py (K-step dispatch bit-identity)
+# and the prefetch-ring units in test_data_pipeline.py; the threaded ring
+# stress variant is slow-marked and runs in the slow tier below
 python -m pytest tests/ -q -m 'not slow'
+
+echo "== multi-step dispatch smoke (CPU, K=4 smallnet + fc dispatch A/B) =="
+PTPU_PLATFORM=cpu python scripts/multi_step_smoke.py
 
 echo "== slow tier (threaded stress, Poisson serving scenario) =="
 python -m pytest tests/ -q -m slow

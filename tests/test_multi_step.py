@@ -1,0 +1,560 @@
+"""Multi-step training dispatch (ISSUE 2): Executor.run_steps wraps the
+traced step in a lax.scan over K pre-staged batches, so one dispatch
+advances optimizer state K steps. The contract under test is
+BIT-IDENTITY with K sequential run() calls — params, rng stream,
+metrics — plus EOF partial-tail flushing, gradient-merge composition,
+fetch-thinning policies, and the numpy-side rng fallback (ADVICE r5
+item 3)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import unique_name
+from paddle_tpu.parallel import MultiStepTrainer
+
+
+def _build_net(seed, dropout=True):
+    """fc net with dropout (rng-consuming), momentum + LR decay (stateful
+    optimizer slots + step-counter state)."""
+    with unique_name.guard():
+        main_p, startup_p = fluid.Program(), fluid.Program()
+        main_p.random_seed = startup_p.random_seed = seed
+        with fluid.program_guard(main_p, startup_p):
+            x = fluid.layers.data(name='x', shape=[16], dtype='float32')
+            lab = fluid.layers.data(name='lab', shape=[1], dtype='int64')
+            h = fluid.layers.fc(x, size=32, act='relu')
+            if dropout:
+                h = fluid.layers.dropout(h, dropout_prob=0.3)
+            logits = fluid.layers.fc(h, size=5)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits=logits,
+                                                        label=lab))
+            acc = fluid.layers.accuracy(input=fluid.layers.softmax(logits),
+                                        label=lab)
+            fluid.optimizer.Momentum(
+                learning_rate=fluid.layers.exponential_decay(0.1, 10, 0.9),
+                momentum=0.9).minimize(loss)
+    return main_p, startup_p, loss, acc
+
+
+def _batches(n, rng_seed=3, batch=8):
+    rng = np.random.RandomState(rng_seed)
+    return ([rng.randn(batch, 16).astype(np.float32) for _ in range(n)],
+            [rng.randint(0, 5, (batch, 1)) for _ in range(n)])
+
+
+def _persist_state(program, scope):
+    return {v.name: np.asarray(scope.get(v.name)).copy()
+            for v in program.list_vars()
+            if v.persistable and scope.get(v.name) is not None}
+
+
+def _run_sequential(steps, fetch_extra=False, seed=17):
+    main_p, startup_p, loss, acc = _build_net(seed)
+    xs, labs = _batches(steps)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    fetches = [loss, acc] if fetch_extra else [loss]
+    out = []
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        for i in range(steps):
+            vals = exe.run(main_p, feed={'x': xs[i], 'lab': labs[i]},
+                           fetch_list=fetches)
+            out.append([np.asarray(v).reshape(-1) for v in vals])
+        state = _persist_state(main_p, scope)
+    return out, state
+
+
+def test_run_steps_bit_identical_to_sequential():
+    """K-step dispatch == K single run() calls, bit for bit: per-step
+    losses AND metrics (via 'stack'), every persistable (params, momentum
+    slots, LR counter), and the rng stream (the net has dropout — any rng
+    divergence would flip masks and change every number)."""
+    seq, seq_state = _run_sequential(8, fetch_extra=True)
+
+    main_p, startup_p, loss, acc = _build_net(17)
+    xs, labs = _batches(8)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    multi = []
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        for d in range(2):
+            l, a = exe.run_steps(
+                main_p, feed={'x': xs[4 * d:4 * d + 4],
+                              'lab': labs[4 * d:4 * d + 4]},
+                fetch_list=[loss, acc], steps=4, fetch_policy='stack')
+            for i in range(4):
+                multi.append([np.asarray(l)[i].reshape(-1),
+                              np.asarray(a)[i].reshape(-1)])
+        multi_state = _persist_state(main_p, scope)
+
+    for s, m in zip(seq, multi):
+        np.testing.assert_array_equal(s[0], m[0])  # loss
+        np.testing.assert_array_equal(s[1], m[1])  # accuracy metric
+    assert set(seq_state) == set(multi_state)
+    for n in seq_state:
+        np.testing.assert_array_equal(seq_state[n], multi_state[n],
+                                      err_msg='state %r diverged' % n)
+
+
+def test_fetch_policy_final_thins_to_every_k():
+    seq, _ = _run_sequential(4)
+    main_p, startup_p, loss, _acc = _build_net(17)
+    xs, labs = _batches(4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        l, = exe.run_steps(main_p, feed={'x': xs, 'lab': labs},
+                           fetch_list=[loss], steps=4,
+                           fetch_policy='final')
+    np.testing.assert_array_equal(np.asarray(l).reshape(-1), seq[-1][0])
+
+
+def test_fetch_policy_validation():
+    main_p, _startup_p, loss, _ = _build_net(1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises(ValueError, match='fetch_policy'):
+        exe.run_steps(main_p, feed={'x': np.zeros((2, 4, 16), np.float32)},
+                      fetch_list=[loss], fetch_policy='every_other')
+
+
+def test_feed_step_dim_mismatch_raises():
+    main_p, startup_p, loss, _ = _build_net(2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        with pytest.raises(ValueError, match='disagree on the step'):
+            exe.run_steps(
+                main_p,
+                feed={'x': np.zeros((3, 8, 16), np.float32),
+                      'lab': np.zeros((2, 8, 1), np.int64)},
+                fetch_list=[loss])
+        with pytest.raises(ValueError, match='stacked'):
+            exe.run_steps(
+                main_p,
+                feed={'x': np.zeros((3, 8, 16), np.float32),
+                      'lab': np.zeros((3, 8, 1), np.int64)},
+                fetch_list=[loss], steps=4)
+
+
+def test_rng_stream_shared_with_single_runs():
+    """run() and run_steps() advance ONE step counter: 2 singles + one
+    K=2 group == 4 singles, bit for bit (dropout makes rng drift
+    visible)."""
+    seq, seq_state = _run_sequential(4)
+
+    main_p, startup_p, loss, _acc = _build_net(17)
+    xs, labs = _batches(4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    got = []
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        for i in range(2):
+            l, = exe.run(main_p, feed={'x': xs[i], 'lab': labs[i]},
+                         fetch_list=[loss])
+            got.append(np.asarray(l).reshape(-1))
+        l, = exe.run_steps(main_p, feed={'x': xs[2:], 'lab': labs[2:]},
+                           fetch_list=[loss], steps=2,
+                           fetch_policy='stack')
+        got.extend(np.asarray(l).reshape(2, -1))
+        state = _persist_state(main_p, scope)
+    for s, m in zip(seq, got):
+        np.testing.assert_array_equal(s[0], m)
+    for n in seq_state:
+        np.testing.assert_array_equal(seq_state[n], state[n])
+
+
+def test_grad_merge_composes_with_run_steps():
+    """K outer steps x k=2 micro-batch scan: the gradient-merge program
+    runs unchanged inside the multi-step dispatch, bit-matching
+    sequential gradient-merge runs."""
+    def build(seed):
+        with unique_name.guard():
+            main_p, startup_p = fluid.Program(), fluid.Program()
+            main_p.random_seed = startup_p.random_seed = seed
+            with fluid.program_guard(main_p, startup_p):
+                x = fluid.layers.data(name='x', shape=[16], dtype='float32')
+                lab = fluid.layers.data(name='lab', shape=[1],
+                                        dtype='int64')
+                logits = fluid.layers.fc(
+                    fluid.layers.fc(x, 32, act='relu'), 5)
+                loss = fluid.layers.mean(
+                    fluid.layers.softmax_with_cross_entropy(
+                        logits=logits, label=lab))
+                fluid.contrib.gradient_merge.decorate(
+                    fluid.optimizer.SGD(learning_rate=0.5), 2).minimize(
+                        loss)
+        return main_p, startup_p, loss
+
+    xs, labs = _batches(6, rng_seed=8)
+    main_p, startup_p, loss = build(23)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        seq = [np.asarray(exe.run(main_p,
+                                  feed={'x': xs[i], 'lab': labs[i]},
+                                  fetch_list=[loss])[0]).reshape(-1)
+               for i in range(6)]
+
+    main_p, startup_p, loss = build(23)
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    scope2 = fluid.core.Scope()
+    with fluid.scope_guard(scope2):
+        exe2.run(startup_p)
+        multi = []
+        for d in range(2):
+            out, = exe2.run_steps(
+                main_p, feed={'x': xs[3 * d:3 * d + 3],
+                              'lab': labs[3 * d:3 * d + 3]},
+                fetch_list=[loss], steps=3, fetch_policy='stack')
+            multi.extend(np.asarray(out).reshape(3, -1))
+    for s, m in zip(seq, multi):
+        np.testing.assert_array_equal(s, m)
+
+
+def _lod_group_roundtrip(lens_per_step):
+    """Build an embedding+sequence_pool net, run the per-step batches
+    sequentially and as one run_steps group; return (seq, multi)."""
+    with unique_name.guard():
+        main_p, startup_p = fluid.Program(), fluid.Program()
+        main_p.random_seed = startup_p.random_seed = 5
+        with fluid.program_guard(main_p, startup_p):
+            w = fluid.layers.data(name='w', shape=[1], dtype='int64',
+                                  lod_level=1)
+            emb = fluid.layers.embedding(w, size=(50, 8))
+            pooled = fluid.layers.sequence_pool(emb, 'sum')
+            lab = fluid.layers.data(name='lab', shape=[1], dtype='int64')
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(
+                    logits=fluid.layers.fc(pooled, 4), label=lab))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    batches = [(fluid.create_lod_tensor(
+                    rng.randint(0, 50, (sum(lens), 1)), [list(lens)]),
+                rng.randint(0, 4, (len(lens), 1)))
+               for lens in lens_per_step]
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        seq = [np.asarray(exe.run(main_p, feed={'w': b[0], 'lab': b[1]},
+                                  fetch_list=[loss])[0]).reshape(-1)
+               for b in batches]
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    scope2 = fluid.core.Scope()
+    with fluid.scope_guard(scope2):
+        exe2.run(startup_p)
+        out, = exe2.run_steps(main_p,
+                              feed={'w': [b[0] for b in batches],
+                                    'lab': [b[1] for b in batches]},
+                              fetch_list=[loss],
+                              steps=len(lens_per_step),
+                              fetch_policy='stack')
+    return np.stack(seq).reshape(-1), np.asarray(out).reshape(-1)
+
+
+def test_lod_feeds_identical_pattern_stack_static():
+    """Identical static lod pattern across the group: offsets stay host
+    structure (static stacking), so even host-lod ops would keep working
+    — and the group bit-matches sequential runs."""
+    seq, multi = _lod_group_roundtrip([[3, 2, 4]] * 4)
+    np.testing.assert_array_equal(seq, multi)
+
+
+def test_lod_feeds_varying_pattern_stack_traced():
+    """Varying lod patterns within one bucket shape (same rows, same
+    nseq) stack in TRACED form — offsets become scanned data — and
+    bit-match sequential runs."""
+    seq, multi = _lod_group_roundtrip(
+        [[3, 2, 4], [2, 3, 4], [4, 4, 1], [1, 2, 6]])
+    np.testing.assert_array_equal(seq, multi)
+
+
+def test_lod_bucket_mismatch_raises():
+    with unique_name.guard():
+        main_p, startup_p = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_p, startup_p):
+            w = fluid.layers.data(name='w', shape=[1], dtype='int64',
+                                  lod_level=1)
+            emb = fluid.layers.embedding(w, size=(50, 8))
+            loss = fluid.layers.mean(
+                fluid.layers.sequence_pool(emb, 'sum'))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    a = fluid.create_lod_tensor(np.zeros((5, 1), np.int64), [[3, 2]])
+    b = fluid.create_lod_tensor(np.zeros((6, 1), np.int64), [[3, 3]])
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        with pytest.raises(ValueError, match='bucket'):
+            exe.run_steps(main_p, feed={'w': [a, b]}, fetch_list=[loss],
+                          steps=2)
+
+
+def _pyreader_program():
+    reader = fluid.layers.py_reader(
+        capacity=8, shapes=[(-1, 4), (-1, 1)], dtypes=['float32', 'int64'])
+    x, label = fluid.layers.read_file(reader)
+    logits = fluid.layers.fc(input=x, size=3)
+    loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+        logits=logits, label=label))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return reader, loss
+
+
+def _seven_batches():
+    def data():
+        rng = np.random.RandomState(0)
+        for i in range(7):
+            yield [(rng.rand(4).astype(np.float32),
+                    np.array([i % 3], np.int64)) for _ in range(6)]
+    return data
+
+
+def test_eof_partial_tail_flush_prefetch_ring():
+    """7 batches through a prefetch_to_device(4) ring: dispatch 1 runs 4
+    steps, dispatch 2 flushes the 3-step tail through a smaller compiled
+    bucket, then EOF — per epoch, for two epochs."""
+    reader, loss = _pyreader_program()
+    reader.decorate_paddle_reader(_seven_batches())
+    reader.prefetch_to_device(4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    for _epoch in range(2):
+        reader.start()
+        per_dispatch = []
+        while True:
+            try:
+                l, = exe.run_steps(fetch_list=[loss], steps=4,
+                                   fetch_policy='stack')
+                per_dispatch.append(np.asarray(l).shape[0])
+            except fluid.core.EOFException:
+                reader.reset()
+                break
+        assert per_dispatch == [4, 3]
+    assert exe._dispatch_stats['dispatches'] == 4
+    assert exe._dispatch_stats['steps'] == 14
+    assert exe._dispatch_stats['tail_flushes'] == 2
+    assert reader.prefetch_stats['tail_groups'] == 1  # per start()
+
+
+def test_eof_partial_tail_flush_plain_reader():
+    """Without the ring, run_steps pulls K single batches and stacks on
+    the spot; the EOF mid-group flushes the partial tail and the
+    EOFException surfaces on the NEXT call (run() parity)."""
+    reader, loss = _pyreader_program()
+    reader.decorate_paddle_reader(_seven_batches())
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    reader.start()
+    l1, = exe.run_steps(fetch_list=[loss], steps=4, fetch_policy='stack')
+    l2, = exe.run_steps(fetch_list=[loss], steps=4, fetch_policy='stack')
+    assert np.asarray(l1).shape[0] == 4 and np.asarray(l2).shape[0] == 3
+    with pytest.raises(fluid.core.EOFException):
+        exe.run_steps(fetch_list=[loss], steps=4)
+    reader.reset()
+
+
+def test_ring_fed_matches_explicit_feed():
+    """The ring path (host-stacked, device-staged groups) feeds the same
+    compiled program the explicit stacked feed hits — losses match."""
+    reader, loss = _pyreader_program()
+    rng = np.random.RandomState(7)
+    feats = [rng.rand(6, 4).astype(np.float32) for _ in range(4)]
+    labs = [rng.randint(0, 3, (6, 1)) for _ in range(4)]
+
+    def data():
+        for f, l in zip(feats, labs):
+            yield [(f[j], l[j]) for j in range(6)]
+
+    reader.decorate_paddle_reader(data)
+    reader.prefetch_to_device(4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    startup = fluid.default_startup_program()
+    main = fluid.default_main_program()
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        reader.start()
+        ring, = exe.run_steps(fetch_list=[loss], steps=4,
+                              fetch_policy='stack')
+        reader.reset()
+    names = [v.name for v in reader.feed_vars]
+    scope2 = fluid.core.Scope()
+    with fluid.scope_guard(scope2):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(startup)
+        fed, = exe2.run_steps(main,
+                              feed={names[0]: np.stack(feats),
+                                    names[1]: np.stack(labs)},
+                              fetch_list=[loss], steps=4,
+                              fetch_policy='stack')
+    np.testing.assert_array_equal(np.asarray(ring), np.asarray(fed))
+
+
+def test_plain_reader_tail_flag_clears_on_restart():
+    """The tail-flush EOF marker run_steps leaves on a plain reader must
+    not leak into the next epoch: after reset()+start(), the first
+    dispatch of epoch 2 runs (it must NOT raise a spurious EOF)."""
+    reader, loss = _pyreader_program()
+    reader.decorate_paddle_reader(_seven_batches())
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    for _epoch in range(2):
+        reader.start()
+        l1, = exe.run_steps(fetch_list=[loss], steps=4,
+                            fetch_policy='stack')
+        l2, = exe.run_steps(fetch_list=[loss], steps=4,
+                            fetch_policy='stack')
+        assert (np.asarray(l1).shape[0], np.asarray(l2).shape[0]) == (4, 3)
+        # caller resets after seeing the short tail, WITHOUT consuming
+        # the pending EOF — the flag must not survive the restart
+        reader.reset()
+
+
+def test_prefetch_config_mid_epoch_takes_effect_next_start():
+    """prefetch_to_device called while a per-batch epoch is running must
+    not break the running epoch (the mode is snapshotted at start())."""
+    reader, loss = _pyreader_program()
+    reader.decorate_paddle_reader(_seven_batches())
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    reader.start()
+    exe.run(fetch_list=[loss])              # per-batch epoch in flight
+    reader.prefetch_to_device(4)            # configure the NEXT epoch
+    exe.run(fetch_list=[loss])              # current epoch keeps working
+    reader.reset()
+    reader.start()                          # group mode takes effect here
+    l, = exe.run_steps(fetch_list=[loss], steps=4, fetch_policy='stack')
+    assert np.asarray(l).shape[0] == 4
+    reader.reset()
+
+
+def test_missing_state_guidance():
+    """run_steps refuses to create scan-carry state entries mid-loop: an
+    un-run startup program yields actionable guidance, not a scan
+    structure error."""
+    main_p, _startup_p, loss, _ = _build_net(9)
+    xs, labs = _batches(2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        with pytest.raises(RuntimeError, match='startup'):
+            exe.run_steps(main_p, feed={'x': xs, 'lab': labs},
+                          fetch_list=[loss], steps=2)
+
+
+def test_multi_step_trainer_wrapper():
+    """MultiStepTrainer: startup + iter_epoch drive the full loop (ring
+    start, dispatches, tail flush, reset) and surface stats."""
+    reader, loss = _pyreader_program()
+    reader.decorate_paddle_reader(_seven_batches())
+    reader.prefetch_to_device(4)
+    trainer = MultiStepTrainer(fluid.default_main_program(),
+                               steps_per_dispatch=4, fetch_list=[loss],
+                               fetch_policy='stack',
+                               place=fluid.CPUPlace())
+    trainer.startup(fluid.default_startup_program())
+    sizes = [np.asarray(f[0]).shape[0] for f in trainer.iter_epoch(reader)]
+    assert sizes == [4, 3]
+    st = trainer.stats
+    assert st['dispatches'] == 2 and st['steps'] == 7
+    assert st['tail_flushes'] == 1
+    # second epoch: iter_epoch restarts the (reset) reader
+    sizes = [np.asarray(f[0]).shape[0] for f in trainer.iter_epoch(reader)]
+    assert sizes == [4, 3]
+    # third epoch from a DRAINED, un-reset reader (manual loop consumed
+    # the EOF but never called reset): iter_epoch must restart, not hang
+    reader.start()
+    with pytest.raises(fluid.core.EOFException):
+        while True:
+            trainer.step_group(reader=reader)
+    sizes = [np.asarray(f[0]).shape[0] for f in trainer.iter_epoch(reader)]
+    assert sizes == [4, 3]
+
+
+def test_prefetch_reader_steps_omitted_counts_tail():
+    """steps= may be omitted when the reader prefetches fixed groups; the
+    EOF tail flush must still be detected (counted against the reader's
+    configured group size, not the steps argument)."""
+    reader, loss = _pyreader_program()
+    reader.decorate_paddle_reader(_seven_batches())
+    reader.prefetch_to_device(4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    reader.start()
+    sizes = []
+    while True:
+        try:
+            l, = exe.run_steps(fetch_list=[loss], fetch_policy='stack')
+            sizes.append(np.asarray(l).shape[0])
+        except fluid.core.EOFException:
+            reader.reset()
+            break
+    assert sizes == [4, 3]
+    assert exe._dispatch_stats['tail_flushes'] == 1
+
+
+def test_serve_np_threefry_fold_matches_jax():
+    """serve.py's framework-free numpy fold (CompiledTrainer._rng
+    fallback under JAX_PLATFORMS=tpu) bit-matches jax's derivation."""
+    import jax
+    from paddle_tpu.inference.serve import _np_threefry_fold
+    for seed in (1, 1234567, 2 ** 31 - 1, 123456789012, -3):
+        for step in (0, 5, 999):
+            key = jax.random.key(seed, impl='threefry2x32')
+            want = np.asarray(jax.random.key_data(
+                jax.random.fold_in(key, step)))
+            np.testing.assert_array_equal(
+                _np_threefry_fold(seed, step), want)
+
+
+def test_host_rng_numpy_fallback_bit_identical():
+    """The numpy-side threefry derivation (used when no cpu backend is
+    registered, JAX_PLATFORMS=tpu — ADVICE r5 item 3) must bit-match
+    jax's key math for single keys and whole dispatch groups."""
+    from paddle_tpu.executor import Executor, _np_threefry_key_group
+    # large (>= 2^32) and negative seeds exercise jax's x64-disabled seed
+    # canonicalization (upper key word zero, lower word two's-complement)
+    for seed in (1, 17, 1234567, 2 ** 31 - 1, 123456789012, -3):
+        for step0, k in ((0, 5), (7, 3), (123456, 2), (0, 1)):
+            via_jax = Executor._host_rng_group(seed, 'threefry2x32',
+                                               step0, k)
+            via_np = _np_threefry_key_group(seed, step0, k)
+            np.testing.assert_array_equal(via_jax, via_np)
+            singles = np.stack([
+                Executor._host_rng(seed, 'threefry2x32', step0 + i)
+                for i in range(k)])
+            np.testing.assert_array_equal(via_jax, singles)
+
+
+def test_profiler_training_report():
+    """run_steps registers a training source; training_report renders and
+    returns its per-dispatch counters."""
+    from paddle_tpu import profiler
+    main_p, startup_p, loss, _ = _build_net(11)
+    xs, labs = _batches(4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        exe.run_steps(main_p, feed={'x': xs, 'lab': labs},
+                      fetch_list=[loss], steps=4)
+    try:
+        report = profiler.training_report()
+        snap = report['executor@%x' % id(exe)]
+        assert snap['dispatches'] == 1 and snap['steps'] == 4
+        assert snap['steps_per_dispatch'] == 4.0
+        assert snap['tail_flushes'] == 0
+    finally:
+        exe.close()  # unregisters the source
+    assert 'executor@%x' % id(exe) not in profiler.training_report()
