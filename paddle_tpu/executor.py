@@ -157,9 +157,13 @@ class Executor(object):
                 self._device = None
         self._cache = {}
         self._step_counters = {}
-        # multi-step dispatch counters (profiler.training_report contract)
+        # multi-step dispatch counters (profiler.training_report contract;
+        # an executor owned by an inference Predictor sets _profile_role =
+        # 'infer' and the same counters surface as a bulk-infer source —
+        # steps relabel as batches)
         self._dispatch_stats = {'dispatches': 0, 'steps': 0,
                                 'tail_flushes': 0, 'host_stall_s': 0.0}
+        self._profile_role = 'training'
         self._prof_registered = False
 
     # ------------------------------------------------------------------
@@ -283,6 +287,7 @@ class Executor(object):
         if self._prof_registered:
             from . import profiler as _profiler
             _profiler.unregister_training_source('executor@%x' % id(self))
+            _profiler.unregister_infer_source('executor@%x' % id(self))
             self._prof_registered = False
 
     # ------------------------------------------------------------------
@@ -391,19 +396,30 @@ class Executor(object):
         # id() must not resurrect a dead executor's row)
         ref = weakref.ref(self)
         name = 'executor@%x' % id(self)
+        infer = self._profile_role == 'infer'
+        unreg = (_profiler.unregister_infer_source if infer
+                 else _profiler.unregister_training_source)
 
         def snap():
             ex = ref()
             if ex is None:
-                _profiler.unregister_training_source(name)
+                unreg(name)
                 raise ReferenceError('executor collected')
             st = ex._dispatch_stats
             d = max(st['dispatches'], 1)
+            if infer:  # run_steps driving Predictor.run_batches: the
+                # scanned units are inference batches, not train steps
+                return {'dispatches': st['dispatches'],
+                        'batches': st['steps'],
+                        'batches_per_dispatch': st['steps'] / d,
+                        'tail_flushes': st['tail_flushes'],
+                        'host_stall_ms': st['host_stall_s'] * 1e3}
             return {'dispatches': st['dispatches'], 'steps': st['steps'],
                     'steps_per_dispatch': st['steps'] / d,
                     'tail_flushes': st['tail_flushes'],
                     'host_stall_ms': st['host_stall_s'] * 1e3}
-        _profiler.register_training_source(name, snap)
+        (_profiler.register_infer_source if infer
+         else _profiler.register_training_source)(name, snap)
 
     def _gather_step_group(self, program, reader, feed, steps):
         """Resolve one K-step input group to ({name: stacked device

@@ -75,12 +75,14 @@ class Pool2D(Layer):
         self._type = pool_type
         self._global = global_pooling
         self._exclusive = exclusive
-        if ceil_mode:
-            raise NotImplementedError("Pool2D ceil_mode is not supported")
+        self._ceil_mode = ceil_mode
 
     def forward(self, x):
         import jax
         import jax.numpy as jnp
+        # the graph lowering's ceil_mode discipline (ops/nn_ops.py _pool):
+        # grow the high-side padding so the last partial window is kept
+        from ..ops.nn_ops import ceil_mode_pads
 
         def pool(xv):
             if self._global:
@@ -92,6 +94,9 @@ class Pool2D(Layer):
             pads = [(0, 0), (0, 0),
                     (self._padding[0], self._padding[0]),
                     (self._padding[1], self._padding[1])]
+            if self._ceil_mode:
+                pads[2:] = ceil_mode_pads(xv.shape[2:], self._size,
+                                          self._stride, self._padding)
             if self._type == 'max':
                 return jax.lax.reduce_window(xv, -jnp.inf, jax.lax.max,
                                              dims, strides, pads)

@@ -40,7 +40,6 @@ imports jax lazily). `paddle_tpu.profiler` is touched ONLY when the
 framework is already loaded in the process, so a serving process stays
 tracer-free (serve.py docstring contract).
 """
-import itertools
 import json
 import os
 import queue
@@ -59,7 +58,9 @@ except ImportError:  # imported by file path: serve.py sits alongside
     import serve as _serve
 
 _STOP = object()
-_SOURCE_SEQ = itertools.count()  # unique profiler source names per process
+# canonical copies live in serve.py (already imported either way)
+_SOURCE_SEQ = _serve._SOURCE_SEQ
+_maybe_profiler = _serve._maybe_profiler
 
 
 def _resolve(future, result=None, exc=None):
@@ -167,19 +168,6 @@ class ServingStats(object):
         else:
             snap.update(p50_ms=0.0, p95_ms=0.0, p99_ms=0.0)
         return snap
-
-
-def _maybe_profiler():
-    """paddle_tpu.profiler, but ONLY if the framework is already imported —
-    importing it from here would drag the framework into a tracer-free
-    serving process."""
-    if sys.modules.get('paddle_tpu') is None:
-        return None
-    try:
-        from paddle_tpu import profiler
-        return profiler
-    except Exception:
-        return None
 
 
 class BatchingPredictor(object):

@@ -11,13 +11,35 @@ process carries none of the framework. It is also runnable as a script:
 (or `python paddle_tpu/inference/serve.py ...` to avoid importing the
 package __init__ entirely; the test exercises that path and asserts the
 framework modules never load).
+
+Bulk offline/eval inference: `CompiledPredictor.run_batches(batches)`
+scans the exported module over K pre-staged batches in ONE device
+dispatch (`serve.py loop ...` from the CLI) — the inference mirror of
+the Executor's multi-step training dispatch.
 """
+import itertools
 import json
 import os
 import sys
 import time
+import warnings
 
 import numpy as np
+
+_SOURCE_SEQ = itertools.count()  # unique profiler source names per process
+
+
+def _maybe_profiler():
+    """paddle_tpu.profiler, but ONLY if the framework is already imported —
+    importing it from here would drag the framework into a tracer-free
+    serving process (canonical copy; batching.py reuses it)."""
+    if sys.modules.get('paddle_tpu') is None:
+        return None
+    try:
+        from paddle_tpu import profiler
+        return profiler
+    except Exception:
+        return None
 
 def _np_threefry_fold(seed, step):
     """fold_in(key(seed), step) raw key data with numpy only — the
@@ -205,6 +227,13 @@ class CompiledPredictor(object):
         self._feed_names = [e['name'] for e in self._sig['feeds']]
         platform = platform or os.environ.get('PTPU_PLATFORM')
         self._device = jax.devices(platform)[0] if platform else None
+        # bulk-inference loop state (run_batches): one jitted scan over the
+        # exported module; XLA caches one executable per group size
+        self._loop = None
+        self._bulk = {'dispatches': 0, 'batches': 0, 'tail_flushes': 0,
+                      'stage_s': 0.0, 'dispatch_s': 0.0, 'total_s': 0.0}
+        self._prof_name = None
+        self._artifact_dir = artifact_dir
 
     def get_input_names(self):
         return list(self._feed_names)
@@ -241,20 +270,30 @@ class CompiledPredictor(object):
         args, pad = _build_args(self._sig['feeds'], self._feed_names,
                                 inputs, allow_pad=pad_partial)
         if pad is not None:
-            for e in _fetch_entries(self._sig):
-                shape = e.get('shape')
-                if int(e.get('lod_levels', 0)) or (
-                        shape is not None
-                        and (not shape or int(shape[0]) != pad[1])):
-                    raise ValueError(
-                        "feed rows were padded %d->%d but fetch %r (shape "
-                        "%s in the signature) is not batch-aligned — its "
-                        "value would depend on the padded rows; run with "
-                        "the exact compiled batch" % (pad + (e['name'],
-                                                             shape)))
+            self._check_pad_fetches(pad)
         outs = _structure_outputs(self._sig, self._call_flat(args))
         if pad is None:
             return outs
+        return self._slice_pad(outs, pad)
+
+    def _check_pad_fetches(self, pad):
+        """Pre-dispatch rejection of row-count-dependent fetches when the
+        signature records fetch shapes (v3 exports)."""
+        for e in _fetch_entries(self._sig):
+            shape = e.get('shape')
+            if int(e.get('lod_levels', 0)) or (
+                    shape is not None
+                    and (not shape or int(shape[0]) != pad[1])):
+                raise ValueError(
+                    "feed rows were padded %d->%d but fetch %r (shape "
+                    "%s in the signature) is not batch-aligned — its "
+                    "value would depend on the padded rows; run with "
+                    "the exact compiled batch" % (pad + (e['name'],
+                                                         shape)))
+
+    def _slice_pad(self, outs, pad):
+        """Slice batch-led fetches of a padded partial batch back to the
+        caller's rows; delivery-time guard for v2 signatures."""
         rows, bucket = pad
         sliced = []
         for e, o in zip(_fetch_entries(self._sig), outs):
@@ -268,6 +307,164 @@ class CompiledPredictor(object):
                        'lod' if isinstance(o, tuple) else list(o.shape)))
             sliced.append(o[:rows])
         return sliced
+
+    # -- bulk inference: one dispatch, K batches ---------------------------
+    def _loop_jit(self):
+        """jit of a lax.scan over the exported module: each scanned step is
+        the exact per-batch program `run()` dispatches, so per-batch
+        results are bit-identical through the same bucket. Every stacked
+        input is donated — the buffers are staged copies this class owns
+        (run_batches never hands a caller-visible array to the jit), so
+        XLA may reuse them for the scan's intermediates. One jitted fn
+        serves every group size: jit compiles one executable per leading
+        dim, which is exactly the multi-bucket tail discipline."""
+        if self._loop is None:
+            import jax
+            exported = self._exported
+            nargs = sum(1 + int(e.get('lod_levels', 0))
+                        for e in self._sig['feeds'])
+
+            def loop(*stacked):
+                def body(carry, xs):
+                    return carry, tuple(exported.call(*xs))
+                _, ys = jax.lax.scan(body, (), stacked)
+                return ys
+            self._loop = jax.jit(loop,
+                                 donate_argnums=tuple(range(nargs)))
+        return self._loop
+
+    def _register_bulk_source(self):
+        if self._prof_name is not None:
+            return
+        prof = _maybe_profiler()
+        if prof is None or not hasattr(prof, 'register_infer_source'):
+            return
+        name = 'bulk_infer:%s#%d' % (
+            os.path.basename(os.path.normpath(self._artifact_dir)),
+            next(_SOURCE_SEQ))
+        # weakref, the Executor's discipline: a predictor dropped by its
+        # owner must not stay pinned (module + per-group executables) in
+        # the profiler registry forever
+        import weakref
+        ref = weakref.ref(self)
+
+        def snap():
+            pred = ref()
+            if pred is None:
+                prof.unregister_infer_source(name)
+                raise ReferenceError('predictor collected')
+            return pred.bulk_stats()
+        prof.register_infer_source(name, snap)
+        self._prof_name = name
+
+    def bulk_stats(self):
+        """Bulk-inference counters (profiler.infer_report contract):
+        dispatches, batches, batches_per_dispatch, tail_flushes,
+        host_stall_ms (staging: stacking + device transfer), occupancy
+        (device-call share of run_batches wall time)."""
+        st = self._bulk
+        d = max(st['dispatches'], 1)
+        return {'dispatches': st['dispatches'], 'batches': st['batches'],
+                'batches_per_dispatch': st['batches'] / d,
+                'tail_flushes': st['tail_flushes'],
+                'host_stall_ms': st['stage_s'] * 1e3,
+                'occupancy': (st['dispatch_s'] / st['total_s']
+                              if st['total_s'] else 0.0)}
+
+    def run_batches(self, batches, group=None, pad_partial=True):
+        """Bulk offline/eval inference: ONE device dispatch runs a
+        lax.scan over K pre-staged input batches, amortizing the fixed
+        per-dispatch cost (the ~200ms remote-tunnel round-trip floor)
+        across all K. Per-batch results are bit-identical to K sequential
+        `run()` calls through the same bucket (matmul models exactly;
+        XLA:CPU rounds conv scan bodies to ~1e-6, PERF_NOTES.md).
+
+        batches: list of K per-batch inputs, each a list (feed order) or
+        dict exactly as `run()` takes — LoD feeds as (values, offsets)
+        pairs ride the scan as stacked runtime data, dense partial
+        batches pad per-batch under `pad_partial` (run()'s discipline).
+
+        group: dispatch at most `group` batches per compiled loop;
+        the tail chunk (m < group) flushes through a smaller compiled
+        group, the multi-bucket discipline of prefetch_to_device.
+        Default: all K in one dispatch.
+
+        Returns a list of K per-batch fetch lists (run()'s structure)."""
+        t_all = time.perf_counter()
+        batches = list(batches)
+        if not batches:
+            return []
+        k = len(batches)
+        g = k if group is None else int(group)
+        if g < 1:
+            raise ValueError("run_batches: group must be >= 1, got %d" % g)
+        st = self._bulk
+        t0 = time.perf_counter()
+        flat, pads = [], []
+        for b in batches:
+            args, pad = _build_args(self._sig['feeds'], self._feed_names,
+                                    b, allow_pad=pad_partial)
+            if pad is not None:
+                self._check_pad_fetches(pad)
+            flat.append(args)
+            pads.append(pad)
+        st['stage_s'] += time.perf_counter() - t0
+        loop = self._loop_jit()
+        try:
+            return self._run_chunks(loop, flat, pads, k, g)
+        finally:
+            # total accrues even when a chunk raises mid-call: dispatched
+            # chunks' stage/dispatch seconds were already committed, and
+            # occupancy (dispatch_s / total_s) must stay <= 1
+            st['total_s'] += time.perf_counter() - t_all
+            self._register_bulk_source()
+
+    def _run_chunks(self, loop, flat, pads, k, g):
+        import jax
+        st = self._bulk
+        results = []
+        for off in range(0, k, g):
+            chunk = flat[off:off + g]
+            m = len(chunk)
+            t0 = time.perf_counter()
+            # np.stack materializes fresh host buffers (even for device-
+            # array inputs), so the donated arrays below are ours alone
+            stacked = [np.stack([c[j] for c in chunk])
+                       for j in range(len(chunk[0]))]
+            if self._device is not None:
+                stacked = [jax.device_put(a, self._device) for a in stacked]
+            else:
+                stacked = [jax.device_put(a) for a in stacked]
+            for a in stacked:
+                a.block_until_ready()
+            t1 = time.perf_counter()
+            with warnings.catch_warnings():
+                # backends without donation support (XLA:CPU) warn per
+                # compile; the fallback is a copy, not a correctness issue
+                warnings.filterwarnings(
+                    'ignore', message='Some donated buffers were not usable')
+                if self._device is not None:
+                    with jax.default_device(self._device):
+                        ys = loop(*stacked)
+                else:
+                    ys = loop(*stacked)
+                ys = [np.asarray(y) for y in ys]  # ONE sync per dispatch
+            t2 = time.perf_counter()
+            st['dispatches'] += 1
+            st['batches'] += m
+            if m < g and off > 0:
+                # a genuine tail: full chunks preceded this smaller one —
+                # a single sub-group call (k < group) compiles only its
+                # own size and is not a tail flush
+                st['tail_flushes'] += 1
+            st['stage_s'] += t1 - t0
+            st['dispatch_s'] += t2 - t1
+            for i in range(m):
+                outs = _structure_outputs(self._sig, [y[i] for y in ys])
+                pad = pads[off + i]
+                results.append(outs if pad is None
+                               else self._slice_pad(outs, pad))
+        return results
 
 
 def load_compiled(artifact_dir):
@@ -439,9 +636,60 @@ def _bench_cli(argv):
     return 0
 
 
+def _feed_from_npz(sig_feeds, raw, index=None):
+    """Rebuild one feed dict from npz arrays ('<name>' plus
+    '<name>.lod<i>' offsets for LoD feeds); with `index`, slice batch
+    `index` out of arrays stacked over a leading K axis."""
+    feed = {}
+    for e in sig_feeds:
+        n, levels = e['name'], int(e.get('lod_levels', 0))
+        pick = (lambda a: a[index]) if index is not None else (lambda a: a)
+        if levels:
+            feed[n] = (pick(raw[n]), [pick(raw['%s.lod%d' % (n, i)])
+                                      for i in range(levels)])
+        else:
+            feed[n] = pick(raw[n])
+    return feed
+
+
+def _loop_cli(argv):
+    # serve.py loop ARTIFACT_DIR IN.npz OUT.npz [GROUP]
+    # IN.npz arrays carry a leading K batch axis (LoD feeds as '<name>'
+    # [K, rows, ...] plus '<name>.lod<i>' [K, n] offsets); all K batches
+    # run through run_batches — ONE compiled dispatch per group — and
+    # OUT.npz holds each fetch stacked over the same K axis.
+    if len(argv) not in (5, 6):
+        print("usage: serve.py loop ARTIFACT_DIR IN.npz OUT.npz [GROUP]",
+              file=sys.stderr)
+        return 2
+    artifact_dir, in_path, out_path = argv[2:5]
+    group = int(argv[5]) if len(argv) == 6 else None
+    pred = CompiledPredictor(artifact_dir)
+    with np.load(in_path) as data:
+        raw = {k: data[k] for k in data.files}
+    k = int(next(iter(raw.values())).shape[0])
+    batches = [_feed_from_npz(pred._sig['feeds'], raw, index=i)
+               for i in range(k)]
+    results = pred.run_batches(batches, group=group)
+    save = {}
+    for j, n in enumerate(pred.get_output_names()):
+        outs = [r[j] for r in results]
+        if isinstance(outs[0], tuple):
+            save[n] = np.stack([o[0] for o in outs])
+            for i in range(len(outs[0][1])):
+                save['%s.lod%d' % (n, i)] = np.stack([o[1][i]
+                                                      for o in outs])
+        else:
+            save[n] = np.stack(outs)
+    np.savez(out_path, **save)
+    return 0
+
+
 def main(argv):
     if len(argv) >= 2 and argv[1] == 'bench':
         return _bench_cli(argv)
+    if len(argv) >= 2 and argv[1] == 'loop':
+        return _loop_cli(argv)
     if len(argv) >= 2 and argv[1] == 'train':
         # serve.py train ARTIFACT_DIR FEEDS.npz OUT.npz STEPS [CKPT.npz]
         # runs STEPS train steps on the (fixed) feeds; OUT.npz holds each
@@ -463,6 +711,7 @@ def main(argv):
         return 0
     if len(argv) != 4:
         print("usage: serve.py ARTIFACT_DIR IN.npz OUT.npz\n"
+              "       serve.py loop ARTIFACT_DIR IN.npz OUT.npz [GROUP]\n"
               "       serve.py train ARTIFACT_DIR FEEDS.npz OUT.npz STEPS "
               "[CKPT.npz]\n"
               "       serve.py bench ARTIFACT_DIR IN.npz N_REQUESTS "
@@ -473,14 +722,7 @@ def main(argv):
     with np.load(in_path) as data:
         raw = {k: data[k] for k in data.files}
     # LoD feeds ride npz as '<name>' plus '<name>.lod<i>' offset arrays
-    feed = {}
-    for e in pred._sig['feeds']:
-        n, levels = e['name'], int(e.get('lod_levels', 0))
-        if levels:
-            feed[n] = (raw[n], [raw['%s.lod%d' % (n, i)]
-                                for i in range(levels)])
-        else:
-            feed[n] = raw[n]
+    feed = _feed_from_npz(pred._sig['feeds'], raw)
     outs = pred.run(feed)
     save = {}
     for n, o in zip(pred.get_output_names(), outs):

@@ -104,6 +104,20 @@ def _conv3d_transpose(ctx, ins):
 # ---------------------------------------------------------------------------
 # pooling
 # ---------------------------------------------------------------------------
+def ceil_mode_pads(spatial, ksize, strides, pads):
+    """Per-spatial-dim (lo, hi) padding implementing pool ceil_mode: the
+    high side grows so the last (partial) window is kept instead of
+    dropped — output dims become ceil((in + 2p - k) / s) + 1. Shared by
+    the graph lowering below and imperative.Pool2D."""
+    out = []
+    for i in range(len(ksize)):
+        in_sz = spatial[i] + 2 * pads[i]
+        rem = (in_sz - ksize[i]) % strides[i]
+        out.append((pads[i],
+                    pads[i] + (strides[i] - rem if rem else 0)))
+    return out
+
+
 def _pool(ctx, ins, nd):
     x = X(ins)
     ptype = ctx.attr('pooling_type', 'max')
@@ -119,11 +133,7 @@ def _pool(ctx, ins, nd):
     strides_full = (1, 1) + tuple(strides)
     pad_full = [(0, 0), (0, 0)] + [(p, p) for p in pads]
     if ctx.attr('ceil_mode', False):
-        for i in range(nd):
-            in_sz = x.shape[2 + i] + 2 * pads[i]
-            rem = (in_sz - ksize[i]) % strides[i]
-            if rem:
-                pad_full[2 + i] = (pads[i], pads[i] + strides[i] - rem)
+        pad_full[2:] = ceil_mode_pads(x.shape[2:], ksize, strides, pads)
     if ptype == 'max':
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         out = jax.lax.reduce_window(x, init, jax.lax.max, window,
@@ -131,11 +141,17 @@ def _pool(ctx, ins, nd):
     else:
         s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides_full,
                                   pad_full)
-        if ctx.attr('exclusive', True) and any(pads):
+        # count windows' REAL elements when any padding exists — including
+        # ceil_mode's high-side extension (pads alone misses it)
+        if ctx.attr('exclusive', True) and any(lo or hi
+                                               for lo, hi in pad_full[2:]):
             ones = jnp.ones(x.shape, x.dtype)
             cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
                                         strides_full, pad_full)
-            out = s / cnt
+            # clamp: a window entirely inside padding (ceil_mode with
+            # stride > kernel) counts 0 real elements — 0/0 would NaN;
+            # clamped it yields the finite 0 the pre-ceil path produced
+            out = s / jnp.maximum(cnt, 1.0)
         else:
             out = s / float(np.prod(ksize))
     return {'Out': [out]}

@@ -55,6 +55,8 @@ def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
         serving_report()
     if _training_sources:
         training_report()
+    if _infer_sources:
+        infer_report()
     print("[paddle_tpu.profiler] device trace written to %s "
           "(open with TensorBoard / Perfetto); host events: "
           "export_chrome_tracing(path)" % _trace_dir)
@@ -184,6 +186,54 @@ def training_report():
                   (name[:32], s.get('dispatches', 0), s.get('steps', 0),
                    s.get('steps_per_dispatch', 0.0),
                    s.get('tail_flushes', 0), s.get('host_stall_ms', 0.0)))
+    return out
+
+
+# -- bulk-inference dispatch metrics -----------------------------------------
+# Bulk-inference loops (serve.CompiledPredictor.run_batches, and Executors
+# driving Predictor.run_batches) register a zero-arg snapshot callable
+# here; infer_report() renders per-dispatch batch counts, tail flushes,
+# host staging time, and device occupancy (device-call share of the bulk
+# call's wall time — absent for async executor-side sources), and
+# stop_profiler appends the same table to the report.
+_infer_sources = {}
+
+
+def register_infer_source(name, snapshot):
+    """Register a bulk-inference metrics source: `snapshot()` -> dict with
+    dispatches, batches, batches_per_dispatch, tail_flushes,
+    host_stall_ms, and optionally occupancy (the contract of
+    serve.CompiledPredictor.bulk_stats)."""
+    _infer_sources[name] = snapshot
+
+
+def unregister_infer_source(name):
+    _infer_sources.pop(name, None)
+
+
+def infer_report():
+    """Print bulk-inference dispatch metrics for every registered source
+    and return them as {source name: snapshot dict}."""
+    out = {}
+    rows = []
+    for name in sorted(_infer_sources):
+        try:
+            snap = _infer_sources[name]()
+        except Exception:
+            continue  # a collected predictor must not break the report
+        out[name] = snap
+        rows.append((name, snap))
+    if rows:
+        print("%-32s %10s %8s %10s %6s %10s %5s" %
+              ('Bulk-infer source', 'dispatches', 'batches', 'batch/disp',
+               'tails', 'stage(ms)', 'occ'))
+        for name, s in rows:
+            occ = s.get('occupancy')
+            print("%-32s %10d %8d %10.2f %6d %10.2f %5s" %
+                  (name[:32], s.get('dispatches', 0), s.get('batches', 0),
+                   s.get('batches_per_dispatch', 0.0),
+                   s.get('tail_flushes', 0), s.get('host_stall_ms', 0.0),
+                   ('%.2f' % occ) if occ is not None else '-'))
     return out
 
 

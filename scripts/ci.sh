@@ -20,11 +20,15 @@ python -m pytest tests/ -q -m 'not slow'
 echo "== multi-step dispatch smoke (CPU, K=4 smallnet + fc dispatch A/B) =="
 PTPU_PLATFORM=cpu python scripts/multi_step_smoke.py
 
+echo "== bulk-inference loop smoke (CPU, run_batches bit-identity + >=3x dispatch A/B) =="
+PTPU_PLATFORM=cpu python scripts/infer_loop_smoke.py
+
 echo "== slow tier (threaded stress, Poisson serving scenario) =="
 python -m pytest tests/ -q -m slow
 
-echo "== bench smoke (tiny config) =="
+echo "== bench smoke (tiny config; device-time off: XLA:CPU runs conv scan bodies ~10x slower) =="
 PTPU_BENCH_ONLY=resnet PTPU_BENCH_BATCH=16 PTPU_BENCH_STEPS=3 \
+PTPU_BENCH_DEVICE_TIME=0 \
 PTPU_PLATFORM=cpu python bench.py
 
 echo "== serving bench smoke (serve.py bench on a tiny artifact) =="

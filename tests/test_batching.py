@@ -437,7 +437,7 @@ def test_bench_poisson_serving_scenario(monkeypatch):
     line = bench._bench_image_serving(
         'smoke_serving_img_s', lambda images: fluid.layers.fc(
             images, 4, act='softmax'),
-        'SMOKE', 1.0, 'self', 'tiny smoke', dshape=(DIM,))
+        'SMOKE', 1.0, 'self', dshape=(DIM,))
     assert line['metric'] == 'smoke_serving_img_s'
     assert line['value'] > 0
     assert line['p99_ms'] >= line['p50_ms'] > 0
