@@ -127,6 +127,23 @@ def _summary_line(lines):
         for l in lines}}
 
 
+def _pass_ops(program, fetch):
+    """[op count before, after] the optimization pass pipeline
+    (paddle_tpu/passes: verify, constant_fold, dead_op_elimination,
+    fuse_activation) rooted at this bench's fetch target — so pass
+    effectiveness rides in the perf trajectory next to throughput.
+    None when the pipeline declines (never fails the metric)."""
+    try:
+        from paddle_tpu import passes
+        name = fetch if isinstance(fetch, str) else fetch.name
+        before = sum(len(b.ops) for b in program.blocks)
+        opt, _ = passes.apply_optimization_pipeline(program,
+                                                    fetch_names=[name])
+        return [before, sum(len(b.ops) for b in opt.blocks)]
+    except Exception:
+        return None
+
+
 def is_transient(exc):
     msg = str(exc)
     return any(m in msg for m in TRANSIENT_MARKERS)
@@ -326,7 +343,8 @@ def _bench_image_train(metric, build, batch, steps, flops_per_img,
     line = _line(metric, img_s, 'img/s', img_s / baseline_img_s,
                  mfu=round(mfu, 4) if mfu is not None else None,
                  dtype='bf16' if use_bf16 else 'fp32', batch=batch,
-                 baseline_ref=baseline_ref)
+                 baseline_ref=baseline_ref,
+                 pass_ops=_pass_ops(main_p, loss))
     return _attach_device_time(line, lambda: _device_ms_scan(
         exe, main_p, feed, loss, _device_k(device_k)))
 
@@ -393,7 +411,8 @@ def bench_transformer():
     line = _line('transformer_base_tokens_s_per_chip', tok_s, 'tokens/s',
                  tok_s / base_tok_s,
                  mfu=round(mfu, 4) if mfu is not None else None, dtype='bf16',
-                 batch=batch, seq_len=seq_len, baseline_ref='flops_eq_xeon')
+                 batch=batch, seq_len=seq_len, baseline_ref='flops_eq_xeon',
+                 pass_ops=_pass_ops(main_p, loss))
     return _attach_device_time(line, lambda: _device_ms_scan(
         exe, main_p, feed, loss, _device_k(8)))
 
@@ -451,7 +470,8 @@ def bench_bert():
                  tok_s / base_tok_s,
                  mfu=round(mfu, 4) if mfu is not None else None, dtype='bf16',
                  batch=batch, seq_len=seq_len, grad_merge_k=k_merge,
-                 baseline_ref='flops_eq_xeon')
+                 baseline_ref='flops_eq_xeon',
+                 pass_ops=_pass_ops(main_p, loss))
     return _attach_device_time(line, lambda: _device_ms_scan(
         exe, main_p, feed, loss, _device_k(8)))
 
@@ -689,7 +709,8 @@ def bench_ocr():
 
     dt = _timed_steps(exe, main_p, feed, avg_cost, steps, warmup=3)
     line = _line('ocr_crnn_img_s_per_chip', batch * steps / dt, 'img/s',
-                 1.0, dtype='bf16', batch=batch, baseline_ref='self')
+                 1.0, dtype='bf16', batch=batch, baseline_ref='self',
+                 pass_ops=_pass_ops(main_p, avg_cost))
     return _attach_device_time(line, lambda: _device_ms_scan(
         exe, main_p, feed, avg_cost, _device_k(8)))
 
@@ -724,7 +745,7 @@ def bench_smallnet():
     base_ms = 33.113 * batch / 256.0
     line = _line('smallnet_cifar_ms_batch', ms_batch, 'ms/batch',
                  base_ms / ms_batch, dtype='bf16', batch=batch,
-                 baseline_ref='k40m')
+                 baseline_ref='k40m', pass_ops=_pass_ops(main_p, loss))
     return _attach_device_time(line, lambda: _device_ms_scan(
         exe, main_p, feed, loss, _device_k(16)))
 
@@ -767,7 +788,8 @@ def bench_stacked_lstm():
     line = _line('stacked_lstm_text_cls_ms_batch', ms_batch, 'ms/batch',
                  base_ms / ms_batch,
                  mfu=round(mfu, 4) if mfu is not None else None,
-                 dtype='bf16', batch=batch, baseline_ref='k40m')
+                 dtype='bf16', batch=batch, baseline_ref='k40m',
+                 pass_ops=_pass_ops(main_p, loss))
     return _attach_device_time(line, lambda: _device_ms_scan(
         exe, main_p, feed, loss, _device_k(8)))
 
@@ -968,7 +990,7 @@ def bench_ctr():
     line = _line(
         'ctr_deepfm_samples_s_per_chip', samples_s, 'samples/s', vs,
         mfu=round(mfu, 6) if mfu is not None else None, batch=batch,
-        baseline_ref=base)
+        baseline_ref=base, pass_ops=_pass_ops(main_p, loss))
     return _attach_device_time(line, lambda: _device_ms_scan(
         exe, main_p, feed, loss, _device_k(8)))
 

@@ -46,6 +46,8 @@ from .io import (save_vars, save_params, save_persistables, load_vars,
                  load_params, load_persistables, save_inference_model,
                  load_inference_model)
 from . import core
+from . import passes
+from .passes import ProgramVerifyError
 from . import contrib
 from . import imperative
 from . import inference

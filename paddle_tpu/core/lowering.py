@@ -80,6 +80,21 @@ class OpCtx(object):
         return env
 
 
+class _FusedActOp(object):
+    """Shadow op handed to an activation lowering when it runs fused into
+    its producer (fuse_act attr): carries the activation's original attrs
+    plus the producer's uid for any rng bookkeeping."""
+
+    __slots__ = ('type', 'attrs', 'inputs', 'outputs')
+
+    def __init__(self, act_type, act_attrs, producer):
+        self.type = act_type
+        self.attrs = dict(act_attrs)
+        self.attrs.setdefault('_op_uid', producer.attrs.get('_op_uid', 0))
+        self.inputs = {}
+        self.outputs = {}
+
+
 class Tracer(object):
     """Walks blocks, maintaining env: var name -> traced value."""
 
@@ -147,11 +162,37 @@ class Tracer(object):
                 ins = {slot: [unwrap(v) for v in vals]
                        for slot, vals in ins.items()}
         outs = d.lower(ctx, ins)
+        if op.attrs.get('fuse_act'):
+            outs = self._apply_fused_act(op, block, outs)
         if (d.lod_mode == 'pass' and src_la is not None and outs):
             outs = {slot: [self._maybe_wrap(v, src_la, src_rows)
                            for v in vals] if vals is not None else None
                     for slot, vals in outs.items()}
         self._scatter_outputs(op, outs)
+
+    def _apply_fused_act(self, op, block, outs):
+        """Apply a pass-fused activation (passes/fuse_act.py) to the
+        producer's primary output, inside the same traced expression:
+        the activation's own registered lowering runs on the slot value,
+        so fused and unfused programs are bit-identical."""
+        act = op.attrs['fuse_act']
+        slot = op.attrs.get('fuse_act_slot', 'Out')
+        d = registry.get(act)
+        if d is None:
+            raise TraceError(
+                "op %s carries fuse_act=%r but no lowering is registered "
+                "for that activation" % (op, act))
+        vals = (outs or {}).get(slot)
+        if not vals or vals[0] is None:
+            raise TraceError(
+                "op %s carries fuse_act=%r but produced no value in slot "
+                "%r to activate" % (op, act, slot))
+        shadow = _FusedActOp(act, op.attrs.get('fuse_act_attrs', {}), op)
+        ctx = OpCtx(self, shadow, block)
+        acted = d.lower(ctx, {'X': [unwrap(vals[0])]})['Out'][0]
+        outs = dict(outs)
+        outs[slot] = [acted] + list(vals[1:])
+        return outs
 
     @staticmethod
     def _maybe_wrap(v, src_la, rows):

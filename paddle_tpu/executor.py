@@ -39,6 +39,7 @@ def _fetch_name(f):
 
 
 _analysis_cache = {}
+_verify_cache = {}
 _entropy_seed = None
 
 
@@ -123,6 +124,28 @@ def _process_entropy():
     return _entropy_seed
 
 
+def _verify_before_run(program, feed_names, fetch_names):
+    """Fast static lint before the analysis cache (passes/verifier.py):
+    warn-only by default — one RuntimeWarning per (program epoch, feed,
+    fetch) signature — while PTPU_STRICT_VERIFY=1 raises
+    ProgramVerifyError instead of letting the tracer fail opaquely."""
+    from .passes import verifier as _verifier
+    key = (program._uid, program._build_epoch,
+           frozenset(feed_names), tuple(fetch_names))
+    errs = _verify_cache.get(key)
+    if errs is None:
+        for k in [k for k in _verify_cache
+                  if k[0] == program._uid and k[1] != program._build_epoch]:
+            del _verify_cache[k]
+        diags = _verifier.verify_program(program, feed_names=feed_names,
+                                         fetch_names=fetch_names,
+                                         level='fast')
+        errs = [d for d in diags if d.level == 'error']
+        _verify_cache[key] = errs
+    if errs:
+        _verifier.maybe_raise_or_warn(errs, warned_key=key)
+
+
 def _program_analysis(program):
     """(persistable names, persistable∩written) — memoized per build epoch."""
     key = (program._uid, program._build_epoch,
@@ -171,17 +194,19 @@ class Executor(object):
             fetch_var_name='fetch', scope=None, return_numpy=True,
             use_program_cache=True):
         program = program if program is not None else default_main_program()
-        mesh = None
-        if hasattr(program, '_ptpu_compiled_program'):
-            compiled = program
-            mesh = compiled._get_mesh(self)
-            program = compiled._program
-        scope = scope if scope is not None else global_scope()
-        feed = feed or {}
         fetch_list = fetch_list or []
         if isinstance(fetch_list, (Variable, str)):
             fetch_list = [fetch_list]
         fetch_names = [_fetch_name(f) for f in fetch_list]
+        mesh = None
+        if hasattr(program, '_ptpu_compiled_program'):
+            compiled = program
+            mesh = compiled._get_mesh(self)
+            # the pass-optimized clone for THIS fetch set (memoized);
+            # falls back to the raw program if the pipeline declines
+            program = compiled._optimized_program(fetch_names)
+        scope = scope if scope is not None else global_scope()
+        feed = feed or {}
 
         feed_vals = {}
         for name, value in feed.items():
@@ -196,6 +221,10 @@ class Executor(object):
                     if n not in feed_vals:
                         feed_vals[n] = self._to_device_value(
                             v, self._feed_var(program, n))
+
+        # static lint (warn-only; PTPU_STRICT_VERIFY=1 raises) before the
+        # analysis cache — malformed programs fail loudly at build time
+        _verify_before_run(program, set(feed_vals), fetch_names)
 
         # persistable state present in scope
         state, persist_written, out_state_names = self._gather_state(
@@ -346,6 +375,8 @@ class Executor(object):
         feed_vals, k, want = self._gather_step_group(program, reader, feed,
                                                      steps)
         stall = _time.perf_counter() - t0
+
+        _verify_before_run(program, set(feed_vals), fetch_names)
 
         state, persist_written, out_state_names = self._gather_state(
             program, scope)

@@ -94,8 +94,9 @@ def export_compiled(predictor, sample_inputs, out_dir, batch_sizes=None):
     missing = [n for n in feed_names if n not in sample]
     if missing:
         raise ValueError("sample_inputs missing feeds: %r" % missing)
+    program = _optimize_for_export(predictor)
     if batch_sizes is None:
-        return _export_single(predictor, sample, out_dir)
+        return _export_single(predictor, sample, out_dir, program=program)
 
     sizes = sorted({int(b) for b in batch_sizes})
     if not sizes or sizes[0] < 1:
@@ -125,7 +126,8 @@ def export_compiled(predictor, sample_inputs, out_dir, batch_sizes=None):
         resized = {n: np.resize(a, (b,) + a.shape[1:])
                    for n, a in arrs.items()}
         _export_single(predictor, resized,
-                       os.path.join(out_dir, _BUCKET_DIR % b))
+                       os.path.join(out_dir, _BUCKET_DIR % b),
+                       program=program)
     # top level mirrors the LARGEST bucket so CompiledPredictor(out_dir)
     # keeps working unchanged on a multi-bucket dir
     top = os.path.join(out_dir, _BUCKET_DIR % sizes[-1])
@@ -144,7 +146,33 @@ def export_compiled(predictor, sample_inputs, out_dir, batch_sizes=None):
     return out_dir
 
 
-def _export_single(predictor, sample, out_dir):
+def _optimize_for_export(predictor):
+    """Run the optimization pass pipeline (paddle_tpu/passes/) on the
+    predictor's program before lowering: constant chains fold, dead
+    branches drop, activations fuse into their producers — the exported
+    StableHLO traces the optimized graph. Falls back to the raw program
+    if the pipeline declines (export must never fail on an optimizer
+    bug); strict-verify errors (PTPU_STRICT_VERIFY=1) propagate."""
+    from .. import passes
+    program = predictor._program
+    try:
+        program, _ = passes.apply_inference_pipeline(
+            program,
+            fetch_names=[v.name for v in predictor._fetch_vars
+                         if v is not None],
+            feed_names=list(predictor._feed_names))
+    except passes.ProgramVerifyError:
+        raise
+    except Exception as e:
+        import warnings
+        warnings.warn(
+            "export optimization pipeline failed (%s: %s); exporting the "
+            "unoptimized program" % (type(e).__name__, e), RuntimeWarning)
+        program = predictor._program
+    return program
+
+
+def _export_single(predictor, sample, out_dir, program=None):
     """One fixed-shape export (the original export_compiled body);
     `sample` is a {feed name: value} dict covering every feed."""
     import jax
@@ -152,7 +180,8 @@ def _export_single(predictor, sample, out_dir):
     from ..core.lowering import Tracer
     from ..core.lod import LoDArray
 
-    program = predictor._program
+    if program is None:
+        program = _optimize_for_export(predictor)
     feed_names = list(predictor._feed_names)
     fetch_names = [v.name for v in predictor._fetch_vars]
 

@@ -168,6 +168,8 @@ def load_reference_inference_model(dirname, executor=None,
             scope.set(v.name, jnp.asarray(arr) if not lod
                       else LoDArray(jnp.asarray(arr), lod))
     feed_names, fetch_names = _feed_fetch_from_program(program)
+    program._feed_names = list(feed_names)
+    program._fetch_names = list(fetch_names)
     fetch_vars = [program.global_block()._find_var_recursive(n)
                   for n in fetch_names]
     return program, feed_names, fetch_vars

@@ -159,7 +159,7 @@ def _var_to_dict(v):
             'persistable': v.persistable, 'stop_gradient': v.stop_gradient,
             'is_parameter': isinstance(v, Parameter),
             'trainable': getattr(v, 'trainable', True),
-            'type': v.type}
+            'type': v.type, 'is_data': getattr(v, 'is_data', False)}
 
 
 def _attr_jsonable(a):
@@ -207,7 +207,8 @@ def program_from_dict(d):
                              lod_level=vd.get('lod_level', 0),
                              persistable=vd.get('persistable', False),
                              stop_gradient=vd.get('stop_gradient', False),
-                             type=vd.get('type', 'lod_tensor'))
+                             type=vd.get('type', 'lod_tensor'),
+                             is_data=vd.get('is_data', False))
             b.vars[vd['name']] = v
         for od in bd['ops']:
             b.ops.append(Operator(b, od['type'], od['inputs'], od['outputs'],
@@ -407,19 +408,20 @@ def load_persistables(executor, dirname, main_program=None, filename=None):
 # write __model__ + params
 # ---------------------------------------------------------------------------
 def prune_program(program, feed_names, fetch_names):
-    """Keep only ops needed to compute fetch from feed (ref framework/prune.cc)."""
+    """Keep only ops needed to compute fetch from feed (ref
+    framework/prune.cc) — the passes subsystem's dead_op_elimination in
+    export mode: liveness rooted at the fetches only (optimizer/metric
+    branches drop), feed/fetch ops stripped, vars left intact for the
+    serializer. Sub-block closure reads are honored, which the old
+    hand-rolled walk here missed."""
+    from .passes.base import PassContext, PassReport
+    from .passes.dce import DeadOpEliminationPass
     pruned = program.clone(for_test=True)
-    block = pruned.global_block()
-    needed = set(fetch_names)
-    keep = []
-    for op in reversed(block.ops):
-        if op.type in ('feed', 'fetch'):
-            continue
-        if any(o in needed for o in op.output_arg_names()):
-            keep.append(op)
-            needed.update(n for n in op.input_arg_names() if n)
-    keep.reverse()
-    block.ops = keep
+    dce = DeadOpEliminationPass(keep_persistable_writers=False,
+                                feed_fetch='drop', prune_vars=False)
+    dce.run_on_program(pruned, PassContext(fetch_names=fetch_names,
+                                           feed_names=feed_names),
+                       PassReport(dce.name))
     return pruned
 
 
@@ -453,6 +455,10 @@ def load_inference_model(dirname, executor, model_filename=None,
     program = program_from_dict(d)
     load_persistables(executor, dirname, program, params_filename)
     feed_names = d.get('feed_names', [])
+    # carried on the program so the verifier/pass pipelines know the run
+    # boundary without being handed it explicitly
+    program._feed_names = list(feed_names)
+    program._fetch_names = list(d.get('fetch_names', []))
     fetch_vars = [program.global_block().var(n)
                   for n in d.get('fetch_names', [])]
     return program, feed_names, fetch_vars

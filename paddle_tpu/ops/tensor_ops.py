@@ -74,7 +74,7 @@ def _assign(ctx, ins):
 def _assign_value(ctx, ins):
     dt = _np_dtype(ctx.attr('dtype'))
     shape = ctx.attr('shape')
-    if jnp.issubdtype(dt, jnp.integer):
+    if jnp.issubdtype(dt, jnp.integer) or dt == jnp.bool_:
         vals = ctx.attr('int32_values') or ctx.attr('int64_values')
     else:
         vals = ctx.attr('fp32_values')

@@ -60,6 +60,8 @@ class CompiledProgram(object):
         self._build_strategy = None
         self._exec_strategy = None
         self._places = None
+        self._opt_cache = {}      # (uid, epoch, fetch sig) -> program
+        self._pass_reports = None  # reports from the latest pipeline run
 
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, share_vars_from=None,
@@ -75,6 +77,40 @@ class CompiledProgram(object):
 
     def with_inference_optimize(self, config=None):
         return self
+
+    def _optimized_program(self, fetch_names):
+        """The pass-optimized clone of the wrapped program for this fetch
+        set (passes/: verify, constant_fold, dead_op_elimination,
+        fuse_activation), memoized per program build epoch. The fetch set
+        keys the cache because dead-op elimination roots liveness in it —
+        fetching a different metric later builds its own clone. Any
+        pipeline failure falls back to the raw program: an optimization
+        layer must never make a runnable program unrunnable."""
+        src = self._program
+        key = (src._uid, src._build_epoch,
+               tuple(sorted(fetch_names or ())))
+        hit = self._opt_cache.get(key)
+        if hit is not None:
+            return hit
+        self._opt_cache = {k: v for k, v in self._opt_cache.items()
+                           if k[0] == src._uid and k[1] == src._build_epoch}
+        try:
+            from .. import passes
+            prog, reports = passes.apply_optimization_pipeline(
+                src, fetch_names=list(fetch_names or ()))
+            self._pass_reports = reports
+        except Exception as e:
+            from ..passes.verifier import ProgramVerifyError
+            if isinstance(e, ProgramVerifyError):
+                raise  # strict verify: fail loudly, never fall back
+            import warnings
+            warnings.warn(
+                "optimization pipeline failed (%s: %s); running the "
+                "unoptimized program" % (type(e).__name__, e),
+                RuntimeWarning)
+            prog = src
+        self._opt_cache[key] = prog
+        return prog
 
     def _get_mesh(self, executor):
         if not self._is_data_parallel:

@@ -1,0 +1,89 @@
+"""Program pass & lint subsystem (ref: paddle/fluid/framework/ir/).
+
+The reference rewrites its graph through a registry of C++ IR passes
+ordered by build_strategy; here the same layer operates directly on
+Program/Block (framework.py is the IR). Four passes ship today:
+
+  verify_program        static lint: undefined inputs, use-before-def,
+                        unregistered ops, dangling sub-blocks,
+                        unreachable fetch targets, registry shape/dtype
+                        consistency (error/warn diagnostics)
+  constant_fold         host-evaluate compile-time-constant chains and
+                        splice literal vars (IEEE-exact ops only)
+  dead_op_elimination   backward liveness from fetch targets +
+                        persistables; subsumes io.prune_program
+  fuse_activation       merge elementwise activations into conv/mul/
+                        elementwise_add producers (tracer applies the
+                        act lowering in the same expression)
+
+Consumers: Executor runs a fast warn-only verify per program epoch
+(PTPU_STRICT_VERIFY=1 raises), CompiledProgram and export_compiled run
+the optimization pipeline before lowering, InferenceTranspiler.transpile
+and memory_optimize are thin calls into PassManager.
+
+    import paddle_tpu as fluid
+    prog, reports = fluid.passes.apply_optimization_pipeline(
+        main_prog, fetch_names=[loss.name])
+    for r in reports:
+        print(r)   # PassReport(dead_op_elimination: ops 87->71 ...)
+"""
+from __future__ import annotations
+
+from .base import (Pass, PassContext, PassManager, PassReport,
+                   register_pass, create_pass, get_pass_class,
+                   registered_passes)
+from .verifier import (VerifyProgramPass, Diagnostic, ProgramVerifyError,
+                       verify_program)
+from .dce import DeadOpEliminationPass
+from .const_fold import ConstantFoldPass
+from .fuse_act import FuseActivationPass
+
+# constant_fold runs first so dead_op_elimination sweeps the literal
+# producers whose consumers folded; fuse_activation last, on the final
+# op list. verify_program leads: fail loudly before rewriting garbage.
+OPTIMIZATION_PIPELINE = ('verify_program', 'constant_fold',
+                         'dead_op_elimination', 'fuse_activation')
+
+# same ordered passes, but dead-op elimination roots liveness at the
+# FETCHES ONLY (keep_persistable_writers=False): an inference program has
+# no optimizer, and a train-derived clone handed to the inference
+# pipeline sheds its whole training cone (grad ops, optimizer writes) —
+# reference InferenceTranspiler semantics. The configured instance sits
+# in the tuple so PassManager(INFERENCE_PIPELINE) reproduces exactly
+# what apply_inference_pipeline runs.
+INFERENCE_PIPELINE = ('verify_program', 'constant_fold',
+                      DeadOpEliminationPass(keep_persistable_writers=False),
+                      'fuse_activation')
+
+
+def pipeline_names(pipeline):
+    """Names of a pipeline's entries (str entries pass through)."""
+    return [p if isinstance(p, str) else p.name for p in pipeline]
+
+
+def _disabled():
+    import os
+    return os.environ.get('PTPU_DISABLE_PASSES', '') == '1'
+
+
+def apply_optimization_pipeline(program, fetch_names=None, feed_names=None,
+                                inplace=False):
+    """Run the standard optimization pipeline; returns (program, reports).
+    PTPU_DISABLE_PASSES=1 short-circuits to the input program."""
+    if _disabled():
+        return program, []
+    return PassManager(OPTIMIZATION_PIPELINE).apply(
+        program, fetch_names=fetch_names, feed_names=feed_names,
+        inplace=inplace)
+
+
+def apply_inference_pipeline(program, fetch_names=None, feed_names=None,
+                             inplace=False):
+    """Inference-program variant: liveness roots at the fetches only, so
+    a train-derived program sheds grads/optimizer. Do not point this at a
+    program you still intend to train."""
+    if _disabled():
+        return program, []
+    return PassManager(INFERENCE_PIPELINE).apply(
+        program, fetch_names=fetch_names, feed_names=feed_names,
+        inplace=inplace)

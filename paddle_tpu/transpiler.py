@@ -10,7 +10,10 @@ did); get_pserver_program() returns an empty no-op program since no separate
 parameter-server process exists.
 
 memory_optimize/release_memory (ref memory_optimization_transpiler.py:491)
-are no-op API shims: XLA's buffer assignment owns memory reuse.
+keep the "XLA owns buffer reuse" split: no var-reuse rewriting happens
+here, but both now run the passes subsystem's dead_op_elimination and
+return its report. InferenceTranspiler.transpile runs the full inference
+pass pipeline (paddle_tpu/passes/) in place.
 """
 from __future__ import annotations
 
@@ -71,21 +74,59 @@ class DistributeTranspiler(object):
 
 
 def memory_optimize(input_program, skip_opt_set=None, print_log=False,
-                    level=0, skip_grads=False):
-    """No-op shim: XLA buffer assignment performs liveness-based reuse."""
-    return None
+                    level=0, skip_grads=False, fetch_list=None):
+    """Dead-op elimination over `input_program` (in place).
+
+    Buffer REUSE stays with XLA: its liveness-based buffer assignment
+    subsumes the reference's var-reuse rewrite
+    (memory_optimization_transpiler.py:491), so no var renaming happens
+    here. What this call now does do is run the passes subsystem's
+    dead_op_elimination — ops that can reach neither a fetch target nor a
+    persistable write are dropped before tracing — and return its
+    PassReport (ops/vars removed) instead of silently returning None.
+
+    fetch_list: optional fetch Variables/names. Without it only vars
+    feeding literally nothing are prunable (any terminal var is a
+    potential fetch target); with it, liveness roots at the fetches, the
+    reference's skip_opt_set discipline.
+    """
+    from .framework import Variable
+    from .passes import PassManager
+    fetch_names = None
+    if fetch_list is not None:
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in fetch_list]
+    _, reports = PassManager(['dead_op_elimination']).apply(
+        input_program, fetch_names=fetch_names,
+        preserve=skip_opt_set, inplace=True)
+    report = reports[0]
+    if print_log:
+        print(report)
+    return report
 
 
 def release_memory(input_program, skip_opt_set=None):
-    return None
+    """Same dead-op sweep as memory_optimize (the reference's eager
+    variant); returns the PassReport."""
+    return memory_optimize(input_program, skip_opt_set=skip_opt_set)
 
 
 class InferenceTranspiler(object):
-    """BN-fold / conv+bn fuse for inference (ref inference_transpiler.py) —
-    subsumed by XLA fusion; clone(for_test) already freezes BN stats."""
+    """Inference-time program rewriting (ref inference_transpiler.py).
+
+    BN folding / conv+bn fusing specifically are subsumed by XLA fusion
+    (clone(for_test) already freezes BN stats), but the transpile call is
+    no longer a no-op: it runs the passes inference pipeline (verify,
+    constant_fold, dead_op_elimination, fuse_activation) on `program` IN
+    PLACE — reference semantics — and returns the per-pass reports."""
 
     def transpile(self, program, place, scope=None):
-        return None
+        from .passes import apply_inference_pipeline
+        _, reports = apply_inference_pipeline(
+            program, fetch_names=getattr(program, '_fetch_names', None),
+            feed_names=getattr(program, '_feed_names', None),
+            inplace=True)
+        return reports
 
 
 class HashName(object):

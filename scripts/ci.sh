@@ -11,6 +11,9 @@ make -C paddle_tpu/native
 echo "== api surface =="
 python tools/print_signatures.py --check API.spec
 
+echo "== program lint over models/ (passes verifier; errors fail the build) =="
+JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python tools/program_lint.py --models
+
 echo "== tests (8-device virtual cpu mesh, tier-1: not slow) =="
 # tier-1 includes tests/test_multi_step.py (K-step dispatch bit-identity)
 # and the prefetch-ring units in test_data_pipeline.py; the threaded ring

@@ -1,0 +1,508 @@
+"""Pass & lint subsystem tests (paddle_tpu/passes/): registry + manager
+contract, per-pass bit-identity on a dense net and an OCR-style LoD
+program, verifier corruption classes, and the consumer wiring
+(Executor strict verify, CompiledProgram pipeline, memory_optimize /
+InferenceTranspiler reports, io.prune_program, program_lint CLI)."""
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import passes
+from paddle_tpu.passes import (PassManager, PassReport, ProgramVerifyError,
+                               registered_passes, verify_program)
+
+
+# ---------------------------------------------------------------------------
+# builders
+# ---------------------------------------------------------------------------
+def _dense_net(seed=11):
+    """Small conv/fc train net with an (unfetched) metric branch and a
+    foldable constant chain."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[6], dtype='float32')
+        label = fluid.layers.data(name='y', shape=[1], dtype='int64')
+        h = fluid.layers.fc(x, size=16, act='relu')
+        logits = fluid.layers.fc(h, size=4)
+        c = fluid.layers.fill_constant([1, 4], 'float32', 0.5)
+        c = fluid.layers.scale(c, scale=0.5)
+        logits = fluid.layers.elementwise_add(logits, c)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits=logits,
+                                                    label=label))
+        probs = fluid.layers.softmax(logits)
+        acc = fluid.layers.accuracy(input=probs, label=label)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss, acc
+
+
+def _dense_feed(rng=None):
+    rng = rng or np.random.RandomState(0)
+    return {'x': rng.randn(8, 6).astype(np.float32),
+            'y': rng.randint(0, 4, (8, 1)).astype(np.int64)}
+
+
+def _lod_net(seed=13):
+    """OCR-style LoD program: variable-length token sequences through
+    embedding + sequence_pool into a classifier."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name='ids', shape=[1], dtype='int64',
+                                lod_level=1)
+        label = fluid.layers.data(name='lbl', shape=[1], dtype='int64')
+        emb = fluid.layers.embedding(ids, size=[50, 8])
+        pooled = fluid.layers.sequence_pool(emb, pool_type='sum')
+        logits = fluid.layers.fc(pooled, size=3)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits=logits,
+                                                    label=label))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    return main, startup, loss
+
+
+def _lod_feed(rng=None):
+    rng = rng or np.random.RandomState(1)
+    lens = [3, 1, 4]
+    toks = rng.randint(0, 50, (sum(lens), 1)).astype(np.int64)
+    ids = fluid.create_lod_tensor(toks, [lens])
+    lbl = rng.randint(0, 3, (len(lens), 1)).astype(np.int64)
+    return {'ids': ids, 'lbl': lbl}
+
+
+def _init_state(startup):
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    return exe, {k: np.asarray(v) for k, v in scope._vars.items()
+                 if v is not None}
+
+
+def _run_from(exe, snap, program, feed, fetches, steps=2):
+    scope = fluid.core.Scope()
+    for k, v in snap.items():
+        scope.set(k, v)
+    outs = []
+    with fluid.scope_guard(scope):
+        for _ in range(steps):
+            outs.append(exe.run(program, feed=feed, fetch_list=fetches))
+    return outs
+
+
+def _assert_identical(a, b):
+    for step_a, step_b in zip(a, b):
+        for va, vb in zip(step_a, step_b):
+            assert np.array_equal(np.asarray(va), np.asarray(vb))
+
+
+# ---------------------------------------------------------------------------
+# registry / manager / report shape
+# ---------------------------------------------------------------------------
+def test_registry_lists_core_passes():
+    names = registered_passes()
+    for want in ('verify_program', 'constant_fold', 'dead_op_elimination',
+                 'fuse_activation'):
+        assert want in names
+
+
+def test_manager_preserves_pipeline_order_and_report_shape():
+    main, startup, loss, acc = _dense_net()
+    order = ['verify_program', 'constant_fold', 'dead_op_elimination']
+    mgr = PassManager(order)
+    assert mgr.pipeline_names() == order
+    prog, reports = mgr.apply(main, fetch_names=[loss.name])
+    assert [r.name for r in reports] == order
+    assert prog is not main  # default: clone, source untouched
+    for r in reports:
+        assert isinstance(r, PassReport)
+        d = r.as_dict()
+        assert set(d) == {'pass', 'ops', 'vars', 'details', 'diagnostics'}
+        assert {'before', 'after', 'added', 'removed'} <= set(d['ops'])
+        assert r.ops_before - r.ops_removed + r.ops_added == r.ops_after
+
+
+def test_unknown_pass_name_raises():
+    with pytest.raises(KeyError):
+        PassManager(['no_such_pass'])
+
+
+def test_dce_prunes_metric_branch_and_reduces_ops():
+    main, startup, loss, acc = _dense_net()
+    before = len(main.global_block().ops)
+    prog, reports = PassManager(['dead_op_elimination']).apply(
+        main, fetch_names=[loss.name])
+    after = len(prog.global_block().ops)
+    assert after < before
+    types = [op.type for op in prog.global_block().ops]
+    assert 'accuracy' not in types  # unfetched metric branch dropped
+    # source program untouched
+    assert len(main.global_block().ops) == before
+
+
+def test_constant_fold_splices_literals():
+    main, startup, loss, acc = _dense_net()
+    prog, reports = PassManager(['constant_fold',
+                                 'dead_op_elimination']).apply(
+        main, fetch_names=[loss.name])
+    fold = reports[0]
+    assert fold.details['folded_ops'] >= 1  # the scale(fill_constant)
+    types = [op.type for op in prog.global_block().ops]
+    assert 'scale' not in types or fold.details['folded_ops'] >= 1
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: each pass alone + the full pipeline, dense and LoD
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize('pipeline', [
+    ['verify_program'], ['constant_fold'], ['dead_op_elimination'],
+    ['fuse_activation'], list(passes.OPTIMIZATION_PIPELINE)])
+def test_bit_identity_dense(pipeline):
+    main, startup, loss, acc = _dense_net()
+    exe, snap = _init_state(startup)
+    feed = _dense_feed()
+    prog, _ = PassManager(pipeline).apply(main, fetch_names=[loss.name])
+    base = _run_from(exe, snap, main, feed, [loss.name])
+    opt = _run_from(exe, snap, prog, feed, [loss.name])
+    _assert_identical(base, opt)
+
+
+@pytest.mark.parametrize('pipeline', [
+    ['constant_fold'], ['dead_op_elimination'],
+    list(passes.OPTIMIZATION_PIPELINE)])
+def test_bit_identity_lod(pipeline):
+    main, startup, loss = _lod_net()
+    exe, snap = _init_state(startup)
+    feed = _lod_feed()
+    prog, _ = PassManager(pipeline).apply(main, fetch_names=[loss.name])
+    base = _run_from(exe, snap, main, feed, [loss.name])
+    opt = _run_from(exe, snap, prog, feed, [loss.name])
+    _assert_identical(base, opt)
+
+
+def test_fuse_activation_inference_bit_identity():
+    main, startup, loss, acc = _dense_net()
+    exe, snap = _init_state(startup)
+    feed = _dense_feed()
+    infer = main.clone(for_test=True)
+    out_name = 'softmax_0.tmp_0'
+    assert any(out_name in op.output_arg_names()
+               for op in infer.global_block().ops)
+    prog, reports = passes.apply_inference_pipeline(
+        infer, fetch_names=[out_name])
+    fused = next(r for r in reports if r.name == 'fuse_activation')
+    assert fused.details['fused'] >= 1
+    assert 'relu' not in [op.type for op in prog.global_block().ops]
+    base = _run_from(exe, snap, infer, feed, [out_name], steps=1)
+    opt = _run_from(exe, snap, prog, feed, [out_name], steps=1)
+    _assert_identical(base, opt)
+
+
+def test_fuse_activation_skips_training_consumers():
+    """Grad ops consume the activation input, so a train program must not
+    fuse (the intermediate has >1 reader)."""
+    main, startup, loss, acc = _dense_net()
+    prog, reports = PassManager(['fuse_activation']).apply(
+        main, fetch_names=[loss.name])
+    assert reports[0].details['fused'] == 0
+
+
+def test_const_fold_invalidates_overwritten_vars():
+    """An in-place overwrite of a folded constant (increment) must kill
+    the const-env entry: scale must NOT fold to the pre-overwrite value
+    (code-review regression: fill_constant -> increment -> scale)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        c = fluid.layers.fill_constant([1], 'float32', 0.0)
+        fluid.layers.increment(c, value=1.0, in_place=True)
+        out = fluid.layers.scale(c, scale=2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        base, = exe.run(main, fetch_list=[out])
+        prog, _ = passes.apply_optimization_pipeline(
+            main, fetch_names=[out.name])
+        opt, = exe.run(prog, fetch_list=[out])
+    assert float(base[0]) == 2.0
+    assert np.array_equal(base, opt)
+    assert 'increment' in [op.type for op in prog.global_block().ops]
+
+
+def test_const_fold_leaves_shape_of_runtime_data():
+    """shape(x) of a feed var must never fold, even when the declared
+    shape is fully static — the executor is shape-polymorphic per feed
+    (code-review regression: declared (4, 3), fed (2, 3))."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4, 3], dtype='float32',
+                              append_batch_size=False)
+        shp = fluid.layers.shape(x)
+    prog, _ = passes.apply_optimization_pipeline(main,
+                                                 fetch_names=[shp.name])
+    assert 'shape' in [op.type for op in prog.global_block().ops]
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    feed = {'x': np.zeros((2, 3), np.float32)}
+    with fluid.scope_guard(scope):
+        got, = exe.run(prog, feed=feed, fetch_list=[shp])
+    assert list(got) == [2, 3]
+
+
+def test_bit_identity_smallnet_model():
+    """Full pipeline on a real bench model (conv net + metric branch)."""
+    from models.smallnet import build_train_net
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 5
+    with fluid.program_guard(main, startup):
+        images, label, loss, acc = build_train_net()
+    exe, snap = _init_state(startup)
+    rng = np.random.RandomState(2)
+    feed = {'data': rng.randn(4, 3, 32, 32).astype(np.float32),
+            'label': rng.randint(0, 10, (4, 1)).astype(np.int64)}
+    prog, reports = passes.apply_optimization_pipeline(
+        main, fetch_names=[loss.name])
+    assert sum(len(b.ops) for b in prog.blocks) < \
+        sum(len(b.ops) for b in main.blocks)
+    base = _run_from(exe, snap, main, feed, [loss.name])
+    opt = _run_from(exe, snap, prog, feed, [loss.name])
+    _assert_identical(base, opt)
+
+
+def test_bit_identity_stacked_lstm_model():
+    """Full pipeline on the scan-based RNN bench model (static_rnn ops +
+    sub-blocks must survive liveness untouched)."""
+    from models.stacked_lstm import build_stacked_lstm_train
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 6
+    with fluid.program_guard(main, startup):
+        ids, label, loss, _ = build_stacked_lstm_train(
+            batch=4, vocab=60, emb_dim=8, hidden=8, seq_len=6)
+    exe, snap = _init_state(startup)
+    rng = np.random.RandomState(3)
+    feed = {'ids': rng.randint(1, 60, (4, 6)).astype(np.int64),
+            'label': rng.randint(0, 2, (4, 1)).astype(np.int64)}
+    prog, _ = passes.apply_optimization_pipeline(
+        main, fetch_names=[loss.name])
+    base = _run_from(exe, snap, main, feed, [loss.name])
+    opt = _run_from(exe, snap, prog, feed, [loss.name])
+    _assert_identical(base, opt)
+
+
+# ---------------------------------------------------------------------------
+# verifier: clean nets + seeded corruption classes
+# ---------------------------------------------------------------------------
+def test_verifier_clean_on_models():
+    from models.smallnet import build_train_net
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        images, label, loss, acc = build_train_net()
+    diags = verify_program(main, fetch_names=[loss.name, acc.name])
+    assert [d for d in diags if d.level == 'error'] == []
+
+
+def _corrupt(kind):
+    main, startup, loss, acc = _dense_net()
+    block = main.global_block()
+    fetch = [loss.name]
+    if kind == 'undefined-input':
+        op = next(op for op in block.ops if op.type == 'mul')
+        op.inputs['X'] = ['ghost_var']
+    elif kind == 'use-before-def':
+        idx = next(i for i, op in enumerate(block.ops)
+                   if op.type == 'mul')
+        op = block.ops.pop(idx)
+        block.ops.append(op)  # producer now AFTER its consumers
+    elif kind == 'unregistered-op':
+        block.append_op(type='definitely_not_an_op',
+                        inputs={'X': [loss.name]},
+                        outputs={'Out': [loss.name]}, infer_shape=False)
+    elif kind == 'dangling-sub-block':
+        block.ops[1].attrs['sub_block'] = 99
+    elif kind == 'unreachable-fetch':
+        fetch = ['never_produced_var']
+    elif kind == 'bad-dtype':
+        op = next(op for op in block.ops if op.type == 'fill_constant')
+        op.attrs['dtype'] = 'float99'
+    elif kind == 'shape-mismatch':
+        op = next(op for op in block.ops if op.type == 'fill_constant')
+        op.attrs['shape'] = [7, 9]  # declared var still says [1, 4]
+    return main, fetch
+
+
+_ERROR_KINDS = ['undefined-input', 'use-before-def', 'unregistered-op',
+                'dangling-sub-block', 'unreachable-fetch', 'bad-dtype']
+
+
+@pytest.mark.parametrize('kind', _ERROR_KINDS)
+def test_verifier_flags_seeded_errors(kind):
+    main, fetch = _corrupt(kind)
+    diags = verify_program(main, fetch_names=fetch)
+    hits = [d for d in diags if d.code == kind]
+    assert hits, "expected %s in %s" % (kind, diags)
+    assert all(d.level == 'error' for d in hits)
+    d = hits[0]
+    assert d.block == 0 and isinstance(d.op_index, int)
+
+
+def test_verifier_flags_shape_mismatch_full_level():
+    main, fetch = _corrupt('shape-mismatch')
+    diags = verify_program(main, fetch_names=fetch, level='full')
+    assert any(d.code == 'shape-mismatch' for d in diags)
+    # fast level skips the registry sweep
+    fast = verify_program(main, fetch_names=fetch, level='fast')
+    assert not any(d.code == 'shape-mismatch' for d in fast)
+
+
+def test_verifier_warns_dead_outputs():
+    main, startup, loss, acc = _dense_net()
+    diags = verify_program(main, fetch_names=[loss.name])
+    dead = [d for d in diags if d.code == 'dead-output']
+    assert dead and all(d.level == 'warn' for d in dead)
+    # fetching the metric silences it
+    diags2 = verify_program(main, fetch_names=[loss.name, acc.name])
+    assert not any(d.code == 'dead-output' and 'accuracy' in d.message
+                   for d in diags2)
+
+
+# ---------------------------------------------------------------------------
+# consumer wiring
+# ---------------------------------------------------------------------------
+def test_executor_strict_verify_raises(monkeypatch):
+    monkeypatch.setenv('PTPU_STRICT_VERIFY', '1')
+    main, fetch = _corrupt('undefined-input')
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises(ProgramVerifyError):
+        exe.run(main, feed=_dense_feed(), fetch_list=fetch)
+
+
+def test_executor_warns_then_trace_fails(monkeypatch):
+    monkeypatch.delenv('PTPU_STRICT_VERIFY', raising=False)
+    main, fetch = _corrupt('undefined-input')
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.warns(RuntimeWarning, match='verification'):
+        with pytest.raises(Exception):
+            exe.run(main, feed=_dense_feed(), fetch_list=fetch)
+
+
+def test_compiled_program_runs_optimized_pipeline():
+    main, startup, loss, acc = _dense_net()
+    exe, snap = _init_state(startup)
+    feed = _dense_feed()
+    base = _run_from(exe, snap, main, feed, [loss.name])
+    compiled = fluid.CompiledProgram(main)
+    opt = _run_from(exe, snap, compiled, feed, [loss.name])
+    _assert_identical(base, opt)
+    assert compiled._pass_reports, "pipeline must have run"
+    dce = next(r for r in compiled._pass_reports
+               if r.name == 'dead_op_elimination')
+    assert dce.ops_removed >= 1  # the unfetched metric branch
+    # a LATER fetch of the pruned metric still works: per-fetch-set clone
+    extra = _run_from(exe, snap, compiled, feed, [loss.name, acc.name],
+                      steps=1)
+    assert np.array_equal(np.asarray(extra[0][0]), np.asarray(base[0][0]))
+
+
+def test_memory_optimize_returns_report():
+    main, startup, loss, acc = _dense_net()
+    n0 = len(main.global_block().ops)
+    report = fluid.memory_optimize(main)  # no fetch info: conservative
+    assert isinstance(report, PassReport)
+    assert len(main.global_block().ops) == n0  # every terminal kept
+    report2 = fluid.memory_optimize(main, fetch_list=[loss])
+    assert report2.ops_removed >= 1  # metric branch pruned in place
+    assert 'accuracy' not in [op.type for op in main.global_block().ops]
+    assert fluid.release_memory(main) is not None
+
+
+def test_memory_optimize_skip_opt_set_preserved():
+    main, startup, loss, acc = _dense_net()
+    fluid.memory_optimize(main, skip_opt_set={acc.name},
+                          fetch_list=[loss])
+    assert 'accuracy' in [op.type for op in main.global_block().ops]
+
+
+def test_inference_transpiler_returns_reports():
+    main, startup, loss, acc = _dense_net()
+    infer = main.clone(for_test=True)
+    infer._fetch_names = [loss.name]
+    t = fluid.InferenceTranspiler()
+    reports = t.transpile(infer, fluid.CPUPlace())
+    assert reports and [r.name for r in reports] == \
+        passes.pipeline_names(passes.INFERENCE_PIPELINE)
+    # the exported constant reproduces the inference pipeline exactly:
+    # its DCE roots at fetches only (no persistable-writer keeping)
+    from paddle_tpu.passes.dce import DeadOpEliminationPass
+    dce = next(p for p in passes.INFERENCE_PIPELINE
+               if isinstance(p, DeadOpEliminationPass))
+    assert dce.keep_persistable_writers is False
+
+
+def test_prune_program_drops_optimizer_and_keeps_fetch_cone():
+    from paddle_tpu.io import prune_program
+    main, startup, loss, acc = _dense_net()
+    pruned = prune_program(main, ['x'], [loss.name])
+    types = [op.type for op in pruned.global_block().ops]
+    assert 'sgd' not in types and 'accuracy' not in types
+    assert not any(t.endswith('_grad') for t in types)
+    assert any(t == 'mul' for t in types)
+
+
+def test_export_compiled_artifact_is_optimized(tmp_path):
+    """export_compiled runs the pipeline; the artifact round-trips
+    bit-identically against the unoptimized predictor."""
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.inference.export import export_compiled
+    from paddle_tpu.inference.serve import CompiledPredictor
+    main, startup, loss, acc = _dense_net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        x = fluid.layers  # noqa: F841
+        logits = 'softmax_0.tmp_0'
+        model_dir = str(tmp_path / 'model')
+        fluid.io.save_inference_model(
+            model_dir, ['x'],
+            [main.global_block().var(logits)], exe, main)
+    pred = create_predictor(Config(model_dir))
+    feed = _dense_feed()
+    ref, = pred.run([feed['x']])
+    out_dir = str(tmp_path / 'artifact')
+    export_compiled(pred, [feed['x']], out_dir)
+    served = CompiledPredictor(out_dir)
+    got, = served.run([feed['x']])
+    assert np.array_equal(np.asarray(ref), np.asarray(got))
+
+
+# ---------------------------------------------------------------------------
+# lint CLI
+# ---------------------------------------------------------------------------
+def _lint_cli():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), 'tools', 'program_lint.py')
+    spec = importlib.util.spec_from_file_location('program_lint', path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_program_lint_cli_exit_codes(tmp_path):
+    lint = _lint_cli()
+    main, startup, loss, acc = _dense_net()
+    good = tmp_path / 'good.json'
+    good.write_bytes(fluid.io.serialize_program(main))
+    assert lint.main([str(good)]) == 0
+    bad_prog, _ = _corrupt('undefined-input')
+    bad = tmp_path / 'bad.json'
+    bad.write_bytes(fluid.io.serialize_program(bad_prog))
+    assert lint.main([str(bad)]) == 1
+    assert lint.main([str(tmp_path / 'missing.json')]) == 2
+
+
+def test_program_lint_cli_models_subset():
+    lint = _lint_cli()
+    assert lint.main(['--models', 'smallnet']) == 0

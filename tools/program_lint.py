@@ -1,0 +1,171 @@
+"""Program lint CLI: run the static verifier (paddle_tpu/passes/verifier.py)
+over serialized programs and/or the models/ zoo.
+
+Usage:
+    python tools/program_lint.py PATH [PATH ...]   # serialized programs
+    python tools/program_lint.py --models          # build + lint models/
+    python tools/program_lint.py --models smallnet resnet
+    python tools/program_lint.py --fast PATH       # structural checks only
+
+PATH is a save_inference_model dir (containing __model__), a __model__
+file itself, or any serialize_program() JSON blob. With no arguments,
+--models is implied (the CI gate: a model that stops verifying fails the
+build). Exit status: 0 clean (warnings allowed), 1 on any error-level
+diagnostic, 2 on a build/load failure.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# name -> zero-arg builder returning the fetch vars worth rooting at; each
+# runs inside fresh default programs. Transformer/BERT build with shrunken
+# dims — the lint walks op STRUCTURE, layer count adds nothing but time.
+def _model_builders():
+    import models.alexnet
+    import models.bert
+    import models.crnn
+    import models.deepfm
+    import models.googlenet
+    import models.resnet
+    import models.se_resnext
+    import models.smallnet
+    import models.stacked_lstm
+    import models.transformer
+    import models.vgg
+    return {
+        'smallnet': lambda: models.smallnet.build_train_net()[2:],
+        'alexnet': lambda: models.alexnet.build_train_net()[2:],
+        'vgg': lambda: models.vgg.build_train_net(depth=16)[2:],
+        'googlenet': lambda: models.googlenet.build_train_net()[2:],
+        'resnet': lambda: models.resnet.build_train_net(
+            dshape=(3, 224, 224), class_dim=1000, depth=50,
+            imagenet=True)[2:],
+        'se_resnext': lambda: models.se_resnext.build_train_net()[2:],
+        'crnn': lambda: models.crnn.build_crnn_train()[2:5],
+        'deepfm': lambda: models.deepfm.build_deepfm_train()[1:],
+        'stacked_lstm': lambda: models.stacked_lstm.build_stacked_lstm_train(
+            batch=4, vocab=1000, emb_dim=32, hidden=32, seq_len=16)[2:3],
+        'transformer': lambda: models.transformer.build_transformer_train(
+            src_vocab=1000, trg_vocab=1000, max_len=16, d_model=32,
+            d_ff=64, n_head=2, n_layer=2)[1:2],
+        'bert': lambda: models.bert.build_bert_pretrain(
+            vocab=1000, max_len=16, d_model=32, d_ff=64, n_head=2,
+            n_layer=2)[1:],
+    }
+
+
+def _fetch_names(fetches):
+    from paddle_tpu.framework import Variable
+    out = []
+    for f in (fetches if isinstance(fetches, (list, tuple)) else [fetches]):
+        if isinstance(f, Variable):
+            out.append(f.name)
+        elif isinstance(f, str):
+            out.append(f)
+    return out
+
+
+def lint_program(program, label, level='full', feed_names=None,
+                 fetch_names=None, out=print):
+    """Run the verifier; prints diagnostics; returns the error count."""
+    from paddle_tpu.passes import verify_program
+    t0 = time.perf_counter()
+    diags = verify_program(program, feed_names=feed_names,
+                           fetch_names=fetch_names, level=level)
+    dt = time.perf_counter() - t0
+    errors = sum(1 for d in diags if d.level == 'error')
+    warns = len(diags) - errors
+    ops = sum(len(b.ops) for b in program.blocks)
+    for d in diags:
+        out("%s: %s" % (label, d))
+    out("%s: %d ops, %d blocks — %d error(s), %d warning(s) [%.2fs]"
+        % (label, ops, program.num_blocks, errors, warns, dt))
+    return errors
+
+
+def lint_path(path, level, out=print):
+    from paddle_tpu import io as ptpu_io
+    if os.path.isdir(path):
+        path = os.path.join(path, '__model__')
+    with open(path, 'rb') as f:
+        blob = f.read()
+    if not blob.lstrip()[:1] == b'{':
+        raise ValueError(
+            "%s is not a paddle_tpu serialized program (JSON); the "
+            "reference protobuf format is out of scope for the linter"
+            % path)
+    program = ptpu_io.deserialize_program(blob)
+    return lint_program(program, os.path.basename(os.path.dirname(path))
+                        or path, level=level,
+                        feed_names=getattr(program, '_feed_names', None),
+                        fetch_names=getattr(program, '_fetch_names', None),
+                        out=out)
+
+
+def lint_models(names, level, out=print):
+    import paddle_tpu as fluid
+    from paddle_tpu import unique_name
+    builders = _model_builders()
+    unknown = [n for n in names if n not in builders]
+    if unknown:
+        raise SystemExit("unknown model(s) %s; have: %s"
+                         % (unknown, ', '.join(sorted(builders))))
+    total_errors = 0
+    failures = 0
+    for name in (names or sorted(builders)):
+        main, startup = fluid.Program(), fluid.Program()
+        try:
+            with fluid.program_guard(main, startup), unique_name.guard():
+                fetches = builders[name]()
+        except Exception as e:
+            out("%s: BUILD FAILED: %s: %s" % (name, type(e).__name__, e))
+            failures += 1
+            continue
+        total_errors += lint_program(main, name, level=level,
+                                     fetch_names=_fetch_names(fetches),
+                                     out=out)
+    return total_errors, failures
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="static program verifier (paddle_tpu/passes)")
+    ap.add_argument('paths', nargs='*',
+                    help="serialized program files/dirs, or model names "
+                         "with --models")
+    ap.add_argument('--models', action='store_true',
+                    help="build and lint the models/ zoo (default when no "
+                         "paths are given)")
+    ap.add_argument('--fast', action='store_true',
+                    help="structural checks only (skip the registry "
+                         "shape/dtype consistency sweep)")
+    args = ap.parse_args(argv)
+    level = 'fast' if args.fast else 'full'
+
+    errors = 0
+    failures = 0
+    if args.models or not args.paths:
+        e, f = lint_models(args.paths if args.models else [], level)
+        errors += e
+        failures += f
+    else:
+        for path in args.paths:
+            try:
+                errors += lint_path(path, level)
+            except Exception as e:
+                print("%s: LOAD FAILED: %s: %s"
+                      % (path, type(e).__name__, e))
+                failures += 1
+    if failures:
+        return 2
+    return 1 if errors else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
