@@ -149,17 +149,53 @@ def is_transient(exc):
     return any(m in msg for m in TRANSIENT_MARKERS)
 
 
+def _cc_stats():
+    try:
+        from paddle_tpu.core import compile_cache as cc
+        return cc.stats()
+    except Exception:
+        return None
+
+
+def _compile_fields(before, after):
+    """compile_s_cold / compile_s_warm for one metric (ISSUE 5): cold =
+    seconds spent tracing+XLA-compiling this round (persistent-cache
+    misses, or raw XLA compile time when the cache is off); warm = seconds
+    spent deserializing warm-started executables. The next BENCH round
+    reads the pair as the warm-start trajectory."""
+    if not before or not after:
+        return {}
+    fields = {}
+    if after['misses'] > before['misses']:
+        fields['compile_s_cold'] = round(
+            after['compile_s'] - before['compile_s'], 2)
+    elif after['xla_compile_s'] > before['xla_compile_s']:
+        fields['compile_s_cold'] = round(
+            after['xla_compile_s'] - before['xla_compile_s'], 2)
+    hits = (after['exec_hits'] + after['hlo_hits']
+            - before['exec_hits'] - before['hlo_hits'])
+    if hits:
+        fields['compile_s_warm'] = round(
+            after['hit_load_s'] - before['hit_load_s'], 3)
+    return fields
+
+
 def run_metric(name, fn, retries=3, backoff_s=5, sleep=None):
     """Run one benchmark with transient-fault retries and full isolation.
 
     Returns the metric line dict on success, or an error line dict (never
     raises). The error line carries the metric name, the error string, the
-    attempt count, and whether the final error looked transient.
-    """
+    attempt count, and whether the final error looked transient. Success
+    lines additionally carry compile_s_cold/compile_s_warm (the
+    warm-start trajectory, _compile_fields)."""
     last = None
     for attempt in range(retries):
+        before = _cc_stats()
         try:
-            return fn()
+            line = fn()
+            if isinstance(line, dict) and 'error' not in line:
+                line.update(_compile_fields(before, _cc_stats()))
+            return line
         except Exception as e:  # per-metric isolation: nothing may escape
             last = e
             if attempt + 1 < retries and is_transient(e):
@@ -1037,6 +1073,19 @@ def main(benches=None):
     """Run benchmarks; always exit 0. The headline runs first; its line is
     printed immediately (insurance) and re-printed last (the driver parses
     the final JSON line as the headline)."""
+    # persistent compile cache ON by default for bench runs: round N+1
+    # measures the warm-start trajectory of the executables round N
+    # persisted, and compile_s_cold/warm on every metric line records it.
+    # An EXPLICIT env opt-out (PTPU_COMPILE_CACHE=0/off/...) wins — the
+    # knob's own semantics (compile_cache.enabled()) decide, bench only
+    # flips the default for the unset case
+    try:
+        from paddle_tpu.core import compile_cache as _cc
+        if os.environ.get('PTPU_COMPILE_CACHE') is None or _cc.enabled():
+            _cc.enable()
+    except Exception as e:
+        print('bench: compile cache unavailable (%s: %s)'
+              % (type(e).__name__, e), file=sys.stderr)
     if benches is None:
         benches = BENCHES
         only = os.environ.get('PTPU_BENCH_ONLY', '')

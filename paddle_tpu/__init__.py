@@ -58,4 +58,12 @@ from .transpiler import DistributeTranspiler, DistributeTranspilerConfig, \
 
 CUDAException = RuntimeError
 
+# persistent compile cache (core/compile_cache.py): when the env knobs
+# enable it, initialize at import — the jax persistent-cache tier and the
+# compile-event counter must be armed BEFORE the first eager/utility jit
+# compiles (rng key derivation fires ahead of the first program dispatch)
+from .core import compile_cache as _compile_cache
+if _compile_cache.enabled():
+    _compile_cache._ensure_ready()
+
 __version__ = '0.1.0'

@@ -38,8 +38,11 @@ def _fetch_name(f):
     raise TypeError("fetch_list entries must be Variable or str, got %r" % (f,))
 
 
-_analysis_cache = {}
-_verify_cache = {}
+# module-level caches indexed BY PROGRAM UID (one slot per uid holding the
+# live build epoch): a lookup miss invalidates only this program's stale
+# entries in O(per-uid entries), never a scan of every program's keys
+_analysis_cache = {}   # uid -> ((build_epoch, op_count), analysis)
+_verify_cache = {}     # uid -> (build_epoch, {(feeds, fetches): errors})
 _entropy_seed = None
 
 
@@ -130,38 +133,36 @@ def _verify_before_run(program, feed_names, fetch_names):
     fetch) signature — while PTPU_STRICT_VERIFY=1 raises
     ProgramVerifyError instead of letting the tracer fail opaquely."""
     from .passes import verifier as _verifier
-    key = (program._uid, program._build_epoch,
-           frozenset(feed_names), tuple(fetch_names))
-    errs = _verify_cache.get(key)
+    uid, epoch = program._uid, program._build_epoch
+    sig = (frozenset(feed_names), tuple(fetch_names))
+    cached = _verify_cache.get(uid)
+    if cached is None or cached[0] != epoch:   # epoch turned: old sigs die
+        cached = (epoch, {})
+        _verify_cache[uid] = cached
+    errs = cached[1].get(sig)
     if errs is None:
-        for k in [k for k in _verify_cache
-                  if k[0] == program._uid and k[1] != program._build_epoch]:
-            del _verify_cache[k]
         diags = _verifier.verify_program(program, feed_names=feed_names,
                                          fetch_names=fetch_names,
                                          level='fast')
         errs = [d for d in diags if d.level == 'error']
-        _verify_cache[key] = errs
+        cached[1][sig] = errs
     if errs:
-        _verifier.maybe_raise_or_warn(errs, warned_key=key)
+        _verifier.maybe_raise_or_warn(errs, warned_key=(uid, epoch) + sig)
 
 
 def _program_analysis(program):
     """(persistable names, persistable∩written) — memoized per build epoch."""
-    key = (program._uid, program._build_epoch,
-           sum(len(b.ops) for b in program.blocks))
-    hit = _analysis_cache.get(key)
-    if hit is not None:
-        return hit
-    for k in [k for k in _analysis_cache if k[0] == program._uid]:
-        del _analysis_cache[k]
+    key = (program._build_epoch, sum(len(b.ops) for b in program.blocks))
+    hit = _analysis_cache.get(program._uid)
+    if hit is not None and hit[0] == key:
+        return hit[1]
     persist = {v.name for v in program.list_vars() if v.persistable}
     written = set()
     for b in program.blocks:
         for op in b.ops:
             written.update(op.output_arg_names())
     out = (tuple(sorted(persist)), tuple(sorted(persist & written)))
-    _analysis_cache[key] = out
+    _analysis_cache[program._uid] = (key, out)
     return out
 
 
@@ -179,6 +180,9 @@ class Executor(object):
             except RuntimeError:
                 self._device = None
         self._cache = {}
+        # uid -> set of _cache keys: keeps per-miss stale-epoch eviction
+        # O(this program's entries) instead of a full-cache scan
+        self._cache_index = {}
         self._step_counters = {}
         # multi-step dispatch counters (profiler.training_report contract;
         # an executor owned by an inference Predictor sets _profile_role =
@@ -240,6 +244,7 @@ class Executor(object):
                              tuple(sorted(state)), out_state_names, mesh,
                              feed_vals)
             self._cache[key] = fn
+            self._cache_index.setdefault(program._uid, set()).add(key)
 
         step = self._step_counters.get(program._uid, 0)
         self._step_counters[program._uid] = step + 1
@@ -274,11 +279,14 @@ class Executor(object):
     def _evict_stale(self, program):
         """Evict compiled steps for older epochs of this program: a
         mutate-then-run loop would otherwise leak one XLA executable per
-        mutation."""
-        stale = [k for k in self._cache
-                 if k[0] == program._uid and k[1] != program._build_epoch]
+        mutation. The uid index keeps this O(this program's entries)."""
+        keys = self._cache_index.get(program._uid)
+        if not keys:
+            return
+        stale = [k for k in keys if k[1] != program._build_epoch]
         for k in stale:
-            del self._cache[k]
+            keys.discard(k)
+            self._cache.pop(k, None)
 
     @staticmethod
     def _step_seed(program):
@@ -313,6 +321,7 @@ class Executor(object):
 
     def close(self):
         self._cache.clear()
+        self._cache_index.clear()
         if self._prof_registered:
             from . import profiler as _profiler
             _profiler.unregister_training_source('executor@%x' % id(self))
@@ -396,6 +405,7 @@ class Executor(object):
             fn = self._build_multi(program, tuple(fetch_names),
                                    out_state_names, k, fetch_policy)
             self._cache[key] = fn
+            self._cache_index.setdefault(program._uid, set()).add(key)
 
         step0 = self._step_counters.get(program._uid, 0)
         self._step_counters[program._uid] = step0 + k
@@ -628,16 +638,56 @@ class Executor(object):
             new_state = {n: st[n] for n in out_state_names if n in st}
             return fetches, new_state
 
-        return self._pin_and_call(jax.jit(step_k, donate_argnums=(0,)))
+        return self._pin_and_call(
+            jax.jit(step_k, donate_argnums=(0,)),
+            key_parts=self._aot_key_parts(program, fetch_names,
+                                          out_state_names,
+                                          extra=('multi', k, fetch_policy)),
+            tag='executor_steps', fun=step_k)
 
-    def _pin_and_call(self, jitted):
+    def _aot_key_parts(self, program, fetch_names, out_state_names,
+                       extra=()):
+        """Trace-time inputs the persistent compile cache must key on but
+        cannot see in the arg avals (core/compile_cache.py); None when the
+        cache is off so the program-desc walk costs nothing."""
+        from .core import compile_cache as _cc
+        if not _cc.enabled():
+            return None
+        from .core import config as _config
+        return ('step', _cc.program_fingerprint(program),
+                tuple(fetch_names), tuple(out_state_names),
+                bool(getattr(program, '_amp_bf16', False)),
+                int(getattr(program, '_grad_accum_k', 1) or 1),
+                _config.rng_impl(),
+                int(_config.get_flag('dropout_bits') or 0)) + tuple(extra)
+
+    def _resolve_aot(self, jitted, fun, args, key_parts, tag):
+        """Persistent-cache warm start for a (state, feed, rng) callable,
+        resolved on the FIRST call (AOT needs concrete avals): a tier-1
+        hit deserializes the executable (zero trace, zero compile); a miss
+        compiles once and persists. Falls back to plain `jitted` when the
+        cache is off or debug_nans needs the re-traceable path. `fun` is
+        the raw step callable: cached executables compile WITHOUT state
+        donation (compile_cache.aot_or_jit's reload-aliasing contract)."""
+        from .core import compile_cache as _cc
+        from .core import config as _config
+        if key_parts is None or not _cc.enabled() \
+                or _config.get_flag('check_nan_inf'):
+            return jitted
+        return _cc.aot_or_jit(jitted, args, key_parts, tag=tag, fun=fun,
+                              device=self._device)
+
+    def _pin_and_call(self, jitted, key_parts=None, tag='executor',
+                      fun=None):
         """Wrap a jitted (state, feed, rng) callable so every input is
         pinned to this executor's device, COMMITTED — keeps
         avals/shardings identical across runs (no silent pjit recompiles)
         and gathers state left sharded across a mesh by an earlier
         ParallelExecutor run on the same scope. Shared by the single-step
-        and multi-step build paths."""
+        and multi-step build paths. With the persistent compile cache on,
+        the first call resolves through it (AOT warm start)."""
         dev = self._device
+        fn_box = [None]
 
         def _pin(v):
             # device_put through a remote-tunnel backend is an RPC even
@@ -653,9 +703,15 @@ class Executor(object):
                 state = {n: _pin(v) for n, v in state.items()}
                 feed = {n: _pin(v) for n, v in feed.items()}
                 rng = _pin(rng)
+            fn = fn_box[0]
+            if fn is None:
+                fn = self._resolve_aot(jitted, fun, (state, feed, rng),
+                                       key_parts, tag)
+                fn_box[0] = fn
+            if dev is not None:
                 with jax.default_device(dev):
-                    return jitted(state, feed, rng)
-            return jitted(state, feed, rng)
+                    return fn(state, feed, rng)
+            return fn(state, feed, rng)
         return call
 
     # ------------------------------------------------------------------
@@ -976,7 +1032,11 @@ class Executor(object):
                                    mesh)
 
         if mesh is None:
-            return self._pin_and_call(jax.jit(step, donate_argnums=(0,)))
+            return self._pin_and_call(
+                jax.jit(step, donate_argnums=(0,)),
+                key_parts=self._aot_key_parts(program, fetch_names,
+                                              out_state_names),
+                tag='executor_run', fun=step)
 
         # SPMD: batch-shard the feeds over the data axis; state replicated
         # unless a parameter carries a sharding_spec (TP/EP annotation);
@@ -1049,6 +1109,10 @@ class Executor(object):
                 return jax.tree.map(lambda x: _mesh_put_leaf(x, sharding), v)
             return jax.device_put(v, sharding)
 
+        aot_parts = self._aot_key_parts(program, fetch_names,
+                                        out_state_names, extra=('mesh',))
+        fn_box = [None]
+
         def run_with_mesh(state, feed, rng):
             # place inputs on the mesh (resharding no-op when already there);
             # jit compiles to the arg shardings, GSPMD does the rest
@@ -1056,6 +1120,18 @@ class Executor(object):
                      for n, v in state.items()}
             feed = {n: _place_feed(n, v) for n, v in feed.items()}
             rng = _mesh_put(rng, rep)
+            fn = fn_box[0]
+            if fn is None:
+                from .core import compile_cache as _cc
+                from .core import config as _config
+                fn = jitted
+                if aot_parts is not None and _cc.enabled() \
+                        and not _config.get_flag('check_nan_inf'):
+                    with mesh:
+                        fn = _cc.aot_or_jit(jitted, (state, feed, rng),
+                                            aot_parts, tag='executor_mesh',
+                                            fun=step, mesh=mesh)
+                fn_box[0] = fn
             with mesh:
-                return jitted(state, feed, rng)
+                return fn(state, feed, rng)
         return run_with_mesh

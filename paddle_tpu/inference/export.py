@@ -34,7 +34,36 @@ import numpy as np
 # the artifact layout contract lives in serve.py (the loader); export
 # writes exactly what serve reads
 from .serve import (_SIGNATURE, _MODULE, _BUCKET_DIR, _TRAIN_SIGNATURE,
-                    _TRAIN_MODULE, _TRAIN_STATE0)
+                    _TRAIN_MODULE, _TRAIN_STATE0, _AOT_SIDECAR,
+                    _aot_platform, _precompile_infer_dir,
+                    _precompile_train_dir)
+
+
+def _should_precompile(precompile):
+    """Export-time AOT sidecars default ON (PTPU_EXPORT_PRECOMPILE=0 opts
+    out): the exporting host pays one XLA compile per bucket so every
+    serving replica that loads the artifact pays none."""
+    if precompile is not None:
+        return bool(precompile)
+    return os.environ.get('PTPU_EXPORT_PRECOMPILE', '1') not in ('0',
+                                                                 'false')
+
+
+def _try_precompile(out_dir, train=False):
+    """Best-effort sidecar write: a backend without executable
+    serialization must never fail the export itself."""
+    import warnings
+    try:
+        if train:
+            _precompile_train_dir(out_dir)
+        else:
+            _precompile_infer_dir(out_dir)
+    except Exception as e:
+        warnings.warn(
+            'export: could not precompile a warm-start sidecar for %s '
+            '(%s: %s); the artifact still serves through the normal '
+            'compile path' % (out_dir, type(e).__name__, e),
+            RuntimeWarning)
 
 
 def _normalize_lod_sample(name, value, lod_level):
@@ -64,7 +93,8 @@ def _normalize_lod_sample(name, value, lod_level):
     return data, [o.astype(np.int32).reshape(-1) for o in offs]
 
 
-def export_compiled(predictor, sample_inputs, out_dir, batch_sizes=None):
+def export_compiled(predictor, sample_inputs, out_dir, batch_sizes=None,
+                    precompile=None):
     """Export `predictor`'s program as a tracer-free compiled artifact.
 
     sample_inputs: list (feed order) or dict of arrays fixing shapes and
@@ -83,6 +113,11 @@ def export_compiled(predictor, sample_inputs, out_dir, batch_sizes=None):
     largest bucket (backward-compatible with CompiledPredictor), and the
     top signature records the bucket list for batching.BatchingPredictor.
 
+    precompile: write AOT warm-start sidecars (serve.py _AOT_SIDECAR) per
+    bucket for the exporting host's platform, so loaders skip the
+    first-request XLA compile. Default: on (PTPU_EXPORT_PRECOMPILE=0
+    opts out); other platforms prewarm with `tools/cache_ctl.py prewarm`.
+
     Returns out_dir. Load with inference/serve.py (no framework imports).
     """
     program = predictor._program
@@ -96,7 +131,8 @@ def export_compiled(predictor, sample_inputs, out_dir, batch_sizes=None):
         raise ValueError("sample_inputs missing feeds: %r" % missing)
     program = _optimize_for_export(predictor)
     if batch_sizes is None:
-        return _export_single(predictor, sample, out_dir, program=program)
+        return _export_single(predictor, sample, out_dir, program=program,
+                              precompile=precompile)
 
     sizes = sorted({int(b) for b in batch_sizes})
     if not sizes or sizes[0] < 1:
@@ -127,7 +163,7 @@ def export_compiled(predictor, sample_inputs, out_dir, batch_sizes=None):
                    for n, a in arrs.items()}
         _export_single(predictor, resized,
                        os.path.join(out_dir, _BUCKET_DIR % b),
-                       program=program)
+                       program=program, precompile=precompile)
     # top level mirrors the LARGEST bucket so CompiledPredictor(out_dir)
     # keeps working unchanged on a multi-bucket dir
     top = os.path.join(out_dir, _BUCKET_DIR % sizes[-1])
@@ -138,6 +174,17 @@ def export_compiled(predictor, sample_inputs, out_dir, batch_sizes=None):
         os.link(os.path.join(top, _MODULE), top_module)
     except OSError:  # cross-device or no-hardlink filesystem
         shutil.copyfile(os.path.join(top, _MODULE), top_module)
+    # the largest bucket's AOT sidecar serves the mirrored top module too
+    # (same module bytes; the sidecar validates by content hash)
+    side = _AOT_SIDECAR % _aot_platform()
+    if os.path.exists(os.path.join(top, side)):
+        top_side = os.path.join(out_dir, side)
+        if os.path.exists(top_side):
+            os.remove(top_side)
+        try:
+            os.link(os.path.join(top, side), top_side)
+        except OSError:
+            shutil.copyfile(os.path.join(top, side), top_side)
     with open(os.path.join(top, _SIGNATURE)) as f:
         sig = json.load(f)
     sig['buckets'] = sizes
@@ -172,7 +219,8 @@ def _optimize_for_export(predictor):
     return program
 
 
-def _export_single(predictor, sample, out_dir, program=None):
+def _export_single(predictor, sample, out_dir, program=None,
+                   precompile=None):
     """One fixed-shape export (the original export_compiled body);
     `sample` is a {feed name: value} dict covering every feed."""
     import jax
@@ -270,11 +318,13 @@ def _export_single(predictor, sample, out_dir, program=None):
     sig = {'version': 3, 'feeds': feed_sig, 'fetches': fetch_sig}
     with open(os.path.join(out_dir, _SIGNATURE), 'w') as f:
         json.dump(sig, f, indent=1)
+    if _should_precompile(precompile):
+        _try_precompile(out_dir)
     return out_dir
 
 
 def export_train_step(program, sample_inputs, fetch_list, out_dir,
-                      scope=None, seed=None):
+                      scope=None, seed=None, precompile=None):
     """Export a full TRAIN step as a tracer-free compiled artifact.
 
     The reference can train from a saved program with no Python
@@ -397,4 +447,6 @@ def export_train_step(program, sample_inputs, fetch_list, out_dir,
         json.dump(sig, f, indent=1)
     np.savez(os.path.join(out_dir, _TRAIN_STATE0),
              **{n: state[n] for n in state_names})
+    if _should_precompile(precompile):
+        _try_precompile(out_dir, train=True)
     return out_dir

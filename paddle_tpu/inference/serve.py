@@ -76,6 +76,180 @@ _BUCKET_DIR = 'bucket_%05d'  # per-bucket subdir of a multi-bucket artifact
 _TRAIN_SIGNATURE = 'train_signature.json'
 _TRAIN_MODULE = 'train_module.jaxexport'
 _TRAIN_STATE0 = 'train_state0.npz'
+# AOT warm-start sidecars (ISSUE 5): the module's XLA executable,
+# serialized per platform next to the module it was compiled from —
+# loading one skips BOTH the StableHLO deserialize-compile and the trace,
+# so a fresh serving replica answers its first request without paying
+# cold-start compile latency. Written by export (default), or after the
+# fact by `tools/cache_ctl.py prewarm ARTIFACT`.
+_AOT_SIDECAR = 'aot_%s.jaxexec'              # % platform
+_TRAIN_AOT_SIDECAR = 'aot_train_%s.jaxexec'  # % platform
+
+
+def _module_sha(module_bytes):
+    import hashlib
+    return hashlib.sha256(module_bytes).hexdigest()
+
+
+def _aot_platform(device=None):
+    """The platform an AOT sidecar is keyed on: the pinned device's, else
+    PTPU_PLATFORM, else the process's default jax backend."""
+    if device is not None:
+        return device.platform
+    env = os.environ.get('PTPU_PLATFORM')
+    if env:
+        return env
+    import jax
+    return jax.default_backend()
+
+
+def _save_aot(path, compiled, module_sha):
+    """Serialize a compiled executable as a warm-start sidecar (atomic
+    tmp+rename; pickle of the serialized executable + validation facts)."""
+    import pickle
+    import jax
+    import jaxlib
+    from jax.experimental.serialize_executable import serialize
+    payload, in_tree, out_tree = serialize(compiled)
+    blob = pickle.dumps({'v': 1, 'jax': jax.__version__,
+                         'jaxlib': jaxlib.__version__, 'sha': module_sha,
+                         'payload': payload, 'in_tree': in_tree,
+                         'out_tree': out_tree})
+    tmp = '%s.tmp-%d' % (path, os.getpid())
+    with open(tmp, 'wb') as f:
+        f.write(blob)
+    os.replace(tmp, path)
+    return path
+
+
+def _load_aot(path, module_sha):
+    """Deserialize a warm-start sidecar; None when absent. A stale or
+    corrupt sidecar warns LOUDLY and is ignored (the module still serves
+    through the normal compile path — never silently, never fatally)."""
+    if not os.path.exists(path):
+        return None
+    import pickle
+    import jax
+    import jaxlib
+    try:
+        with open(path, 'rb') as f:
+            d = pickle.loads(f.read())
+        if d.get('sha') != module_sha:
+            raise ValueError('sidecar was compiled from a different module')
+        if (d.get('jax'), d.get('jaxlib')) != (jax.__version__,
+                                               jaxlib.__version__):
+            raise ValueError(
+                'sidecar built with jax %s / jaxlib %s, process runs %s/%s'
+                % (d.get('jax'), d.get('jaxlib'), jax.__version__,
+                   jaxlib.__version__))
+        from jax.experimental.serialize_executable import (
+            deserialize_and_load)
+        return deserialize_and_load(d['payload'], d['in_tree'],
+                                    d['out_tree'])
+    except Exception as e:
+        warnings.warn('AOT sidecar %s unusable (%s: %s) — falling back to '
+                      'compiling the module; re-run `cache_ctl.py prewarm` '
+                      'to refresh it' % (path, type(e).__name__, e),
+                      RuntimeWarning)
+        return None
+
+
+def _infer_flat_specs(sig):
+    """The module's flat arg specs from signature.json: per feed, data then
+    one int32 offsets array per lod level (export.py's flat convention)."""
+    import jax
+    specs = []
+    for e in sig['feeds']:
+        specs.append(jax.ShapeDtypeStruct(tuple(e['shape']),
+                                          np.dtype(e['dtype'])))
+        if int(e.get('lod_levels', 0)):
+            for n in e['lod_sizes']:
+                specs.append(jax.ShapeDtypeStruct((int(n),), np.int32))
+    return specs
+
+
+def _precompile_infer_dir(d, platform=None):
+    """AOT-compile the inference module in artifact dir `d` for this
+    process's platform and write the sidecar. Returns the sidecar path."""
+    import jax
+    from jax import export as jexport
+    with open(os.path.join(d, _MODULE), 'rb') as f:
+        module_bytes = f.read()
+    with open(os.path.join(d, _SIGNATURE)) as f:
+        sig = json.load(f)
+    plat = platform or _aot_platform()
+    dev = jax.devices(plat)[0]
+    exp = jexport.deserialize(module_bytes)
+    with jax.default_device(dev):
+        compiled = jax.jit(exp.call).lower(*_infer_flat_specs(sig)).compile()
+    return _save_aot(os.path.join(d, _AOT_SIDECAR % plat), compiled,
+                     _module_sha(module_bytes))
+
+
+def _precompile_train_dir(d, platform=None):
+    """AOT-compile the train-step module in artifact dir `d` (sidecar per
+    platform), mirroring CompiledTrainer.step's calling convention."""
+    import jax
+    from jax import export as jexport
+    with open(os.path.join(d, _TRAIN_MODULE), 'rb') as f:
+        module_bytes = f.read()
+    with open(os.path.join(d, _TRAIN_SIGNATURE)) as f:
+        sig = json.load(f)
+    plat = platform or _aot_platform()
+    dev = jax.devices(plat)[0]
+    state_specs = [jax.ShapeDtypeStruct(tuple(e['shape']),
+                                        np.dtype(e['dtype']))
+                   for e in sig['state']]
+    feed_specs = [jax.ShapeDtypeStruct(tuple(e['shape']),
+                                       np.dtype(e['dtype']))
+                  for e in sig['feeds']]
+    rng_spec = jax.ShapeDtypeStruct(tuple(sig['rng']['key_shape']),
+                                    np.dtype(sig['rng']['key_dtype']))
+    exp = jexport.deserialize(module_bytes)
+    with jax.default_device(dev):
+        compiled = jax.jit(exp.call).lower(state_specs, feed_specs,
+                                           rng_spec).compile()
+    return _save_aot(os.path.join(d, _TRAIN_AOT_SIDECAR % plat), compiled,
+                     _module_sha(module_bytes))
+
+
+def precompile_artifact(artifact_dir, platform=None):
+    """Prewarm a serving artifact: AOT-compile EVERY bucket's module (and
+    the train module when present) for this process's platform, writing
+    warm-start sidecars — a replica that loads the artifact afterwards
+    performs zero traces and zero XLA compiles before its first answer.
+    The engine behind `tools/cache_ctl.py prewarm`. Returns the sidecar
+    paths written."""
+    import shutil
+    written = []
+    plat = platform or _aot_platform()
+    sig_p = os.path.join(artifact_dir, _SIGNATURE)
+    if os.path.exists(sig_p):
+        with open(sig_p) as f:
+            buckets = json.load(f).get('buckets')
+        if buckets:
+            for b in buckets:
+                written.append(_precompile_infer_dir(
+                    os.path.join(artifact_dir, _BUCKET_DIR % int(b)),
+                    platform=plat))
+            # the top level mirrors (hardlinks) the LARGEST bucket's
+            # module, so its sidecar is byte-for-byte reusable — link,
+            # don't recompile
+            src = written[-1]
+            top = os.path.join(artifact_dir, _AOT_SIDECAR % plat)
+            if os.path.exists(top):
+                os.remove(top)
+            try:
+                os.link(src, top)
+            except OSError:
+                shutil.copyfile(src, top)
+            written.append(top)
+        else:
+            written.append(_precompile_infer_dir(artifact_dir,
+                                                 platform=plat))
+    if os.path.exists(os.path.join(artifact_dir, _TRAIN_MODULE)):
+        written.append(_precompile_train_dir(artifact_dir, platform=plat))
+    return written
 
 
 def _split_lod_value(name, value, levels):
@@ -219,14 +393,27 @@ class CompiledPredictor(object):
 
     def __init__(self, artifact_dir, platform=None):
         import jax
-        from jax import export as jexport
         with open(os.path.join(artifact_dir, _SIGNATURE)) as f:
             self._sig = json.load(f)
         with open(os.path.join(artifact_dir, _MODULE), 'rb') as f:
-            self._exported = jexport.deserialize(f.read())
+            module_bytes = f.read()
+        # the StableHLO module deserializes LAZILY: a warm replica that
+        # loads an AOT sidecar never parses it at all (cold-start cost is
+        # the sidecar deserialize alone)
+        self._module_bytes = module_bytes
+        self._exported_cached = None
         self._feed_names = [e['name'] for e in self._sig['feeds']]
         platform = platform or os.environ.get('PTPU_PLATFORM')
         self._device = jax.devices(platform)[0] if platform else None
+        # AOT warm start: a precompiled sidecar for this platform skips
+        # the first-request XLA compile entirely (PTPU_ARTIFACT_AOT=0
+        # opts out; a stale sidecar warns and falls back)
+        self._aot = None
+        if os.environ.get('PTPU_ARTIFACT_AOT', '1') not in ('0', 'false'):
+            self._aot = _load_aot(
+                os.path.join(artifact_dir,
+                             _AOT_SIDECAR % _aot_platform(self._device)),
+                _module_sha(module_bytes))
         # bulk-inference loop state (run_batches): one jitted scan over the
         # exported module; XLA caches one executable per group size
         self._loop = None
@@ -234,6 +421,13 @@ class CompiledPredictor(object):
                       'stage_s': 0.0, 'dispatch_s': 0.0, 'total_s': 0.0}
         self._prof_name = None
         self._artifact_dir = artifact_dir
+
+    @property
+    def _exported(self):
+        if self._exported_cached is None:
+            from jax import export as jexport
+            self._exported_cached = jexport.deserialize(self._module_bytes)
+        return self._exported_cached
 
     def get_input_names(self):
         return list(self._feed_names)
@@ -244,12 +438,15 @@ class CompiledPredictor(object):
     def _call_flat(self, args):
         """Dispatch the exported module on the pinned device; returns the
         FLAT device outputs without a host sync (async serving loops —
-        e.g. batching.BatchingPredictor — sync once at delivery)."""
+        e.g. batching.BatchingPredictor — sync once at delivery). With a
+        warm-start sidecar loaded, this calls the deserialized executable
+        directly — no trace, no compile, same flat convention."""
+        fn = self._aot if self._aot is not None else self._exported.call
         if self._device is not None:
             import jax
             with jax.default_device(self._device):
-                return self._exported.call(*args)
-        return self._exported.call(*args)
+                return fn(*args)
+        return fn(*args)
 
     def run(self, inputs, pad_partial=True):
         """inputs: list (feed order) or dict name -> array; LoD feeds as
@@ -482,11 +679,14 @@ class CompiledTrainer(object):
 
     def __init__(self, artifact_dir, platform=None, seed=None):
         import jax
-        from jax import export as jexport
         with open(os.path.join(artifact_dir, _TRAIN_SIGNATURE)) as f:
             self._sig = json.load(f)
         with open(os.path.join(artifact_dir, _TRAIN_MODULE), 'rb') as f:
-            self._exported = jexport.deserialize(f.read())
+            module_bytes = f.read()
+        # lazy, as in CompiledPredictor: an AOT-warm trainer never parses
+        # the StableHLO module
+        self._module_bytes = module_bytes
+        self._exported_cached = None
         self._state_names = [e['name'] for e in self._sig['state']]
         with np.load(os.path.join(artifact_dir, _TRAIN_STATE0)) as z:
             self._state = [z[n] for n in self._state_names]
@@ -496,6 +696,19 @@ class CompiledTrainer(object):
         self._step_count = 0
         platform = platform or os.environ.get('PTPU_PLATFORM')
         self._device = jax.devices(platform)[0] if platform else None
+        self._aot = None
+        if os.environ.get('PTPU_ARTIFACT_AOT', '1') not in ('0', 'false'):
+            self._aot = _load_aot(
+                os.path.join(artifact_dir, _TRAIN_AOT_SIDECAR
+                             % _aot_platform(self._device)),
+                _module_sha(module_bytes))
+
+    @property
+    def _exported(self):
+        if self._exported_cached is None:
+            from jax import export as jexport
+            self._exported_cached = jexport.deserialize(self._module_bytes)
+        return self._exported_cached
 
     def get_input_names(self):
         return list(self._feed_names)
@@ -537,9 +750,10 @@ class CompiledTrainer(object):
         Strict shapes: a train step never pads (padded rows would corrupt
         the loss and every batch statistic)."""
         args, _ = _build_args(self._sig['feeds'], self._feed_names, inputs)
+        fn = self._aot if self._aot is not None else self._exported.call
 
         def call():
-            return self._exported.call(self._state, args, self._rng())
+            return fn(self._state, args, self._rng())
         if self._device is not None:
             import jax
             with jax.default_device(self._device):

@@ -47,6 +47,11 @@ class ExecutionStrategy(object):
         self.use_experimental_executor = False
 
 
+# optimized-clone variants kept per CompiledProgram (LRU): enough for a
+# train/eval/metric fetch-set rotation, bounded against fetch-set churn
+_OPT_CACHE_MAX = 8
+
+
 class CompiledProgram(object):
     """Wraps a Program; with_data_parallel attaches a mesh."""
 
@@ -60,7 +65,11 @@ class CompiledProgram(object):
         self._build_strategy = None
         self._exec_strategy = None
         self._places = None
-        self._opt_cache = {}      # (uid, epoch, fetch sig) -> program
+        # (uid, epoch, fetch sig) -> optimized program clone. LRU-capped:
+        # each fetch-set variation pins a full program clone, and a metric
+        # sweep cycling fetch sets would otherwise grow this without bound
+        from ..core.compile_cache import LRUCache
+        self._opt_cache = LRUCache(_OPT_CACHE_MAX)
         self._pass_reports = None  # reports from the latest pipeline run
 
     def with_data_parallel(self, loss_name=None, build_strategy=None,
@@ -92,8 +101,8 @@ class CompiledProgram(object):
         hit = self._opt_cache.get(key)
         if hit is not None:
             return hit
-        self._opt_cache = {k: v for k, v in self._opt_cache.items()
-                           if k[0] == src._uid and k[1] == src._build_epoch}
+        self._opt_cache.filter_inplace(
+            lambda k: k[0] == src._uid and k[1] == src._build_epoch)
         try:
             from .. import passes
             prog, reports = passes.apply_optimization_pipeline(
@@ -109,7 +118,7 @@ class CompiledProgram(object):
                 "unoptimized program" % (type(e).__name__, e),
                 RuntimeWarning)
             prog = src
-        self._opt_cache[key] = prog
+        self._opt_cache.put(key, prog)
         return prog
 
     def _get_mesh(self, executor):

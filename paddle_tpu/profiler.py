@@ -41,6 +41,15 @@ def start_profiler(state='All', tracer_option=None):
     import jax
     _trace_dir = os.environ.get('PTPU_PROFILE_DIR', '/tmp/paddle_tpu_profile')
     os.makedirs(_trace_dir, exist_ok=True)
+    # hook the compile-event counter (and its compile source) even when
+    # the persistent cache is off, so stop_profiler can report per-run
+    # compile events whenever any compile occurred
+    try:
+        from .core import compile_cache
+        compile_cache._ensure_listener()
+        compile_cache._register_profiler_source()
+    except Exception:
+        pass
     jax.profiler.start_trace(_trace_dir)
     _active = True
 
@@ -57,6 +66,8 @@ def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
         training_report()
     if _infer_sources:
         infer_report()
+    if _compile_sources:
+        compile_report()
     print("[paddle_tpu.profiler] device trace written to %s "
           "(open with TensorBoard / Perfetto); host events: "
           "export_chrome_tracing(path)" % _trace_dir)
@@ -234,6 +245,59 @@ def infer_report():
                    s.get('batches_per_dispatch', 0.0),
                    s.get('tail_flushes', 0), s.get('host_stall_ms', 0.0),
                    ('%.2f' % occ) if occ is not None else '-'))
+    return out
+
+
+# -- compile / compile-cache metrics -----------------------------------------
+# The persistent compile cache (core/compile_cache.py) registers a zero-arg
+# snapshot callable here; compile_report() renders per-run compile events —
+# XLA compiles performed, seconds spent, cache hits per tier, bytes moved —
+# and stop_profiler appends the same table whenever any compile (or cache
+# traffic) occurred during the run.
+_compile_sources = {}
+
+
+def register_compile_source(name, snapshot):
+    """Register a compile-metrics source: `snapshot()` -> dict with
+    compiles, compile_s, exec_hits, hlo_hits, misses, bytes_read,
+    bytes_written, xla_compiles, xla_compiles_net (the contract of
+    core.compile_cache.stats)."""
+    _compile_sources[name] = snapshot
+
+
+def unregister_compile_source(name):
+    _compile_sources.pop(name, None)
+
+
+def compile_report():
+    """Print compile/cache metrics for every registered source and return
+    them as {source name: snapshot dict}. Sources with no compile AND no
+    cache traffic are skipped — the table only appears when something
+    compiled or warm-started."""
+    out = {}
+    rows = []
+    for name in sorted(_compile_sources):
+        try:
+            snap = _compile_sources[name]()
+        except Exception:
+            continue  # a torn-down cache must not break the report
+        out[name] = snap
+        if (snap.get('xla_compiles', 0) or snap.get('compiles', 0)
+                or snap.get('exec_hits', 0) or snap.get('hlo_hits', 0)
+                or snap.get('misses', 0)):
+            rows.append((name, snap))
+    if rows:
+        print("%-20s %8s %10s %6s %6s %6s %9s %8s %10s %10s" %
+              ('Compile source', 'compiles', 'xla(net)', 'exec+', 'hlo+',
+               'miss', 'cache(s)', 'xla(s)', 'read(B)', 'written(B)'))
+        for name, s in rows:
+            print("%-20s %8d %10d %6d %6d %6d %9.2f %8.2f %10d %10d" %
+                  (name[:20], s.get('compiles', 0),
+                   s.get('xla_compiles_net', s.get('xla_compiles', 0)),
+                   s.get('exec_hits', 0), s.get('hlo_hits', 0),
+                   s.get('misses', 0), s.get('compile_s', 0.0),
+                   s.get('xla_compile_s', 0.0),
+                   s.get('bytes_read', 0), s.get('bytes_written', 0)))
     return out
 
 

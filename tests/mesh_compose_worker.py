@@ -99,6 +99,18 @@ def main():
     # repo-standard tolerance for single-vs-mesh on CPU fastmath
     # (test_pipeline.py:86); observed divergence is ~1e-7 relative
     np.testing.assert_allclose(single, multi, rtol=2e-3, atol=1e-5)
+    # persistent compile cache (ISSUE 5): when the caller points
+    # PTPU_COMPILE_CACHE_DIR at a shared dir, report the counters so the
+    # test can assert a warm re-run skips the recompile of the largest
+    # mesh ever compiled here
+    from paddle_tpu.core import compile_cache as cc
+    if cc.enabled():
+        import json
+        s = cc.stats()
+        print('CC_STATS %s' % json.dumps(
+            {k: s[k] for k in ('exec_hits', 'hlo_hits', 'misses',
+                               'compiles', 'xla_compiles_net')}
+            | {'compile_s': round(s['compile_s'], 2)}))
     print("MESH_COMPOSE_OK n=%d %s single=%r multi=%r"
           % (n, ' '.join('%s=%d' % (a, sizes[a]) for a in AXES),
              single, multi))
