@@ -46,6 +46,7 @@ from .io import (save_vars, save_params, save_persistables, load_vars,
                  load_params, load_persistables, save_inference_model,
                  load_inference_model)
 from . import core
+from .core.checkpoint import CheckpointManager
 from . import passes
 from .passes import ProgramVerifyError
 from . import contrib

@@ -1,0 +1,558 @@
+"""Async crash-consistent checkpointing (ISSUE 6 tentpole).
+
+The reference production stack survived failure with two mechanisms: the
+Go pserver wrote CRC-checked atomic-rename checkpoints
+(go/pserver/service.go:346) and the master re-leased timed-out task
+chunks (go/master/service.go:89). `CheckpointManager` is the TPU-native
+composition of both with the warm-start tier (core/compile_cache.py):
+
+1. **Snapshot off the step loop** — at a step boundary the manager
+   copies the scope's persistable state device->host (async D2H
+   initiation first, then one blocking materialize + copy per array; the
+   copy is mandatory because the NEXT dispatch DONATES the state buffers
+   — a background reader racing a donated buffer reads freed memory).
+   The measured snapshot time is the only stall the step loop ever sees;
+   it is surfaced as checkpoint-stall %% in
+   `profiler.training_report()`.
+2. **Background writer** — one daemon thread serializes shards into a
+   `.tmp-` staging directory (per-file fsync + sha256 manifest), makes
+   the checkpoint live with ONE atomic `os.replace` of the directory,
+   then appends a commit record to a flock-guarded `COMMITS.jsonl`
+   journal and applies keep-last-N retention (evictions journaled too).
+   A crash at ANY byte leaves either a fully-live checkpoint or an
+   ignorable staging dir — never a half-readable one.
+3. **Degrade, don't crash** — write-path errors (ENOSPC, EIO — the
+   fault-injection harness in testing/faults.py produces them on
+   demand) warn loudly and retry with exponential backoff; after
+   `max_retries` the checkpoint is abandoned (counted in `stats`) and
+   TRAINING CONTINUES. The writer thread never propagates into the step
+   loop.
+4. **Restore = newest fully-committed** — `restore()` scans candidates
+   newest-first and verifies COMMIT record + manifest digest + per-file
+   sha256 before loading anything; a partial or corrupt checkpoint is
+   skipped with a loud warning, NEVER silently loaded. The restored meta
+   carries the executor step counter (so the per-step rng stream — and
+   therefore the loss curve — continues bit-exactly) and the elastic
+   task-journal position (reader/elastic.py), so a killed trainer
+   resumes with params + data position + compile-cache warm hit.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import queue
+import shutil
+import threading
+import time
+import warnings
+
+import numpy as np
+
+try:
+    import fcntl
+except ImportError:          # non-POSIX: no advisory locking available
+    fcntl = None
+
+_MANIFEST = 'MANIFEST.json'
+_COMMIT = 'COMMIT.json'
+_JOURNAL = 'COMMITS.jsonl'
+_PREFIX = 'ckpt-'
+_TMP_PREFIX = '.tmp-'
+_VERSION = 1
+
+# write-path indirection points: testing/faults.py wraps these to inject
+# ENOSPC/EIO without touching the filesystem layer for real
+_open_for_write = open
+_fsync = os.fsync
+
+
+def _sha256(data):
+    return hashlib.sha256(data).hexdigest()
+
+
+def _checkpoint_step(name):
+    """Parse the step out of a 'ckpt-<step>' dir name, or None."""
+    if not name.startswith(_PREFIX):
+        return None
+    try:
+        return int(name[len(_PREFIX):])
+    except ValueError:
+        return None
+
+
+def list_checkpoints(dirname):
+    """(step, path) of every live (renamed-in) checkpoint dir, ascending
+    by step. Liveness != committedness: restore() still verifies."""
+    if not os.path.isdir(dirname):
+        return []
+    out = []
+    for name in os.listdir(dirname):
+        step = _checkpoint_step(name)
+        if step is not None and os.path.isdir(os.path.join(dirname, name)):
+            out.append((step, os.path.join(dirname, name)))
+    return sorted(out)
+
+
+def _check_commit(path):
+    """COMMIT record present, MANIFEST present/parseable, and the COMMIT's
+    digest matching the manifest bytes. Returns (manifest, commit);
+    raises ValueError with a precise reason. Shard contents are NOT read
+    here — per-shard digests verify on the single read that loads them."""
+    commit_path = os.path.join(path, _COMMIT)
+    manifest_path = os.path.join(path, _MANIFEST)
+    if not os.path.exists(commit_path):
+        raise ValueError('no COMMIT record (crash before commit)')
+    if not os.path.exists(manifest_path):
+        raise ValueError('no MANIFEST')
+    with open(manifest_path, 'rb') as f:
+        manifest_raw = f.read()
+    try:
+        manifest = json.loads(manifest_raw.decode())
+    except ValueError:
+        raise ValueError('MANIFEST is not valid JSON (torn write?)')
+    try:
+        with open(commit_path) as f:
+            commit = json.load(f)
+    except ValueError:
+        raise ValueError('COMMIT record is not valid JSON (torn write?)')
+    if commit.get('manifest_sha256') != _sha256(manifest_raw):
+        raise ValueError('COMMIT/MANIFEST digest mismatch')
+    return manifest, commit
+
+
+def _read_shard(path, name, ent):
+    """One shard's raw bytes, verified against its manifest entry."""
+    shard = os.path.join(path, name)
+    if not os.path.exists(shard):
+        raise ValueError('missing shard %r' % name)
+    with open(shard, 'rb') as f:
+        raw = f.read()
+    if len(raw) != ent['bytes']:
+        raise ValueError('shard %r is %d bytes, manifest says %d '
+                         '(truncated?)' % (name, len(raw), ent['bytes']))
+    if _sha256(raw) != ent['sha256']:
+        raise ValueError('shard %r sha256 mismatch (corrupt)' % name)
+    return raw
+
+
+def verify_checkpoint(path):
+    """Check one checkpoint dir end to end: COMMIT record present and
+    pointing at this manifest, every shard present with matching sha256
+    and size. Returns (manifest dict, commit dict); raises ValueError
+    with a precise reason on the first violation."""
+    manifest, commit = _check_commit(path)
+    for name, ent in manifest.get('files', {}).items():
+        _read_shard(path, name, ent)
+    return manifest, commit
+
+
+def latest_committed(dirname):
+    """Newest checkpoint that passes full verification, as (step, path,
+    manifest, commit) — or None. Partial/corrupt candidates are skipped
+    with a LOUD warning, never loaded silently. A candidate racing
+    deletion (retention rmtree from another incarnation) counts as
+    unloadable, not fatal — hence OSError alongside ValueError."""
+    for step, path in reversed(list_checkpoints(dirname)):
+        try:
+            manifest, commit = verify_checkpoint(path)
+            return step, path, manifest, commit
+        except (ValueError, OSError) as e:
+            warnings.warn(
+                'checkpoint %s is not loadable: %s — skipping it and '
+                'falling back to an older checkpoint' % (path, e),
+                RuntimeWarning)
+    return None
+
+
+class CheckpointManager(object):
+    """Asynchronous crash-consistent checkpoint writer + restorer.
+
+        mgr = CheckpointManager(dirname, every_steps=100, keep_last_n=3)
+        trainer = MultiStepTrainer(main, steps_per_dispatch=8,
+                                   fetch_list=[loss], checkpoint=mgr)
+        info = trainer.startup(startup)      # restores when a committed
+        ...                                  # checkpoint exists
+        mgr.flush(); mgr.close()             # end of training
+
+    Or drive it directly: `Executor.run_steps(..., checkpoint=mgr)`
+    evaluates the every-N-steps / every-T-seconds policy at each dispatch
+    boundary, and `mgr.save(program, scope, step)` forces one.
+    """
+
+    def __init__(self, dirname, keep_last_n=3, every_steps=None,
+                 every_seconds=None, max_retries=3, retry_backoff_s=0.25,
+                 task_service=None):
+        if keep_last_n is not None and int(keep_last_n) < 1:
+            raise ValueError('keep_last_n must be >= 1, got %r'
+                             % (keep_last_n,))
+        self.dirname = dirname
+        self.keep_last_n = int(keep_last_n) if keep_last_n else None
+        self.every_steps = int(every_steps) if every_steps else None
+        self.every_seconds = float(every_seconds) if every_seconds else None
+        self.max_retries = int(max_retries)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.task_service = task_service
+        self._last_step = None
+        self._last_time = time.monotonic()
+        self._stats_lock = threading.Lock()
+        self.stats = {'snapshots': 0, 'commits': 0, 'failed': 0,
+                      'skipped_busy': 0, 'retries': 0, 'evicted': 0,
+                      'stall_s': 0.0, 'write_s': 0.0, 'bytes_written': 0,
+                      'last_error': None}
+        # depth-1 queue: at most one checkpoint in flight; a boundary that
+        # fires while the writer is busy is SKIPPED (counted), because
+        # queueing snapshots would grow host memory without bound when the
+        # disk is slower than the policy
+        self._jobs = queue.Queue(maxsize=1)
+        self._idle = threading.Event()
+        self._idle.set()
+        self._closed = False
+        self._warned_busy = False
+        self._clean_stale_tmp()
+        self._writer = threading.Thread(target=self._write_loop,
+                                        name='ptpu-ckpt-writer', daemon=True)
+        self._writer.start()
+
+    def _clean_stale_tmp(self):
+        """Remove staging dirs left by a writer that was SIGKILLed
+        mid-write — but only when their owning pid is dead (a concurrent
+        writer's live staging must survive)."""
+        if not os.path.isdir(self.dirname):
+            return
+        for name in os.listdir(self.dirname):
+            if not name.startswith(_TMP_PREFIX):
+                continue
+            try:
+                pid = int(name.rsplit('.', 1)[-1])
+                os.kill(pid, 0)
+                alive = True
+            except (ValueError, ProcessLookupError):
+                alive = False
+            except OSError:
+                alive = True     # EPERM: someone else's live process
+            if not alive:
+                shutil.rmtree(os.path.join(self.dirname, name),
+                              ignore_errors=True)
+
+    # -- policy --------------------------------------------------------
+    def step_boundary(self, executor, program, scope, step):
+        """Called by Executor.run_steps after each dispatch. Evaluates the
+        checkpoint_every(steps|seconds) policy and snapshots when due.
+        Returns the stall seconds this boundary cost (0.0 when idle)."""
+        due = False
+        if self.every_steps is not None:
+            # baseline 0 (or the restore point, set by restore()): the
+            # FIRST checkpoint lands after every_steps trained steps, not
+            # at the first boundary seen
+            base = self._last_step if self._last_step is not None else 0
+            due = step - base >= self.every_steps
+        if not due and self.every_seconds is not None:
+            due = time.monotonic() - self._last_time >= self.every_seconds
+        if not due:
+            return 0.0
+        return self.save(program, scope, step, executor=executor)
+
+    # -- snapshot (the only step-loop work) ----------------------------
+    def _snapshot_state(self, program, scope):
+        """Persistable scope state as host numpy (+ static lod), copied:
+        jax buffers are donated by the next dispatch, so the writer thread
+        must never hold device references."""
+        from .lod import unwrap, lod_of
+        names = [v.name for v in program.list_vars() if v.persistable]
+        vals = [(n, scope.get(n)) for n in sorted(set(names))]
+        vals = [(n, v) for n, v in vals if v is not None]
+        for _n, v in vals:          # start every D2H transfer first
+            data = unwrap(v)
+            start = getattr(data, 'copy_to_host_async', None)
+            if start is not None:
+                try:
+                    start()
+                except Exception:
+                    pass            # best-effort prefetch only
+        out = {}
+        for n, v in vals:
+            arr = np.array(unwrap(v), copy=True)    # blocks; owns memory
+            lod = [np.asarray(l).tolist() for l in lod_of(v)]
+            out[n] = (arr, lod)
+        return out
+
+    def save(self, program, scope, step, executor=None, meta=None,
+             blocking=False):
+        """Snapshot now and enqueue the write. Returns the snapshot stall
+        in seconds. When the writer is still busy with the previous
+        checkpoint the snapshot is skipped (latest-wins would hoard host
+        memory); `blocking=True` waits for the writer instead (and for
+        the write to finish — the final checkpoint of a run)."""
+        if self._closed:
+            raise RuntimeError('CheckpointManager is closed')
+        if blocking:
+            self.flush()
+        elif not self._idle.is_set() or not self._jobs.empty():
+            with self._stats_lock:
+                self.stats['skipped_busy'] += 1
+            if not self._warned_busy:
+                self._warned_busy = True
+                warnings.warn(
+                    'checkpoint writer still busy at a due boundary — '
+                    'skipping this snapshot (repeats are counted in '
+                    "stats['skipped_busy']); lower the checkpoint "
+                    'frequency or speed up the target filesystem',
+                    RuntimeWarning)
+            return 0.0
+        t0 = time.perf_counter()
+        state = self._snapshot_state(program, scope)
+        job_meta = {
+            'version': _VERSION,
+            'step': int(step),
+            'executor_step': int(
+                executor._step_counters.get(program._uid, step))
+            if executor is not None else int(step),
+            'wall_time': time.time(),
+            'random_seed': getattr(program, 'random_seed', 0),
+        }
+        if self.task_service is not None:
+            job_meta['task_journal'] = {
+                'path': getattr(self.task_service, '_journal_path', None),
+                'position': self.task_service.journal_position(),
+                'epoch': self.task_service.epoch,
+            }
+        if meta:
+            job_meta['user'] = meta
+        stall = time.perf_counter() - t0
+        with self._stats_lock:
+            self.stats['snapshots'] += 1
+            self.stats['stall_s'] += stall
+        self._idle.clear()
+        self._jobs.put((state, job_meta))
+        self._last_step = int(step)
+        self._last_time = time.monotonic()
+        if blocking:
+            self.flush()
+        return stall
+
+    def flush(self, timeout=None):
+        """Block until the writer has drained (committed or given up)."""
+        self._idle.wait(timeout)
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._jobs.put(None)
+        self._writer.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- background writer ---------------------------------------------
+    def _write_loop(self):
+        while True:
+            job = self._jobs.get()
+            if job is None:
+                self._idle.set()
+                return
+            state, meta = job
+            t0 = time.perf_counter()
+            for attempt in range(self.max_retries + 1):
+                try:
+                    nbytes = self._write_checkpoint(state, meta)
+                    with self._stats_lock:
+                        self.stats['commits'] += 1
+                        self.stats['bytes_written'] += nbytes
+                    break
+                except Exception as e:      # degrade, never crash the loop
+                    with self._stats_lock:
+                        self.stats['last_error'] = '%s: %s' % (
+                            type(e).__name__, e)
+                    if attempt < self.max_retries:
+                        with self._stats_lock:
+                            self.stats['retries'] += 1
+                        backoff = self.retry_backoff_s * (2 ** attempt)
+                        warnings.warn(
+                            'checkpoint step %d write failed (%s: %s) — '
+                            'retrying in %.2fs (%d/%d); training continues'
+                            % (meta['step'], type(e).__name__, e, backoff,
+                               attempt + 1, self.max_retries),
+                            RuntimeWarning)
+                        time.sleep(backoff)
+                    else:
+                        with self._stats_lock:
+                            self.stats['failed'] += 1
+                        warnings.warn(
+                            'checkpoint step %d ABANDONED after %d retries '
+                            '(%s: %s); training continues on the previous '
+                            'checkpoint' % (meta['step'], self.max_retries,
+                                            type(e).__name__, e),
+                            RuntimeWarning)
+            with self._stats_lock:
+                self.stats['write_s'] += time.perf_counter() - t0
+            self._idle.set()
+
+    def _write_checkpoint(self, state, meta):
+        """One atomic checkpoint: stage dir -> shards (fsync each, sha256
+        while writing) -> MANIFEST -> COMMIT -> one os.replace makes it
+        live -> flock-journaled commit record -> retention."""
+        from ..io import _serialize_tensor, _HashingFile
+        from .lod import LoDArray
+        step = meta['step']
+        final = os.path.join(self.dirname, '%s%d' % (_PREFIX, step))
+        tmp = os.path.join(self.dirname, '%sckpt-%d.%d' % (
+            _TMP_PREFIX, step, os.getpid()))
+        os.makedirs(self.dirname, exist_ok=True)
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        try:
+            files = {}
+            for name, (arr, lod) in sorted(state.items()):
+                value = LoDArray(arr, [np.asarray(l, np.int32)
+                                       for l in lod]) if lod else arr
+                with _open_for_write(os.path.join(tmp, name), 'wb') as f:
+                    hf = _HashingFile(f)
+                    _serialize_tensor(hf, value)
+                    f.flush()
+                    _fsync(f.fileno())
+                files[name] = {'sha256': hf.sha.hexdigest(),
+                               'bytes': hf.nbytes}
+            manifest_raw = json.dumps(
+                {'version': _VERSION, 'step': step, 'files': files,
+                 'meta': meta}, indent=1, sort_keys=True).encode()
+            with _open_for_write(os.path.join(tmp, _MANIFEST), 'wb') as f:
+                f.write(manifest_raw)
+                f.flush()
+                _fsync(f.fileno())
+            commit = {'step': step, 'manifest_sha256': _sha256(manifest_raw),
+                      'wall_time': meta['wall_time']}
+            with _open_for_write(os.path.join(tmp, _COMMIT), 'wb') as f:
+                f.write(json.dumps(commit).encode())
+                f.flush()
+                _fsync(f.fileno())
+            if os.path.isdir(final):        # re-checkpoint of a resumed step
+                shutil.rmtree(final)
+            os.replace(tmp, final)          # THE commit point
+            self._fsync_dir(self.dirname)
+        except Exception:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        nbytes = sum(e['bytes'] for e in files.values())
+        # journal + retention are post-commit bookkeeping: a failure here
+        # must not fail (or re-run) the already-live checkpoint
+        try:
+            self._journal_and_retain(step, commit)
+        except Exception as e:
+            warnings.warn('checkpoint step %d committed but journal/'
+                          'retention failed: %s' % (step, e), RuntimeWarning)
+        return nbytes
+
+    @staticmethod
+    def _fsync_dir(path):
+        try:
+            fd = os.open(path, os.O_RDONLY)
+        except OSError:
+            return
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    def _journal_and_retain(self, step, commit):
+        journal = os.path.join(self.dirname, _JOURNAL)
+        with open(journal, 'a') as jf:
+            if fcntl is not None:
+                try:
+                    fcntl.flock(jf, fcntl.LOCK_EX)
+                except OSError:
+                    pass        # lockless FS: journaling still append-only
+            jf.write(json.dumps({'event': 'commit', 'step': step,
+                                 'manifest_sha256': commit['manifest_sha256'],
+                                 'wall_time': commit['wall_time']}) + '\n')
+            evicted = []
+            if self.keep_last_n is not None:
+                live = list_checkpoints(self.dirname)
+                for old_step, old_path in live[:-self.keep_last_n]:
+                    shutil.rmtree(old_path, ignore_errors=True)
+                    evicted.append(old_step)
+                    jf.write(json.dumps({'event': 'evict',
+                                         'step': old_step}) + '\n')
+            jf.flush()
+            _fsync(jf.fileno())
+            # flock released on close
+        if evicted:
+            with self._stats_lock:
+                self.stats['evicted'] += len(evicted)
+
+    # -- restore --------------------------------------------------------
+    def restore(self, executor=None, program=None, scope=None):
+        """Load the newest fully-committed checkpoint into `scope` (the
+        global scope by default). Returns an info dict {'step', 'path',
+        'meta', 'task_journal'} or None when no committed checkpoint
+        exists. Candidates are tried newest-first, each shard verified on
+        the SAME read that loads it (one disk pass per shard — the
+        seconds-scale-resume path never reads a checkpoint twice);
+        partial/corrupt candidates are skipped with a loud warning and
+        nothing of them reaches the scope. When `executor` and `program`
+        are given, the executor's per-program step counter is restored so
+        the per-step rng stream — and therefore every subsequent loss —
+        continues bit-exactly."""
+        for step, path in reversed(list_checkpoints(self.dirname)):
+            try:
+                manifest, _commit = _check_commit(path)
+                info = self.load_into_scope(path, manifest,
+                                            program=program, scope=scope)
+            except (ValueError, OSError) as e:
+                warnings.warn(
+                    'checkpoint %s is not loadable: %s — skipping it and '
+                    'falling back to an older checkpoint' % (path, e),
+                    RuntimeWarning)
+                continue
+            meta = manifest.get('meta', {})
+            if executor is not None and program is not None:
+                executor._step_counters[program._uid] = int(
+                    meta.get('executor_step', step))
+            self._last_step = step
+            self._last_time = time.monotonic()
+            info.update(step=step, path=path, meta=meta,
+                        task_journal=meta.get('task_journal'))
+            return info
+        return None
+
+    @staticmethod
+    def load_into_scope(path, manifest=None, program=None, scope=None):
+        """Deserialize every shard of a checkpoint dir into the scope,
+        verifying each against its manifest entry on the same read. The
+        scope is only touched after EVERY shard decoded — a corrupt late
+        shard must not leave half a checkpoint behind. Returns {'loaded':
+        [names], 'missing': [persistable names the checkpoint does not
+        carry]} — `missing` is warned about, not silently left stale."""
+        import io as _pyio
+        from ..io import _deserialize_tensor
+        from .scope import global_scope
+        scope = scope if scope is not None else global_scope()
+        if manifest is None:
+            manifest, _ = _check_commit(path)
+        files = manifest.get('files', {})
+        decoded = {name: _deserialize_tensor(
+            _pyio.BytesIO(_read_shard(path, name, files[name])))
+            for name in sorted(files)}
+        loaded = []
+        for name, value in decoded.items():
+            scope.set(name, value)
+            loaded.append(name)
+        missing = []
+        if program is not None:
+            missing = sorted({v.name for v in program.list_vars()
+                              if v.persistable
+                              and scope.get(v.name) is not None}
+                             - set(loaded))
+            if missing:
+                warnings.warn(
+                    'checkpoint %s does not carry persistable vars %r — '
+                    'they keep their startup values (program changed '
+                    'since the checkpoint was written?)' % (path, missing),
+                    RuntimeWarning)
+        return {'loaded': loaded, 'missing': missing}

@@ -189,7 +189,8 @@ class Executor(object):
         # 'infer' and the same counters surface as a bulk-infer source —
         # steps relabel as batches)
         self._dispatch_stats = {'dispatches': 0, 'steps': 0,
-                                'tail_flushes': 0, 'host_stall_s': 0.0}
+                                'tail_flushes': 0, 'host_stall_s': 0.0,
+                                'ckpt_stall_s': 0.0, 'run_s': 0.0}
         self._profile_role = 'training'
         self._prof_registered = False
 
@@ -331,7 +332,7 @@ class Executor(object):
     # ------------------------------------------------------------------
     def run_steps(self, program=None, reader=None, fetch_list=None,
                   steps=None, feed=None, scope=None, return_numpy=True,
-                  fetch_policy='final'):
+                  fetch_policy='final', checkpoint=None):
         """Run K training steps in ONE device dispatch (in-graph loop).
 
         The traced step body is wrapped in a lax.scan over K pre-staged
@@ -360,6 +361,13 @@ class Executor(object):
         every-K thinning a periodic-logging loop wants); 'stack' returns
         every fetch stacked over a leading K axis, bit-matching the K
         sequential per-step fetch values.
+
+        checkpoint: an optional core.checkpoint.CheckpointManager whose
+        every-N-steps / every-T-seconds policy is evaluated at this
+        dispatch boundary (after the new state is committed to the
+        scope). Only the device->host snapshot stalls the loop; the
+        write happens on the manager's background thread, and the stall
+        is reported as ckpt%% in profiler.training_report().
         """
         if fetch_policy not in ('final', 'stack'):
             raise ValueError("fetch_policy must be 'final' or 'stack', "
@@ -380,7 +388,7 @@ class Executor(object):
         fetch_names = [_fetch_name(f) for f in fetch_list]
 
         import time as _time
-        t0 = _time.perf_counter()
+        t_run = t0 = _time.perf_counter()
         feed_vals, k, want = self._gather_step_group(program, reader, feed,
                                                      steps)
         stall = _time.perf_counter() - t0
@@ -424,7 +432,14 @@ class Executor(object):
             st['tail_flushes'] += 1
         st['host_stall_s'] += stall
         self._register_profiler_source()
-        return self._finish(scope, new_state, fetches, return_numpy)
+        out = self._finish(scope, new_state, fetches, return_numpy)
+        if checkpoint is not None:
+            # after _finish: the scope now holds this dispatch's state, so
+            # a snapshot here is a consistent step-boundary cut
+            st['ckpt_stall_s'] += checkpoint.step_boundary(
+                self, program, scope, self._step_counters[program._uid])
+        st['run_s'] += _time.perf_counter() - t_run
+        return out
 
     def _register_profiler_source(self):
         if self._prof_registered:
@@ -458,7 +473,11 @@ class Executor(object):
             return {'dispatches': st['dispatches'], 'steps': st['steps'],
                     'steps_per_dispatch': st['steps'] / d,
                     'tail_flushes': st['tail_flushes'],
-                    'host_stall_ms': st['host_stall_s'] * 1e3}
+                    'host_stall_ms': st['host_stall_s'] * 1e3,
+                    'ckpt_stall_ms': st['ckpt_stall_s'] * 1e3,
+                    'ckpt_stall_pct': (100.0 * st['ckpt_stall_s']
+                                       / st['run_s'])
+                    if st['run_s'] else 0.0}
         (_profiler.register_infer_source if infer
          else _profiler.register_training_source)(name, snap)
 

@@ -24,7 +24,8 @@ from .ref_format import (load_reference_inference_model,
 from .export import export_compiled, export_train_step
 from .serve import (CompiledPredictor, load_compiled,
                     CompiledTrainer, load_trainer)
-from .batching import BatchingPredictor, ServingStats, load_batching
+from .batching import (BatchingPredictor, ServingStats, load_batching,
+                       ServerOverloaded, DeadlineExceeded)
 
 __all__ = ['Config', 'Predictor', 'create_predictor',
            'load_reference_inference_model',
@@ -32,4 +33,5 @@ __all__ = ['Config', 'Predictor', 'create_predictor',
            'load_reference_persistables',
            'export_compiled', 'CompiledPredictor', 'load_compiled',
            'export_train_step', 'CompiledTrainer', 'load_trainer',
-           'BatchingPredictor', 'ServingStats', 'load_batching']
+           'BatchingPredictor', 'ServingStats', 'load_batching',
+           'ServerOverloaded', 'DeadlineExceeded']

@@ -63,6 +63,17 @@ _SOURCE_SEQ = _serve._SOURCE_SEQ
 _maybe_profiler = _serve._maybe_profiler
 
 
+class ServerOverloaded(RuntimeError):
+    """The request queue is beyond max_queue: this request was shed
+    immediately (fast-fail) instead of being queued into unbounded
+    latency. Back off and retry, or add capacity."""
+
+
+class DeadlineExceeded(TimeoutError):
+    """The request's deadline_ms elapsed while it waited in the queue; it
+    was never dispatched (no device work was wasted on it)."""
+
+
 def _resolve(future, result=None, exc=None):
     """Resolve a request future, tolerating caller-side cancel(): queued
     futures are never marked running, so a client may cancel at any time —
@@ -108,13 +119,15 @@ def _batch_rows(sig):
 
 
 class _Request(object):
-    __slots__ = ('arrays', 'rows', 'future', 't_submit')
+    __slots__ = ('arrays', 'rows', 'future', 't_submit', 'deadline')
 
-    def __init__(self, arrays, rows, future):
+    def __init__(self, arrays, rows, future, deadline_ms=None):
         self.arrays = arrays
         self.rows = rows
         self.future = future
         self.t_submit = time.perf_counter()
+        self.deadline = (self.t_submit + deadline_ms / 1e3
+                         if deadline_ms is not None else None)
 
 
 class ServingStats(object):
@@ -130,6 +143,8 @@ class ServingStats(object):
         self.batches = 0
         self.filled_rows = 0
         self.bucket_rows = 0
+        self.shed = 0      # fast-failed at submit: queue beyond max_queue
+        self.expired = 0   # deadline_ms elapsed while queued
 
     def reset(self):
         """Zero the counters and latency window (queue_depth is a live
@@ -141,6 +156,8 @@ class ServingStats(object):
             self.batches = 0
             self.filled_rows = 0
             self.bucket_rows = 0
+            self.shed = 0
+            self.expired = 0
 
     def record_batch(self, filled, bucket, latencies_s):
         with self._lock:
@@ -158,6 +175,8 @@ class ServingStats(object):
             snap = {'queue_depth': int(self.queue_depth),
                     'requests': int(self.requests),
                     'batches': int(self.batches),
+                    'shed': int(self.shed),
+                    'expired': int(self.expired),
                     'occupancy': round(self.filled_rows / self.bucket_rows, 4)
                     if self.bucket_rows else 0.0}
         if lat.size:
@@ -187,7 +206,8 @@ class BatchingPredictor(object):
     """
 
     def __init__(self, artifact_dir, platform=None, max_batch_size=None,
-                 batch_timeout_ms=5.0, inflight=2, stats_window=8192):
+                 batch_timeout_ms=5.0, inflight=2, stats_window=8192,
+                 max_queue=None):
         with open(os.path.join(artifact_dir, _serve._SIGNATURE)) as f:
             top_sig = json.load(f)
         # lod rejection first: feeds are the same in every bucket, and
@@ -232,6 +252,10 @@ class BatchingPredictor(object):
         largest = self._buckets[-1]
         self._max_rows = min(max_batch_size or largest, largest)
         self._timeout_s = max(batch_timeout_ms, 0.0) / 1e3
+        # load-shedding bound: queued requests beyond this fast-fail with
+        # ServerOverloaded instead of growing tail latency unboundedly
+        # (every queued request behind a full device is pure added p99)
+        self._max_queue = int(max_queue) if max_queue else None
         self._queue = queue.Queue()
         self._inflight = queue.Queue(maxsize=max(1, int(inflight)))
         self.stats = ServingStats(stats_window)
@@ -267,14 +291,36 @@ class BatchingPredictor(object):
     def buckets(self):
         return list(self._buckets)
 
-    def submit(self, inputs):
+    def submit(self, inputs, deadline_ms=None):
         """Enqueue one request; returns a Future resolving to the list of
         per-fetch numpy arrays sliced to this request's rows. Validation
         errors fail THIS future only (a bad request never poisons a
-        batch)."""
+        batch). With `deadline_ms`, a request still queued when the
+        deadline elapses resolves to DeadlineExceeded instead of being
+        dispatched late. When the queue is beyond `max_queue`, the future
+        resolves to ServerOverloaded immediately — load is shed at the
+        door, before any padding or device work."""
         if self._closed:
             raise RuntimeError('BatchingPredictor is closed')
         fut = Future()
+
+        def _shed_locked():
+            # must hold stats._lock: the depth check and the enqueue
+            # increment form one critical section, or N concurrent
+            # submits at depth max_queue-1 would ALL pass and overshoot
+            # the bound by the submitter concurrency
+            if self._max_queue is not None \
+                    and self.stats.queue_depth >= self._max_queue:
+                self.stats.shed += 1
+                fut.set_exception(ServerOverloaded(
+                    'queue depth %d >= max_queue %d — request shed'
+                    % (self.stats.queue_depth, self._max_queue)))
+                return True
+            return False
+
+        with self.stats._lock:     # fast-fail before validation work
+            if _shed_locked():
+                return fut
         try:
             arrays, rows = self._validate(inputs)
         except Exception as e:
@@ -284,13 +330,15 @@ class BatchingPredictor(object):
             if self._closed:
                 raise RuntimeError('BatchingPredictor is closed')
             with self.stats._lock:
+                if _shed_locked():  # re-check atomically with the enqueue
+                    return fut
                 self.stats.queue_depth += 1
-            self._queue.put(_Request(arrays, rows, fut))
+            self._queue.put(_Request(arrays, rows, fut, deadline_ms))
         return fut
 
-    def run(self, inputs, timeout=None):
+    def run(self, inputs, timeout=None, deadline_ms=None):
         """Synchronous single-request path: submit + wait."""
-        return self.submit(inputs).result(timeout)
+        return self.submit(inputs, deadline_ms=deadline_ms).result(timeout)
 
     def warmup(self):
         """Compile every bucket ahead of traffic (the reference predictor's
@@ -377,6 +425,20 @@ class BatchingPredictor(object):
                 % (rows, self._max_rows))
         return arrays, rows
 
+    def _reap_expired(self, req):
+        """Resolve a request whose deadline elapsed in the queue; True
+        when reaped (it must not join a batch)."""
+        if req.deadline is None or time.perf_counter() <= req.deadline:
+            return False
+        with self.stats._lock:
+            self.stats.queue_depth -= 1
+            self.stats.expired += 1
+        _resolve(req.future, exc=DeadlineExceeded(
+            'request expired after %.1f ms in queue (deadline_ms=%.1f)'
+            % ((time.perf_counter() - req.t_submit) * 1e3,
+               (req.deadline - req.t_submit) * 1e3)))
+        return True
+
     def _coalesce_loop(self):
         carry = None
         while True:
@@ -384,6 +446,8 @@ class BatchingPredictor(object):
             carry = None
             if req is _STOP:
                 return
+            if self._reap_expired(req):
+                continue
             batch, rows = [req], req.rows
             deadline = time.perf_counter() + self._timeout_s
             while rows < self._max_rows:
@@ -397,6 +461,8 @@ class BatchingPredictor(object):
                 if nxt is _STOP:
                     carry = _STOP  # dispatch this batch, then stop
                     break
+                if self._reap_expired(nxt):
+                    continue
                 if rows + nxt.rows > self._max_rows:
                     carry = nxt  # seed the next batch
                     break

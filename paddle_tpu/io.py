@@ -11,6 +11,7 @@ to JSON (programs are plain-python IR; see framework.py).
 """
 from __future__ import annotations
 
+import hashlib
 import io as _io
 import json
 import os
@@ -26,6 +27,79 @@ from .core.lod import LoDArray, unwrap, lod_of
 
 _MAGIC = b'PTPU'
 _VERSION = 2  # v2 adds a crc32 of the payload to the header (v1 readable)
+# per-save digest manifest: written LAST (atomic rename), so its absence
+# or any digest mismatch marks a partial/interrupted save — a directory
+# mixing files from two saves must fail loudly at load, never load-in
+# silently with stale params (go/pserver/service.go:346's guarantee at
+# directory granularity)
+_MANIFEST_FILE = '.ptpu_manifest.json'
+
+
+class _HashingFile(object):
+    """File wrapper that sha256s and counts everything written through it
+    (manifest digests without a second read of the file)."""
+
+    def __init__(self, f):
+        self._f = f
+        self.sha = hashlib.sha256()
+        self.nbytes = 0
+
+    def write(self, data):
+        self._f.write(data)
+        self.sha.update(data)
+        self.nbytes += len(data)
+
+
+def _write_manifest(dirname, entries):
+    """Merge `entries` ({relname: {'sha256', 'bytes'}}) into the dir's
+    manifest, atomically. Merging (not replacing) keeps earlier saves into
+    the same dir verifiable — save_inference_model writes __model__ and
+    params through separate calls."""
+    path = os.path.join(dirname, _MANIFEST_FILE)
+    files = {}
+    old = _load_manifest(dirname, tolerate_corrupt=True)
+    if old is not None:
+        files.update(old.get('files', {}))
+    files.update(entries)
+    with _atomic_file(path) as f:
+        f.write(json.dumps({'version': 1, 'files': files},
+                           sort_keys=True).encode())
+    return path
+
+
+def _load_manifest(dirname, tolerate_corrupt=False):
+    path = os.path.join(dirname, _MANIFEST_FILE)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, 'rb') as f:
+            return json.loads(f.read().decode())
+    except ValueError:
+        if tolerate_corrupt:
+            return None
+        raise RuntimeError(
+            "save manifest %s is unreadable (torn write?) — the save "
+            "that produced this directory did not complete; re-save or "
+            "delete the manifest to load unverified" % path)
+
+
+def _verify_against_manifest(manifest, name, raw, dirname):
+    """One loaded file vs its manifest entry. A manifest that exists but
+    does not list `name` means the file predates (or outlived) the last
+    completed save — stale; a digest mismatch means corrupt/partial."""
+    ent = manifest.get('files', {}).get(name)
+    if ent is None:
+        raise RuntimeError(
+            "file %r in %s has no entry in the save manifest — it is "
+            "stale (left over from an older save) or the save that "
+            "should have written it was interrupted; refusing to load "
+            "it silently" % (name, dirname))
+    if len(raw) != ent['bytes'] or \
+            hashlib.sha256(raw).hexdigest() != ent['sha256']:
+        raise RuntimeError(
+            "file %r in %s does not match the save manifest (%d bytes vs "
+            "%d expected) — partial or corrupt save; refusing to load"
+            % (name, dirname, len(raw), ent['bytes']))
 
 
 # ---------------------------------------------------------------------------
@@ -286,22 +360,32 @@ def save_vars(executor, dirname, main_program=None, vars=None,
     if pid == 0:
         try:
             os.makedirs(dirname, exist_ok=True)
+            entries = {}
             if filename is None:
                 for v, val in present:
                     path = os.path.join(dirname, v.name)
                     with _atomic_file(path) as f:
-                        _serialize_tensor(f, val)
+                        hf = _HashingFile(f)
+                        _serialize_tensor(hf, val)
+                    entries[v.name] = {'sha256': hf.sha.hexdigest(),
+                                       'bytes': hf.nbytes}
                     written.append(path)
             else:
                 path = os.path.join(dirname, filename)
                 with _atomic_file(path) as f:
-                    f.write(struct.pack('<I', len(present)))
+                    hf = _HashingFile(f)
+                    hf.write(struct.pack('<I', len(present)))
                     for v, val in present:
                         name = v.name.encode()
-                        f.write(struct.pack('<I', len(name)))
-                        f.write(name)
-                        _serialize_tensor(f, val)
+                        hf.write(struct.pack('<I', len(name)))
+                        hf.write(name)
+                        _serialize_tensor(hf, val)
+                entries[filename] = {'sha256': hf.sha.hexdigest(),
+                                     'bytes': hf.nbytes}
                 written.append(path)
+            # the manifest is written LAST: its digests committing to the
+            # files above is what makes an interrupted save detectable
+            written.append(_write_manifest(dirname, entries))
         except Exception as e:
             # the barrier below must still be reached — process 0 raising
             # while the others wait in a collective would hang the job
@@ -314,7 +398,9 @@ def save_vars(executor, dirname, main_program=None, vars=None,
 
 
 def _read_var_blob(dirname, names, filename):
-    """Read requested vars into the single-file wire format (in memory)."""
+    """Read requested vars into the single-file wire format (in memory),
+    verifying each file against the save manifest when one exists."""
+    manifest = _load_manifest(dirname)
     buf = _io.BytesIO()
     if filename is None:
         entries = []
@@ -324,7 +410,10 @@ def _read_var_blob(dirname, names, filename):
                 raise RuntimeError("missing checkpoint file for var %r at %s"
                                    % (name, path))
             with open(path, 'rb') as f:
-                entries.append((name, f.read()))
+                raw = f.read()
+            if manifest is not None:
+                _verify_against_manifest(manifest, name, raw, dirname)
+            entries.append((name, raw))
         buf.write(struct.pack('<I', len(entries)))
         for name, raw in entries:
             nb = name.encode()
@@ -333,7 +422,10 @@ def _read_var_blob(dirname, names, filename):
             buf.write(raw)
     else:
         with open(os.path.join(dirname, filename), 'rb') as f:
-            buf.write(f.read())
+            raw = f.read()
+        if manifest is not None:
+            _verify_against_manifest(manifest, filename, raw, dirname)
+        buf.write(raw)
     return buf.getvalue()
 
 
@@ -369,6 +461,7 @@ def load_vars(executor, dirname, main_program=None, vars=None,
         if filename is None and missing:
             raise RuntimeError("missing checkpoint vars: %r" % missing)
     elif filename is None:
+        manifest = _load_manifest(dirname)
         loaded = {}
         for v in vars:
             path = os.path.join(dirname, v.name)
@@ -376,10 +469,17 @@ def load_vars(executor, dirname, main_program=None, vars=None,
                 raise RuntimeError("missing checkpoint file for var %r at %s"
                                    % (v.name, path))
             with open(path, 'rb') as f:
-                loaded[v.name] = _deserialize_tensor(f)
+                raw = f.read()
+            if manifest is not None:
+                _verify_against_manifest(manifest, v.name, raw, dirname)
+            loaded[v.name] = _deserialize_tensor(_io.BytesIO(raw))
     else:
+        manifest = _load_manifest(dirname)
         with open(os.path.join(dirname, filename), 'rb') as f:
-            loaded = _parse_var_blob(f.read())
+            raw = f.read()
+        if manifest is not None:
+            _verify_against_manifest(manifest, filename, raw, dirname)
+        loaded = _parse_var_blob(raw)
     for v in vars:
         if v.name in loaded:
             scope.set(v.name, loaded[v.name])
@@ -440,18 +540,27 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     pid, _pcount = _proc_info()
     if pid == 0:  # process-0 guard; save_persistables barriers below
         os.makedirs(dirname, exist_ok=True)
-        model_path = os.path.join(dirname, model_filename or '__model__')
-        with _atomic_file(model_path) as f:
-            f.write(json.dumps(d).encode())
+        model_name = model_filename or '__model__'
+        with _atomic_file(os.path.join(dirname, model_name)) as f:
+            hf = _HashingFile(f)
+            hf.write(json.dumps(d).encode())
+        # __model__ joins the manifest so a stale program mixed into the
+        # dir fails as loudly as stale params would
+        _write_manifest(dirname, {model_name: {
+            'sha256': hf.sha.hexdigest(), 'bytes': hf.nbytes}})
     save_persistables(executor, dirname, pruned, params_filename)
     return fetch_names
 
 
 def load_inference_model(dirname, executor, model_filename=None,
                          params_filename=None):
-    model_path = os.path.join(dirname, model_filename or '__model__')
-    with open(model_path, 'rb') as f:
-        d = json.loads(f.read().decode())
+    model_name = model_filename or '__model__'
+    with open(os.path.join(dirname, model_name), 'rb') as f:
+        raw = f.read()
+    manifest = _load_manifest(dirname)
+    if manifest is not None:
+        _verify_against_manifest(manifest, model_name, raw, dirname)
+    d = json.loads(raw.decode())
     program = program_from_dict(d)
     load_persistables(executor, dirname, program, params_filename)
     feed_names = d.get('feed_names', [])

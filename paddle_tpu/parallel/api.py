@@ -51,7 +51,7 @@ class MultiStepTrainer(object):
 
     def __init__(self, program, steps_per_dispatch=8, fetch_list=None,
                  fetch_policy='final', place=None, scope=None,
-                 executor=None):
+                 executor=None, checkpoint=None):
         from ..executor import Executor
         from ..framework import TPUPlace
         if int(steps_per_dispatch) < 1:
@@ -64,13 +64,32 @@ class MultiStepTrainer(object):
         self.scope = scope
         self.executor = executor if executor is not None else Executor(
             place if place is not None else TPUPlace())
+        # fault-tolerance policy (core/checkpoint.py): evaluated at every
+        # dispatch boundary; startup() restores from the newest committed
+        # checkpoint so a SIGKILLed trainer resumes where it stopped
+        self.checkpoint = checkpoint
+        self.resume_info = None
 
     def startup(self, startup_program):
         """Run the startup program so every state var the K-step scan
         carries is materialized (run_steps refuses to create scan-carry
-        entries mid-loop). Returns self."""
+        entries mid-loop). With a checkpoint manager attached, then
+        restore from the newest fully-committed checkpoint when one
+        exists — kill-and-resume is the SAME script run twice. Returns
+        self; resume_info/resume_step tell whether (and where) a restore
+        happened."""
         self.executor.run(startup_program, scope=self.scope)
+        if self.checkpoint is not None:
+            self.resume_info = self.checkpoint.restore(
+                executor=self.executor, program=self.program,
+                scope=self.scope)
         return self
+
+    @property
+    def resume_step(self):
+        """Steps already trained before this incarnation (0 on a cold
+        start)."""
+        return int(self.resume_info['step']) if self.resume_info else 0
 
     def step_group(self, feed=None, reader=None, steps=None):
         """One dispatch of up to steps_per_dispatch steps; returns the
@@ -81,7 +100,8 @@ class MultiStepTrainer(object):
             fetch_list=self.fetch_list,
             steps=int(steps) if steps is not None
             else self.steps_per_dispatch,
-            scope=self.scope, fetch_policy=self.fetch_policy)
+            scope=self.scope, fetch_policy=self.fetch_policy,
+            checkpoint=self.checkpoint)
 
     def iter_epoch(self, reader):
         """Drive one epoch from a PyReader, yielding fetches per dispatch;

@@ -145,14 +145,15 @@ def serving_report():
         out[name] = snap
         rows.append((name, snap))
     if rows:
-        print("%-32s %6s %8s %8s %5s %9s %9s %9s" %
+        print("%-32s %6s %8s %8s %5s %7s %7s %9s %9s %9s" %
               ('Serving source', 'queue', 'requests', 'batches', 'occ',
-               'p50(ms)', 'p95(ms)', 'p99(ms)'))
+               'shed', 'expired', 'p50(ms)', 'p95(ms)', 'p99(ms)'))
         for name, s in rows:
-            print("%-32s %6d %8d %8d %5.2f %9.2f %9.2f %9.2f" %
+            print("%-32s %6d %8d %8d %5.2f %7d %7d %9.2f %9.2f %9.2f" %
                   (name[:32], s.get('queue_depth', 0),
                    s.get('requests', 0), s.get('batches', 0),
-                   s.get('occupancy', 0.0), s.get('p50_ms', 0.0),
+                   s.get('occupancy', 0.0), s.get('shed', 0),
+                   s.get('expired', 0), s.get('p50_ms', 0.0),
                    s.get('p95_ms', 0.0), s.get('p99_ms', 0.0)))
     return out
 
@@ -189,14 +190,16 @@ def training_report():
         out[name] = snap
         rows.append((name, snap))
     if rows:
-        print("%-32s %10s %8s %10s %6s %12s" %
+        print("%-32s %10s %8s %10s %6s %12s %9s %6s" %
               ('Training source', 'dispatches', 'steps', 'steps/disp',
-               'tails', 'stall(ms)'))
+               'tails', 'stall(ms)', 'ckpt(ms)', 'ckpt%'))
         for name, s in rows:
-            print("%-32s %10d %8d %10.2f %6d %12.2f" %
+            print("%-32s %10d %8d %10.2f %6d %12.2f %9.2f %6.2f" %
                   (name[:32], s.get('dispatches', 0), s.get('steps', 0),
                    s.get('steps_per_dispatch', 0.0),
-                   s.get('tail_flushes', 0), s.get('host_stall_ms', 0.0)))
+                   s.get('tail_flushes', 0), s.get('host_stall_ms', 0.0),
+                   s.get('ckpt_stall_ms', 0.0),
+                   s.get('ckpt_stall_pct', 0.0)))
     return out
 
 

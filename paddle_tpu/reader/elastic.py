@@ -26,8 +26,10 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import threading
 import time
+import warnings
 
 try:
     import fcntl
@@ -47,7 +49,9 @@ class TaskService(object):
     failure cap, and an optional journal for crash recovery."""
 
     def __init__(self, tasks, journal_path=None, lease_timeout_s=60.0,
-                 max_failures=3):
+                 max_failures=3, retry_backoff_s=0.05,
+                 retry_backoff_max_s=5.0, retry_jitter=0.25,
+                 journal_limit=None):
         self._all = {str(t): t for t in tasks}
         if len(self._all) != len(tasks):
             raise ValueError("task ids (str(task)) must be unique")
@@ -59,19 +63,31 @@ class TaskService(object):
         self._dropped = set()                 # failure cap exceeded
         self._failures = {}                   # id -> count
         self._progress = {}                   # id -> samples consumed
+        self._not_before = {}                 # id -> backoff deadline
         self._meta = {}                       # journaled config facts
         self._epoch = 0
         self._lease_timeout = float(lease_timeout_s)
         self._max_failures = int(max_failures)
+        # jittered exponential backoff before re-leasing a FAILED task: an
+        # immediate requeue lets a poisoned task (bad file, flaky mount)
+        # hot-loop through its whole failure cap in milliseconds and
+        # starve good tasks of worker attention (the Go master re-leased
+        # on TIMEOUT, which is an implicit backoff this library lost)
+        self._backoff_base = float(retry_backoff_s)
+        self._backoff_max = float(retry_backoff_max_s)
+        self._backoff_jitter = float(retry_jitter)
+        self._backoff_rng = random.Random()
         self._journal_path = journal_path
         self._journal_f = None
         if journal_path:
-            self._recover(journal_path)
             self._journal_f = open(journal_path, 'a')
             # single-writer guard: the Go master serialized all queue
             # mutation through one server (service.go); as a library, two
             # feeders pointed at one journal would interleave appends
-            # silently — refuse instead (service.go:89's invariant)
+            # silently — refuse instead (service.go:89's invariant).
+            # Acquired BEFORE the journal_limit truncation below: a
+            # rejected second writer must never destroy the live
+            # holder's journal tail
             if fcntl is not None:
                 import errno
                 try:
@@ -95,6 +111,17 @@ class TaskService(object):
                         "journal %r: filesystem does not support flock "
                         "(%s); the single-writer guard is not enforced"
                         % (journal_path, e))
+            if journal_limit is not None \
+                    and os.path.getsize(journal_path) > int(journal_limit):
+                # checkpoint-consistent resume (core/checkpoint.py): the
+                # restored params predate the journal's tail records, so
+                # the tail describes consumption the model never trained
+                # on — truncate to the checkpointed position so that data
+                # re-dispatches instead of being silently skipped
+                # (O_APPEND writes land at the new EOF)
+                os.truncate(journal_path, int(journal_limit))
+                self._journal_f.seek(0, os.SEEK_END)  # keep tell() honest
+            self._recover(journal_path)
 
     # -- journal -----------------------------------------------------------
     def _recover(self, path):
@@ -153,11 +180,25 @@ class TaskService(object):
         if n >= self._max_failures:
             self._dropped.add(task_id)  # cap hit: stop poisoning the queue
             self._journal({'event': 'dropped', 'task': task_id})
-        elif task_id not in self._todo and task_id not in self._pending:
-            # no duplicate queue entries: a late task_failed() from a
-            # worker whose lease already expired (and re-dispatched) must
-            # not enqueue the task a second time
-            self._todo.append(task_id)
+            # loud and exactly once: silently shrinking the epoch is how a
+            # bad shard goes unnoticed for a week of training
+            warnings.warn(
+                "task %r DROPPED after %d failures (last: %s) — its "
+                "samples will not be trained on this epoch; inspect the "
+                "task and raise max_failures if it is expected to be "
+                "flaky" % (task_id, n, why), RuntimeWarning)
+        else:
+            if task_id not in self._todo and task_id not in self._pending:
+                # no duplicate queue entries: a late task_failed() from a
+                # worker whose lease already expired (and re-dispatched)
+                # must not enqueue the task a second time
+                self._todo.append(task_id)
+            if self._backoff_base > 0:
+                delay = min(self._backoff_max,
+                            self._backoff_base * (2 ** (n - 1)))
+                delay *= 1 + self._backoff_jitter * (
+                    2 * self._backoff_rng.random() - 1)
+                self._not_before[task_id] = time.monotonic() + delay
 
     def get_task(self):
         """Lease the next task. Returns (task_id, task, skip) or None when
@@ -166,19 +207,29 @@ class TaskService(object):
         now = time.monotonic()
         with self._lock:
             self._requeue_expired(now)
-            while self._todo:
-                task_id = self._todo.pop(0)
-                if task_id in self._dropped or task_id in self._pending \
-                        or task_id in self._done:
-                    continue  # stale queue entry: never lease these
-                self._pending[task_id] = now + self._lease_timeout
-                gen = self._lease_gen.get(task_id, 0) + 1
-                self._lease_gen[task_id] = gen
-                leased = Lease((task_id, self._all[task_id],
-                                self._progress.get(task_id, 0)))
-                leased.gen = gen
-                return leased
-            return None
+            backing_off = []
+            try:
+                while self._todo:
+                    task_id = self._todo.pop(0)
+                    if task_id in self._dropped or task_id in self._pending \
+                            or task_id in self._done:
+                        continue  # stale queue entry: never lease these
+                    if self._not_before.get(task_id, 0) > now:
+                        backing_off.append(task_id)  # failed recently: wait
+                        continue
+                    self._not_before.pop(task_id, None)
+                    self._pending[task_id] = now + self._lease_timeout
+                    gen = self._lease_gen.get(task_id, 0) + 1
+                    self._lease_gen[task_id] = gen
+                    leased = Lease((task_id, self._all[task_id],
+                                    self._progress.get(task_id, 0)))
+                    leased.gen = gen
+                    return leased
+                return None
+            finally:
+                # backing-off tasks stay queued (epoch_done must not fire
+                # early) in their original order, ahead of later failures
+                self._todo[:0] = backing_off
 
     def _stale(self, task_id, gen):
         return gen is not None and gen != self._lease_gen.get(task_id)
@@ -212,6 +263,17 @@ class TaskService(object):
     def is_dropped(self, task_id):
         with self._lock:
             return task_id in self._dropped
+
+    def journal_position(self):
+        """Current journal byte offset (flushed), or None without a
+        journal. A CheckpointManager records this at snapshot time; a
+        restart passes it back as `journal_limit` so the journal and the
+        restored params describe the SAME training history."""
+        with self._lock:
+            if self._journal_f is None:
+                return None
+            self._journal_f.flush()
+            return self._journal_f.tell()
 
     def set_meta(self, key, value):
         """Journal a configuration fact (e.g. batch size) so a resume with
@@ -260,6 +322,7 @@ class TaskService(object):
             self._dropped.clear()
             self._failures.clear()
             self._progress.clear()
+            self._not_before.clear()
             self._todo = list(self._all)
             self._journal({'event': 'epoch', 'epoch': self._epoch})
 

@@ -1,0 +1,4 @@
+"""Testing utilities — fault injection for the crash-consistency story
+(testing/faults.py). Framework code never imports this package; the
+fault hooks patch indirection points the production modules expose."""
+from . import faults  # noqa: F401

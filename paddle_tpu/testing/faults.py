@@ -1,0 +1,179 @@
+"""Fault-injection harness (ISSUE 6): the three failure classes a
+fault-tolerant trainer must survive, producible on demand.
+
+1. **Write-path I/O errors** — `inject_write_errors()` wraps the
+   checkpoint writer's file-open indirection point
+   (core/checkpoint._open_for_write) so writes raise ENOSPC/EIO under a
+   deterministic budget or a seeded random rate. The writer must warn,
+   retry with backoff, and keep the step loop alive (its contract).
+2. **Torn / corrupt checkpoint bytes** — `corrupt_file` /
+   `corrupt_checkpoint` flip payload bytes, truncate shards, or delete
+   the COMMIT record, simulating a crash mid-write or bit rot. Restore
+   must skip such checkpoints with a loud warning, never load silently.
+3. **Process death** — `kill_self()` and the env-driven
+   `maybe_kill_at_step()` SIGKILL the calling process at a chosen step
+   boundary, the real-kill discipline of tests/elastic_kill_worker.py
+   (the reference killed trainers with signals, test_dist_base.py:339).
+
+tools/chaos.py composes all three into a kill/corrupt/restart loop.
+"""
+from __future__ import annotations
+
+import contextlib
+import errno as _errno
+import json
+import os
+import random
+import signal
+
+_CODES = {'ENOSPC': _errno.ENOSPC, 'EIO': _errno.EIO,
+          'EDQUOT': getattr(_errno, 'EDQUOT', _errno.ENOSPC)}
+
+
+class _FaultyFile(object):
+    """Proxy file whose write() consults the injector before touching the
+    real file — an ENOSPC fires mid-stream, exactly like a full disk."""
+
+    def __init__(self, f, injector, path):
+        self._f = f
+        self._inj = injector
+        self._path = path
+
+    def write(self, data):
+        self._inj._maybe_fail(self._path)
+        return self._f.write(data)
+
+    def __getattr__(self, name):      # flush/fileno/close/...
+        return getattr(self._f, name)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._f.close()
+        return False
+
+
+class WriteFaultInjector(object):
+    """Injects OSError into checkpoint write paths.
+
+    fail_next   deterministic budget: the next N write() calls fail
+    rate/seed   seeded random failure per write() (chaos mode)
+    match       only paths containing this substring are eligible
+    code        'ENOSPC' | 'EIO' | errno int
+    """
+
+    def __init__(self, code='ENOSPC', fail_next=0, rate=0.0, seed=0,
+                 match=''):
+        self.code = _CODES.get(code, code if isinstance(code, int)
+                               else _errno.EIO)
+        self.budget = int(fail_next)
+        self.rate = float(rate)
+        self.match = match
+        self.injected = 0
+        self._rng = random.Random(seed)
+
+    def arm(self, n):
+        """Make the next n write() calls fail."""
+        self.budget = int(n)
+        return self
+
+    def _maybe_fail(self, path):
+        if self.match and self.match not in path:
+            return
+        fire = False
+        if self.budget > 0:
+            self.budget -= 1
+            fire = True
+        elif self.rate > 0 and self._rng.random() < self.rate:
+            fire = True
+        if fire:
+            self.injected += 1
+            raise OSError(self.code, os.strerror(self.code), path)
+
+    def open(self, path, mode='wb'):
+        return _FaultyFile(open(path, mode), self, path)
+
+
+@contextlib.contextmanager
+def inject_write_errors(code='ENOSPC', fail_next=0, rate=0.0, seed=0,
+                        match=''):
+    """Patch the checkpoint writer's file opens so writes raise OSError
+    per the injector's policy. Yields the injector (read .injected, call
+    .arm(n) to schedule more failures mid-test)."""
+    from ..core import checkpoint as _ckpt
+    inj = WriteFaultInjector(code=code, fail_next=fail_next, rate=rate,
+                             seed=seed, match=match)
+    prev = _ckpt._open_for_write
+    _ckpt._open_for_write = inj.open
+    try:
+        yield inj
+    finally:
+        _ckpt._open_for_write = prev
+
+
+# ---------------------------------------------------------------------------
+# byte-level corruption (simulated torn writes / bit rot)
+# ---------------------------------------------------------------------------
+def corrupt_file(path, mode='flip', offset=-2):
+    """Corrupt one file in place: 'flip' XORs a payload byte at `offset`
+    (negative = from the end), 'truncate' cuts the file in half, 'empty'
+    leaves zero bytes."""
+    size = os.path.getsize(path)
+    if mode == 'flip':
+        with open(path, 'r+b') as f:
+            pos = offset if offset >= 0 else size + offset
+            f.seek(pos)
+            b = f.read(1)
+            f.seek(pos)
+            f.write(bytes([b[0] ^ 0xFF]))
+    elif mode == 'truncate':
+        with open(path, 'r+b') as f:
+            f.truncate(size // 2)
+    elif mode == 'empty':
+        with open(path, 'w'):
+            pass
+    else:
+        raise ValueError('unknown corruption mode %r' % (mode,))
+    return path
+
+
+def corrupt_checkpoint(ckpt_path, what='shard', mode='flip'):
+    """Corrupt one live checkpoint dir the way a crash or bit rot would:
+    what='shard' hits the first tensor file, 'manifest' the MANIFEST,
+    'commit' DELETES the COMMIT record (crash between rename and commit
+    marker is impossible by construction, but an operator rm isn't).
+    Returns the path touched."""
+    from ..core import checkpoint as _ckpt
+    if what == 'commit':
+        p = os.path.join(ckpt_path, _ckpt._COMMIT)
+        os.remove(p)
+        return p
+    if what == 'manifest':
+        return corrupt_file(os.path.join(ckpt_path, _ckpt._MANIFEST), mode)
+    with open(os.path.join(ckpt_path, _ckpt._MANIFEST)) as f:
+        names = sorted(json.load(f)['files'])
+    if not names:
+        raise ValueError('checkpoint %s has no shards' % ckpt_path)
+    return corrupt_file(os.path.join(ckpt_path, names[0]), mode)
+
+
+# ---------------------------------------------------------------------------
+# process death
+# ---------------------------------------------------------------------------
+KILL_STEP_ENV = 'PTPU_FAULT_KILL_STEP'
+
+
+def kill_self():
+    """SIGKILL the calling process — no atexit, no flush, no mercy."""
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_kill_at_step(step, env=KILL_STEP_ENV):
+    """SIGKILL the calling process once `step` reaches the env-configured
+    kill step (no-op when the env var is unset/empty). Worker loops call
+    this at step boundaries so a driver can schedule a crash at an exact
+    point without signal-delivery races."""
+    spec = os.environ.get(env, '')
+    if spec and int(step) >= int(spec):
+        kill_self()

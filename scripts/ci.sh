@@ -29,6 +29,9 @@ PTPU_PLATFORM=cpu python scripts/infer_loop_smoke.py
 echo "== warm-start smoke (persistent compile cache: cold A/B warm in fresh processes, >=3x artifact cold-start cut, cache_ctl stats/prune/prewarm) =="
 JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/warm_start_smoke.py
 
+echo "== crash-resume smoke (SIGKILL mid-epoch -> seconds-scale resume with bit/loss parity; chaos kill+corrupt rounds; checkpoint stall < 2%) =="
+JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/crash_resume_smoke.py
+
 echo "== slow tier (threaded stress, Poisson serving scenario) =="
 python -m pytest tests/ -q -m slow
 

@@ -363,6 +363,92 @@ def test_serving_stats_and_profiler_report(artifacts):
     assert name not in profiler._serving_sources
 
 
+# -- load shedding + per-request deadlines (ISSUE 6 satellite) ---------------
+
+def test_overloaded_queue_sheds_requests_fast(artifacts):
+    """Beyond max_queue, submit() resolves to ServerOverloaded instead of
+    queueing into unbounded latency; shed requests are counted and never
+    cost a padded batch slot."""
+    from paddle_tpu.inference import ServerOverloaded
+    batcher = BatchingPredictor(artifacts['multi'], max_queue=2,
+                                batch_timeout_ms=5.0)
+    with batcher.stats._lock:
+        batcher.stats.queue_depth = 2       # simulate a standing backlog
+    fut = batcher.submit([_x(0, 1)])
+    with pytest.raises(ServerOverloaded, match='shed'):
+        fut.result(5)
+    with batcher.stats._lock:
+        batcher.stats.queue_depth = 0
+    out, = batcher.run([_x(1, 1)], timeout=30)  # back under: serves fine
+    assert out.shape[0] == 1
+    assert batcher.stats.snapshot()['shed'] == 1
+    batcher.close()
+
+
+def test_overload_flood_all_requests_resolve(artifacts):
+    """Under a flood with a tight max_queue every future resolves — to a
+    result or to ServerOverloaded — and the sum adds up; nothing hangs."""
+    from paddle_tpu.inference import ServerOverloaded
+    batcher = BatchingPredictor(artifacts['multi'], max_queue=4,
+                                batch_timeout_ms=1.0)
+    batcher.warmup()
+    futs = [batcher.submit([_x(i, 1)]) for i in range(64)]
+    served = shed = 0
+    for f in futs:
+        try:
+            f.result(60)
+            served += 1
+        except ServerOverloaded:
+            shed += 1
+    assert served + shed == 64 and served >= 1
+    snap = batcher.stats.snapshot()
+    assert snap['shed'] == shed and snap['requests'] == served
+    assert snap['queue_depth'] == 0
+    batcher.close()
+
+
+def test_expired_deadline_fails_before_dispatch(artifacts):
+    from paddle_tpu.inference import DeadlineExceeded
+    batcher = BatchingPredictor(artifacts['multi'], batch_timeout_ms=5.0)
+    batcher.warmup()
+    fut = batcher.submit([_x(2, 1)], deadline_ms=0.0)
+    with pytest.raises(DeadlineExceeded, match='expired'):
+        fut.result(5)
+    out, = batcher.run([_x(3, 1)], timeout=30)   # no-deadline peer serves
+    assert out.shape[0] == 1
+    snap = batcher.stats.snapshot()
+    assert snap['expired'] == 1 and snap['queue_depth'] == 0
+    assert snap['requests'] == 1   # the expired one never dispatched
+    batcher.close()
+
+
+def test_generous_deadline_is_met(artifacts):
+    batcher = BatchingPredictor(artifacts['multi'], batch_timeout_ms=1.0)
+    batcher.warmup()
+    out, = batcher.run([_x(4, 2)], timeout=30, deadline_ms=60000.0)
+    assert out.shape[0] == 2
+    assert batcher.stats.snapshot()['expired'] == 0
+    batcher.close()
+
+
+def test_shed_and_expired_in_profiler_serving_report(artifacts):
+    from paddle_tpu.inference import ServerOverloaded, DeadlineExceeded
+    batcher = BatchingPredictor(artifacts['multi'], max_queue=1,
+                                batch_timeout_ms=5.0)
+    batcher.warmup()
+    with batcher.stats._lock:
+        batcher.stats.queue_depth = 1
+    with pytest.raises(ServerOverloaded):
+        batcher.submit([_x(5, 1)]).result(5)
+    with batcher.stats._lock:
+        batcher.stats.queue_depth = 0
+    with pytest.raises(DeadlineExceeded):
+        batcher.submit([_x(6, 1)], deadline_ms=0.0).result(5)
+    snap = profiler.serving_report()[batcher._profiler_name]
+    assert snap['shed'] == 1 and snap['expired'] == 1
+    batcher.close()
+
+
 # -- serve.py bench CLI (framework-free process) -----------------------------
 
 def test_serve_bench_cli_fresh_process_framework_free(artifacts, tmp_path):

@@ -1,0 +1,440 @@
+"""Fault-tolerant training (ISSUE 6): async crash-consistent
+checkpointing (core/checkpoint.py), kill-and-resume elastic restart, and
+the fault-injection harness (testing/faults.py).
+
+The headline contract: a trainer SIGKILLed at a random step boundary and
+restarted on the same checkpoint dir reproduces the uninterrupted run's
+losses and final params BIT-EXACTLY — and no partial or corrupt
+checkpoint is ever loaded silently.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import unique_name
+from paddle_tpu.core.checkpoint import (CheckpointManager, latest_committed,
+                                        list_checkpoints, verify_checkpoint)
+from paddle_tpu.parallel import MultiStepTrainer
+from paddle_tpu.testing import faults
+
+_WORKER = os.path.join(os.path.dirname(__file__),
+                       'checkpoint_kill_worker.py')
+
+
+def _build_net(seed=17):
+    with unique_name.guard():
+        main_p, startup_p = fluid.Program(), fluid.Program()
+        main_p.random_seed = startup_p.random_seed = seed
+        with fluid.program_guard(main_p, startup_p):
+            x = fluid.layers.data(name='x', shape=[16], dtype='float32')
+            lab = fluid.layers.data(name='lab', shape=[1], dtype='int64')
+            h = fluid.layers.fc(x, size=32, act='relu')
+            h = fluid.layers.dropout(h, dropout_prob=0.3)
+            logits = fluid.layers.fc(h, size=5)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits=logits,
+                                                        label=lab))
+            fluid.optimizer.Momentum(learning_rate=0.1,
+                                     momentum=0.9).minimize(loss)
+    return main_p, startup_p, loss
+
+
+def _feed_for(step0, k, batch=8):
+    xs, labs = [], []
+    for s in range(step0, step0 + k):
+        r = np.random.RandomState(1000 + s)
+        xs.append(r.randn(batch, 16).astype(np.float32))
+        labs.append(r.randint(0, 5, (batch, 1)))
+    return {'x': np.stack(xs), 'lab': np.stack(labs)}
+
+
+def _state(program, scope):
+    return {v.name: np.asarray(scope.get(v.name)).copy()
+            for v in program.list_vars()
+            if v.persistable and scope.get(v.name) is not None}
+
+
+def _startup_and_save(tmp_path, steps=(1, 2, 3), **mgr_kw):
+    """Build + init a net, save one blocking checkpoint per step value.
+    Returns (dir, program, scope, manager stats)."""
+    d = str(tmp_path / 'ckpts')
+    main_p, startup_p, _loss = _build_net()
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup_p)
+        with CheckpointManager(d, **mgr_kw) as mgr:
+            for s in steps:
+                mgr.save(main_p, scope, s, blocking=True)
+            stats = dict(mgr.stats)
+    return d, main_p, scope, stats
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager mechanics
+# ---------------------------------------------------------------------------
+def test_save_restore_roundtrip(tmp_path):
+    d, main_p, scope, stats = _startup_and_save(tmp_path, steps=(5,))
+    assert stats['commits'] == 1 and stats['failed'] == 0
+    want = _state(main_p, scope)
+
+    scope2 = fluid.core.Scope()
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope2):
+        mgr = CheckpointManager(d)
+        info = mgr.restore(executor=exe2, program=main_p, scope=scope2)
+        mgr.close()
+    assert info is not None and info['step'] == 5
+    got = _state(main_p, scope2)
+    assert set(got) == set(want)
+    for n in want:
+        np.testing.assert_array_equal(want[n], got[n], err_msg=n)
+    # the executor step counter is restored: the per-step rng stream (and
+    # therefore every loss after resume) continues bit-exactly
+    assert exe2._step_counters[main_p._uid] == 5
+
+
+def test_restore_on_empty_dir_returns_none(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / 'none'))
+    assert mgr.restore() is None
+    mgr.close()
+
+
+def test_corrupt_shard_skipped_with_warning(tmp_path):
+    d, _p, _s, _ = _startup_and_save(tmp_path, steps=(1, 2))
+    faults.corrupt_checkpoint(os.path.join(d, 'ckpt-2'), what='shard')
+    with pytest.warns(RuntimeWarning, match='not loadable'):
+        got = latest_committed(d)
+    assert got is not None and got[0] == 1  # falls back, never loads bad
+
+
+def test_truncated_shard_skipped(tmp_path):
+    d, _p, _s, _ = _startup_and_save(tmp_path, steps=(1, 2))
+    faults.corrupt_checkpoint(os.path.join(d, 'ckpt-2'), what='shard',
+                              mode='truncate')
+    with pytest.warns(RuntimeWarning, match='truncated|mismatch'):
+        assert latest_committed(d)[0] == 1
+
+
+def test_corrupt_manifest_skipped(tmp_path):
+    d, _p, _s, _ = _startup_and_save(tmp_path, steps=(1, 2))
+    faults.corrupt_checkpoint(os.path.join(d, 'ckpt-2'), what='manifest',
+                              mode='truncate')
+    with pytest.warns(RuntimeWarning, match='not loadable'):
+        assert latest_committed(d)[0] == 1
+
+
+def test_partial_checkpoint_without_commit_skipped(tmp_path):
+    d, _p, _s, _ = _startup_and_save(tmp_path, steps=(1,))
+    faults.corrupt_checkpoint(os.path.join(d, 'ckpt-1'), what='commit')
+    with pytest.warns(RuntimeWarning, match='no COMMIT'):
+        assert latest_committed(d) is None
+
+
+def test_retention_keeps_last_n_and_journals_evictions(tmp_path):
+    d, _p, _s, stats = _startup_and_save(tmp_path, steps=(1, 2, 3, 4, 5),
+                                         keep_last_n=2)
+    assert [s for s, _ in list_checkpoints(d)] == [4, 5]
+    assert stats['evicted'] == 3
+    events = [json.loads(l) for l in
+              open(os.path.join(d, 'COMMITS.jsonl'))]
+    assert [e['step'] for e in events if e['event'] == 'commit'] == \
+        [1, 2, 3, 4, 5]
+    assert [e['step'] for e in events if e['event'] == 'evict'] == [1, 2, 3]
+    verify_checkpoint(os.path.join(d, 'ckpt-5'))  # survivors stay whole
+
+
+def test_enospc_writer_retries_then_commits(tmp_path):
+    d = str(tmp_path / 'ckpts')
+    main_p, startup_p, _ = _build_net()
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup_p)
+        with CheckpointManager(d, retry_backoff_s=0.01) as mgr:
+            with faults.inject_write_errors(code='ENOSPC', fail_next=2) as inj:
+                with pytest.warns(RuntimeWarning, match='retrying'):
+                    mgr.save(main_p, scope, 1, blocking=True)
+            assert inj.injected == 2
+            assert mgr.stats['commits'] == 1 and mgr.stats['retries'] == 2
+    assert latest_committed(d)[0] == 1
+
+
+def test_persistent_eio_degrades_without_crashing_the_step_loop(tmp_path):
+    """Every write fails: checkpoints are abandoned with loud warnings,
+    but run_steps keeps training and its losses are untouched."""
+    main_p, startup_p, loss = _build_net()
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    ref = []
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        for dsp in range(2):
+            l, = exe.run_steps(main_p, feed=_feed_for(dsp * 4, 4),
+                               fetch_list=[loss], steps=4,
+                               fetch_policy='stack')
+            ref += list(np.asarray(l).reshape(-1))
+
+    main_p, startup_p, loss = _build_net()
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    d = str(tmp_path / 'ckpts')
+    got = []
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        with CheckpointManager(d, every_steps=4, max_retries=1,
+                               retry_backoff_s=0.01) as mgr:
+            with faults.inject_write_errors(code='EIO', fail_next=10 ** 6):
+                with warnings.catch_warnings(record=True) as w:
+                    warnings.simplefilter('always')
+                    for dsp in range(2):
+                        l, = exe.run_steps(main_p, feed=_feed_for(dsp * 4, 4),
+                                           fetch_list=[loss], steps=4,
+                                           fetch_policy='stack',
+                                           checkpoint=mgr)
+                        got += list(np.asarray(l).reshape(-1))
+                    mgr.flush()
+            assert mgr.stats['failed'] >= 1 and mgr.stats['commits'] == 0
+            assert 'Input/output error' in (mgr.stats['last_error'] or '')
+    assert any('ABANDONED' in str(x.message) for x in w)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+    assert latest_committed(d) is None  # nothing half-written became live
+
+
+def test_every_steps_policy_and_busy_skip_accounting(tmp_path):
+    main_p, startup_p, loss = _build_net()
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    d = str(tmp_path / 'ckpts')
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        with warnings.catch_warnings():
+            warnings.simplefilter('ignore', RuntimeWarning)
+            with CheckpointManager(d, every_steps=8) as mgr:
+                for dsp in range(4):
+                    exe.run_steps(main_p, feed=_feed_for(dsp * 4, 4),
+                                  fetch_list=[loss], steps=4,
+                                  checkpoint=mgr)
+                mgr.flush()
+                st = dict(mgr.stats)
+    # boundaries at 8 and 16 are due; a busy writer may skip one, but
+    # every due boundary is either committed or accounted as skipped
+    assert st['snapshots'] + st['skipped_busy'] == 2
+    assert st['commits'] == st['snapshots']
+    assert latest_committed(d) is not None
+
+
+def test_every_seconds_policy(tmp_path):
+    main_p, startup_p, _ = _build_net()
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    d = str(tmp_path / 'ckpts')
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        with CheckpointManager(d, every_seconds=0.05) as mgr:
+            assert mgr.step_boundary(exe, main_p, scope, 1) == 0.0  # not due
+            time.sleep(0.06)
+            assert mgr.step_boundary(exe, main_p, scope, 2) > 0.0
+            mgr.flush()
+            assert mgr.stats['commits'] == 1
+
+
+def test_ckpt_stall_reported_in_training_report(tmp_path):
+    from paddle_tpu import profiler
+    main_p, startup_p, loss = _build_net()
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        with CheckpointManager(str(tmp_path / 'c'), every_steps=4) as mgr:
+            for dsp in range(2):
+                exe.run_steps(main_p, feed=_feed_for(dsp * 4, 4),
+                              fetch_list=[loss], steps=4, checkpoint=mgr)
+            mgr.flush()
+    try:
+        snap = profiler.training_report()['executor@%x' % id(exe)]
+        assert snap['ckpt_stall_ms'] > 0.0
+        assert 0.0 < snap['ckpt_stall_pct'] < 100.0
+    finally:
+        exe.close()
+
+
+def test_stale_tmp_dirs_from_dead_writers_are_cleaned(tmp_path):
+    d = str(tmp_path / 'ckpts')
+    os.makedirs(os.path.join(d, '.tmp-ckpt-3.999999'))  # dead pid
+    live = os.path.join(d, '.tmp-ckpt-4.%d' % os.getpid())
+    os.makedirs(live)
+    mgr = CheckpointManager(d)
+    mgr.close()
+    assert not os.path.exists(os.path.join(d, '.tmp-ckpt-3.999999'))
+    assert os.path.exists(live)  # owning pid alive: not ours to delete
+
+
+# ---------------------------------------------------------------------------
+# io.py manifest satellite: partial/stale save dirs fail loudly at load
+# ---------------------------------------------------------------------------
+def _save_dir(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        fluid.layers.fc(x, size=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    d = str(tmp_path / 'save')
+    fluid.io.save_persistables(exe, d, main)
+    return d, main, exe
+
+
+def test_io_manifest_written_and_roundtrips(tmp_path):
+    d, main, exe = _save_dir(tmp_path)
+    assert os.path.exists(os.path.join(d, '.ptpu_manifest.json'))
+    fluid.io.load_persistables(exe, d, main)  # verifies digests
+
+
+def test_io_load_rejects_truncated_file(tmp_path):
+    d, main, exe = _save_dir(tmp_path)
+    faults.corrupt_file(os.path.join(d, 'fc_0.w_0'), mode='truncate')
+    with pytest.raises(RuntimeError, match='partial or corrupt'):
+        fluid.io.load_persistables(exe, d, main)
+
+
+def test_io_load_rejects_stale_mixed_save(tmp_path):
+    """A file whose bytes differ from the manifest (an interrupted later
+    save overwrote it) must fail loudly, not load stale params."""
+    d, main, exe = _save_dir(tmp_path)
+    faults.corrupt_file(os.path.join(d, 'fc_0.w_0'), mode='flip', offset=-1)
+    with pytest.raises(RuntimeError, match='manifest'):
+        fluid.io.load_persistables(exe, d, main)
+
+
+def test_io_load_without_manifest_stays_compatible(tmp_path):
+    d, main, exe = _save_dir(tmp_path)
+    os.remove(os.path.join(d, '.ptpu_manifest.json'))
+    fluid.io.load_persistables(exe, d, main)  # pre-manifest dirs still load
+
+
+# ---------------------------------------------------------------------------
+# the headline: SIGKILL at a step boundary + restart = bit-exact resume
+# ---------------------------------------------------------------------------
+def _read_out(path):
+    resume, losses, sha = None, {}, None
+    for line in open(path):
+        parts = line.split()
+        if parts[0] == 'RESUME':
+            resume = int(parts[1])
+        elif parts[0] == 'DONE':
+            sha = parts[1]
+        else:
+            losses[int(parts[0])] = float(parts[1])
+    return resume, losses, sha
+
+
+def _run_worker(ckpt_dir, out, total=24, k=4, every=4, kill_at=0,
+                min_commits=1, check=True):
+    argv = [sys.executable, _WORKER, ckpt_dir, out, str(total), str(k),
+            str(every)]
+    if kill_at:
+        argv += [str(kill_at), str(min_commits)]
+    r = subprocess.run(argv, capture_output=True, text=True, timeout=300)
+    if check:
+        assert r.returncode == 0, r.stderr[-3000:]
+    return r
+
+
+def test_sigkill_at_step_boundary_resumes_bit_exact(tmp_path):
+    """Kill a trainer with SIGKILL mid-epoch (racing the async checkpoint
+    writer), restart it on the same dir: the resumed run restores the
+    newest committed checkpoint, re-runs at most the post-checkpoint
+    steps, and every loss — including the re-run overlap — plus the
+    final params digest bit-match an uninterrupted run."""
+    ref_out = str(tmp_path / 'ref.txt')
+    _run_worker('-', ref_out)
+    _, ref_losses, ref_sha = _read_out(ref_out)
+    assert ref_sha is not None and len(ref_losses) == 24
+
+    d = str(tmp_path / 'ckpts')
+    kill_at = int(np.random.RandomState(int(time.time())).randint(8, 21))
+    kill_at -= kill_at % 4  # the worker kills at a dispatch boundary
+    kill_at = max(kill_at, 8)
+    out1 = str(tmp_path / 'run1.txt')
+    r1 = _run_worker(d, out1, kill_at=kill_at, check=False)
+    assert r1.returncode == -signal.SIGKILL, (r1.returncode, r1.stderr)
+    resume1, losses1, sha1 = _read_out(out1)
+    assert resume1 == 0 and sha1 is None
+    assert len(losses1) == kill_at
+
+    out2 = str(tmp_path / 'run2.txt')
+    _run_worker(d, out2)
+    resume2, losses2, sha2 = _read_out(out2)
+    assert resume2 is not None and 0 < resume2 <= kill_at
+    assert sha2 == ref_sha, 'final params diverged from uninterrupted run'
+    for idx, v in {**losses1, **losses2}.items():
+        assert v == ref_losses[idx], \
+            'loss at step %d diverged: %r vs %r' % (idx, v, ref_losses[idx])
+    # re-run overlap (kill landed past the restored checkpoint): the
+    # replayed steps must reproduce the first incarnation bit-exactly
+    for idx in set(losses1) & set(losses2):
+        assert losses1[idx] == losses2[idx]
+
+
+def test_resume_skips_corrupted_latest_checkpoint(tmp_path):
+    """Corrupt the newest of two committed checkpoints: the restart must
+    fall back to the OLDER one with a loud warning and still reach full
+    parity with an uninterrupted run."""
+    def train(exe, main_p, loss, scope, lo, hi, mgr=None, save_at=()):
+        out = {}
+        for d0 in range(lo // 4, hi // 4):
+            l, = exe.run_steps(main_p, feed=_feed_for(d0 * 4, 4),
+                               fetch_list=[loss], steps=4,
+                               fetch_policy='stack')
+            for i, v in enumerate(np.asarray(l).reshape(-1)):
+                out[d0 * 4 + i] = float(v)
+            if mgr is not None and (d0 + 1) * 4 in save_at:
+                mgr.save(main_p, scope, (d0 + 1) * 4, executor=exe,
+                         blocking=True)
+        return out
+
+    main_p, startup_p, loss = _build_net()
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        ref_losses = train(exe, main_p, loss, scope, 0, 16)
+        ref_state = _state(main_p, scope)
+
+    d = str(tmp_path / 'ckpts')
+    main_p, startup_p, loss = _build_net()
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        with CheckpointManager(d) as mgr:
+            losses1 = train(exe, main_p, loss, scope, 0, 12, mgr,
+                            save_at=(4, 8))
+    assert [s for s, _ in list_checkpoints(d)] == [4, 8]
+    faults.corrupt_checkpoint(os.path.join(d, 'ckpt-8'), what='shard')
+
+    main_p, startup_p, loss = _build_net()   # "restarted process"
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        with CheckpointManager(d) as mgr:
+            with pytest.warns(RuntimeWarning, match='not loadable'):
+                info = mgr.restore(executor=exe, program=main_p,
+                                   scope=scope)
+            assert info['step'] == 4, 'did not fall back to ckpt-4'
+            losses2 = train(exe, main_p, loss, scope, 4, 16)
+        state2 = _state(main_p, scope)
+
+    for idx, v in {**losses1, **losses2}.items():
+        assert v == ref_losses[idx], 'step %d diverged' % idx
+    for n in ref_state:
+        np.testing.assert_array_equal(ref_state[n], state2[n], err_msg=n)

@@ -32,24 +32,67 @@ def test_lease_finish_cycle():
 
 
 def test_failed_task_requeues_until_cap():
-    svc = TaskService(['a'], max_failures=3)
+    svc = TaskService(['a'], max_failures=3, retry_backoff_s=0)
     for _ in range(2):
         tid, _, _ = svc.get_task()
         svc.task_failed(tid)
     tid, _, _ = svc.get_task()   # 3rd lease still dispatchable
-    svc.task_failed(tid)         # 3rd failure hits the cap
+    with pytest.warns(RuntimeWarning, match='DROPPED'):
+        svc.task_failed(tid)     # 3rd failure hits the cap — loudly
     assert svc.get_task() is None
     assert svc.counts['dropped'] == 1
     assert svc.epoch_done        # dropped tasks don't wedge the epoch
 
 
 def test_lease_timeout_requeues():
-    svc = TaskService(['a'], lease_timeout_s=0.05, max_failures=10)
+    svc = TaskService(['a'], lease_timeout_s=0.05, max_failures=10,
+                      retry_backoff_s=0)
     tid, _, _ = svc.get_task()
     assert svc.get_task() is None
     time.sleep(0.08)
     got = svc.get_task()         # expired lease re-dispatches
     assert got is not None and got[1] == 'a'
+
+
+def test_failed_task_backs_off_exponentially_before_release():
+    """A failed task is NOT immediately re-leasable (a poisoned task
+    would hot-loop through its failure cap in microseconds and starve
+    good tasks); it re-dispatches after a jittered exponential delay."""
+    svc = TaskService(['bad', 'good'], max_failures=10,
+                      retry_backoff_s=0.08, retry_jitter=0.0)
+    tid, task, _ = svc.get_task()
+    assert task == 'bad'  # FIFO
+    svc.task_failed(tid)
+    # backing off: 'bad' is not dispatchable, but 'good' still is
+    leased = svc.get_task()
+    assert leased is not None and leased[1] == 'good'
+    assert svc.get_task() is None          # 'bad' held back
+    assert not svc.epoch_done              # ...but still owed this epoch
+    time.sleep(0.1)
+    leased = svc.get_task()
+    assert leased is not None and leased[1] == 'bad'
+    svc.task_failed(leased[0])             # 2nd failure: delay doubles
+    time.sleep(0.1)
+    assert svc.get_task() is None          # 0.16s not yet elapsed
+    time.sleep(0.08)
+    assert svc.get_task()[1] == 'bad'
+
+
+def test_backoff_jitter_and_cap_bounds():
+    svc = TaskService(['t'], max_failures=100, retry_backoff_s=0.1,
+                      retry_backoff_max_s=0.4, retry_jitter=0.25)
+    now = time.monotonic()
+    for n in range(1, 8):
+        with svc._lock:
+            svc._fail_locked('t', 'test')
+        base = min(0.4, 0.1 * 2 ** (n - 1))
+        delay = svc._not_before['t'] - now
+        assert base * 0.7 <= delay <= base * 1.3, (n, delay, base)
+        svc._todo = ['t']  # reset queue state between iterations
+    # warns-on-drop fires when the cap is eventually hit
+    svc2 = TaskService(['p'], max_failures=1)
+    with pytest.warns(RuntimeWarning, match='DROPPED'):
+        svc2.task_failed('p')
 
 
 def test_progress_heartbeat_extends_lease():
@@ -238,6 +281,11 @@ def test_checkpoint_crc_detects_corruption(tmp_path):
     blob[-2] ^= 0xFF  # flip a payload byte
     with open(target, 'wb') as f:
         f.write(bytes(blob))
+    # first line of defense: the save manifest's sha256 (ISSUE 6)
+    with pytest.raises(RuntimeError, match='manifest'):
+        fluid.io.load_persistables(exe, d, main)
+    # the per-tensor CRC still guards manifest-less (pre-ISSUE-6) dirs
+    os.remove(os.path.join(d, '.ptpu_manifest.json'))
     with pytest.raises(ValueError, match='CRC'):
         fluid.io.load_persistables(exe, d, main)
 
@@ -385,7 +433,8 @@ def test_stale_lease_reports_ignored():
     not clobber the live holder: its task_failed/report_progress/finish
     are no-ops once the generation moved on."""
     from paddle_tpu.reader.elastic import TaskService
-    svc = TaskService(['t'], lease_timeout_s=0.01, max_failures=10)
+    svc = TaskService(['t'], lease_timeout_s=0.01, max_failures=10,
+                      retry_backoff_s=0)
     a = svc.get_task()
     assert a is not None and a[0] == 't'
     time.sleep(0.05)                       # A's lease expires
@@ -402,3 +451,38 @@ def test_stale_lease_reports_ignored():
     assert svc.counts['done'] == 0
     svc.task_finished('t', gen=b.gen)          # the live holder finishes
     assert svc.counts['done'] == 1 and svc.epoch_done
+
+
+def test_journal_position_and_limit_rewind(tmp_path):
+    """journal_position() marks a consistent point; a restart with
+    journal_limit truncates the tail so data consumed AFTER a checkpoint
+    re-dispatches instead of being skipped against pre-checkpoint
+    params (core/checkpoint.py resume contract)."""
+    j = str(tmp_path / 'j.jsonl')
+    svc = TaskService(['a', 'b'], journal_path=j)
+    ta = svc.get_task()
+    svc.report_progress(ta[0], 3, gen=ta.gen)
+    pos = svc.journal_position()           # "checkpoint" taken here
+    assert pos == os.path.getsize(j)
+    svc.report_progress(ta[0], 7, gen=ta.gen)   # post-checkpoint progress
+    svc.task_finished(ta[0], gen=ta.gen)
+    svc.close()
+
+    # plain restart replays everything: 'a' is done, skip would be 7
+    svc2 = TaskService(['a', 'b'], journal_path=j)
+    assert svc2.counts['done'] == 1
+    svc2.close()
+
+    # checkpoint-consistent restart rewinds to pos: 'a' redispatches
+    # with the journaled skip of 3 (what the restored params trained on)
+    svc3 = TaskService(['a', 'b'], journal_path=j, journal_limit=pos)
+    assert svc3.counts['done'] == 0
+    assert os.path.getsize(j) == pos       # tail physically truncated
+    leased = {}
+    while True:
+        t = svc3.get_task()
+        if t is None:
+            break
+        leased[t[1]] = t[2]
+    assert leased == {'a': 3, 'b': 0}
+    svc3.close()

@@ -1,0 +1,223 @@
+"""Chaos harness for fault-tolerant training (ISSUE 6): repeatedly
+SIGKILL a trainer subprocess at random step boundaries — optionally
+corrupting the newest checkpoint between incarnations — and verify that
+every incarnation's losses and the final params BIT-MATCH an
+uninterrupted reference run.
+
+    python tools/chaos.py                        # 3 kill rounds, no rot
+    python tools/chaos.py --rounds 5 --corrupt random --seed 7
+    python tools/chaos.py --total 48 --every 8 --keep
+
+Per round: launch tests/checkpoint_kill_worker.py on a shared checkpoint
+dir (it resumes from the newest committed checkpoint), let it train to a
+randomly chosen step boundary, and let it SIGKILL itself there — racing
+the async checkpoint writer exactly like a preemption. With --corrupt,
+the newest checkpoint is then damaged (shard flip / manifest truncation
+/ COMMIT removal) to prove restore falls back rather than loading it. A
+final incarnation runs to completion and its params digest must equal
+the reference's.
+
+Exit 0: survived every round with bit parity. Exit 1: divergence or a
+round that failed to make progress. ENOSPC/EIO write-path injection is
+covered separately (in-process) by tests/test_checkpoint.py and
+paddle_tpu/testing/faults.inject_write_errors.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import random
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, 'tests', 'checkpoint_kill_worker.py')
+
+
+def _checkpoint_mod():
+    """Load core/checkpoint.py standalone (stdlib+numpy only at import
+    time) so the orchestrator never pays the framework/jax import."""
+    spec = importlib.util.spec_from_file_location(
+        'ptpu_chaos_checkpoint',
+        os.path.join(REPO, 'paddle_tpu', 'core', 'checkpoint.py'))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _faults_mod():
+    spec = importlib.util.spec_from_file_location(
+        'ptpu_chaos_faults',
+        os.path.join(REPO, 'paddle_tpu', 'testing', 'faults.py'))
+    mod = importlib.util.module_from_spec(spec)
+    # faults.py uses relative imports only inside functions we don't call
+    # (inject_write_errors / corrupt_checkpoint); corrupt_file is pure
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def read_out(path):
+    resume, losses, sha = None, {}, None
+    if not os.path.exists(path):
+        return resume, losses, sha
+    for line in open(path):
+        parts = line.split()
+        if not parts:
+            continue
+        if parts[0] == 'RESUME':
+            resume = int(parts[1])
+        elif parts[0] == 'DONE':
+            sha = parts[1]
+        else:
+            losses[int(parts[0])] = float(parts[1])
+    return resume, losses, sha
+
+
+def run_worker(ckpt_dir, out, total, k, every, kill_at=0, timeout=600):
+    argv = [sys.executable, WORKER, ckpt_dir, out, str(total), str(k),
+            str(every)]
+    if kill_at:
+        argv += [str(kill_at), '1']
+    return subprocess.run(argv, capture_output=True, text=True,
+                          timeout=timeout)
+
+
+def corrupt_newest(ckpt_mod, faults, ckpt_dir, mode, rng):
+    live = ckpt_mod.list_checkpoints(ckpt_dir)
+    if not live:
+        return None
+    step, path = live[-1]
+    if mode == 'random':
+        mode = rng.choice(['shard', 'manifest', 'commit'])
+    if mode == 'commit':
+        try:
+            os.remove(os.path.join(path, ckpt_mod._COMMIT))
+        except FileNotFoundError:
+            pass        # already damaged in an earlier round
+    elif mode == 'manifest':
+        faults.corrupt_file(os.path.join(path, ckpt_mod._MANIFEST),
+                            mode='truncate')
+    else:
+        import json
+        try:
+            with open(os.path.join(path, ckpt_mod._MANIFEST)) as f:
+                name = sorted(json.load(f)['files'])[0]
+        except (OSError, ValueError, KeyError, IndexError):
+            # manifest already rotted in an earlier round: hit any shard
+            names = sorted(n for n in os.listdir(path)
+                           if n not in (ckpt_mod._MANIFEST,
+                                        ckpt_mod._COMMIT))
+            if not names:
+                return step, 'already-empty'
+            name = names[0]
+        faults.corrupt_file(os.path.join(path, name), mode='flip')
+    return step, mode
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description='kill/corrupt/restart chaos loop over the checkpoint '
+                    'subsystem; exit 0 on bit parity with an '
+                    'uninterrupted run')
+    ap.add_argument('--rounds', type=int, default=3,
+                    help='kill rounds before the final full run')
+    ap.add_argument('--total', type=int, default=24)
+    ap.add_argument('--k', type=int, default=4,
+                    help='steps per dispatch (kills land on multiples)')
+    ap.add_argument('--every', type=int, default=4,
+                    help='checkpoint_every steps')
+    ap.add_argument('--corrupt', default='none',
+                    choices=['none', 'shard', 'manifest', 'commit',
+                             'random'],
+                    help='damage the newest checkpoint after each kill')
+    ap.add_argument('--seed', type=int, default=None)
+    ap.add_argument('--workdir', default=None)
+    ap.add_argument('--keep', action='store_true',
+                    help='keep the workdir for inspection')
+    args = ap.parse_args(argv)
+
+    seed = args.seed if args.seed is not None else int(time.time())
+    rng = random.Random(seed)
+    ckpt_mod = _checkpoint_mod()
+    faults = _faults_mod()
+    work = args.workdir or tempfile.mkdtemp(prefix='ptpu-chaos-')
+    os.makedirs(work, exist_ok=True)
+    ckpt_dir = os.path.join(work, 'ckpts')
+    print('[chaos] workdir=%s seed=%d rounds=%d total=%d k=%d every=%d '
+          'corrupt=%s' % (work, seed, args.rounds, args.total, args.k,
+                          args.every, args.corrupt))
+
+    def fail(msg):
+        print('[chaos] FAIL: %s' % msg)
+        print('[chaos] workdir kept at %s' % work)
+        return 1
+
+    ref_out = os.path.join(work, 'ref.txt')
+    r = run_worker('-', ref_out, args.total, args.k, args.every)
+    if r.returncode != 0:
+        return fail('reference run failed:\n%s' % r.stderr[-2000:])
+    _, ref_losses, ref_sha = read_out(ref_out)
+    print('[chaos] reference: %d steps, params %s' % (len(ref_losses),
+                                                      ref_sha[:12]))
+
+    all_seen = {}
+    for rnd in range(1, args.rounds + 1):
+        kill_at = rng.randrange(args.k, args.total + args.k, args.k)
+        out = os.path.join(work, 'round-%d.txt' % rnd)
+        t0 = time.time()
+        r = run_worker(ckpt_dir, out, args.total, args.k, args.every,
+                       kill_at=kill_at)
+        resume, losses, sha = read_out(out)
+        if r.returncode == 0 and sha is not None:
+            outcome = 'completed'
+        elif r.returncode == -signal.SIGKILL:
+            outcome = 'killed@%d' % max(losses, default=-1)
+        else:
+            return fail('round %d crashed (rc=%s):\n%s'
+                        % (rnd, r.returncode, r.stderr[-2000:]))
+        for idx, v in losses.items():
+            if v != ref_losses.get(idx):
+                return fail('round %d: loss at step %d diverged '
+                            '(%r vs %r)' % (rnd, idx, v,
+                                            ref_losses.get(idx)))
+            if idx in all_seen and all_seen[idx] != v:
+                return fail('round %d: step %d not reproducible across '
+                            'incarnations' % (rnd, idx))
+        all_seen.update(losses)
+        note = ''
+        if args.corrupt != 'none' and r.returncode != 0:
+            hit = corrupt_newest(ckpt_mod, faults, ckpt_dir, args.corrupt,
+                                 rng)
+            if hit:
+                note = ' corrupt[%s@ckpt-%d]' % (hit[1], hit[0])
+        print('[chaos] round %d: resume=%s kill_at=%d %s steps_ok=%d '
+              '%.1fs%s' % (rnd, resume, kill_at, outcome, len(losses),
+                           time.time() - t0, note))
+
+    out = os.path.join(work, 'final.txt')
+    r = run_worker(ckpt_dir, out, args.total, args.k, args.every)
+    if r.returncode != 0:
+        return fail('final run failed:\n%s' % r.stderr[-2000:])
+    resume, losses, sha = read_out(out)
+    for idx, v in losses.items():
+        if v != ref_losses.get(idx):
+            return fail('final: loss at step %d diverged' % idx)
+    if sha != ref_sha:
+        return fail('final params digest %s != reference %s'
+                    % (sha, ref_sha))
+    print('[chaos] final: resume=%s -> %d steps, params %s == reference'
+          % (resume, args.total, sha[:12]))
+    print('[chaos] OK: %d kill rounds + %s corruption, bit parity held'
+          % (args.rounds, args.corrupt))
+    if not args.keep and args.workdir is None:
+        shutil.rmtree(work, ignore_errors=True)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

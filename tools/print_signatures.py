@@ -32,6 +32,7 @@ MODULES = [
     'paddle_tpu.inference',
     'paddle_tpu.imperative',
     'paddle_tpu.passes',
+    'paddle_tpu.testing.faults',
     'paddle_tpu.contrib.mixed_precision',
     'paddle_tpu.contrib.gradient_merge',
     'paddle_tpu.contrib.quantize',
