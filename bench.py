@@ -144,6 +144,24 @@ def _pass_ops(program, fetch):
         return None
 
 
+def _static_fields(program, fetch, batch=None):
+    """pass_ops + peak_bytes_est for one train metric: the pipeline op
+    counts above plus the dataflow analyzer's static peak-memory
+    estimate at this bench's batch (passes/dataflow.py — pure
+    shape/dtype math, no runtime cost; omitted if analysis declines)."""
+    fields = {'pass_ops': _pass_ops(program, fetch)}
+    try:
+        from paddle_tpu.passes import dataflow
+        name = fetch if isinstance(fetch, str) else fetch.name
+        est = dataflow.analyze_program(
+            program, fetch_names=[name]).peak_memory(
+                batch=batch or 1, top=0)
+        fields['peak_bytes_est'] = int(est.peak_bytes)
+    except Exception:
+        pass
+    return fields
+
+
 def is_transient(exc):
     msg = str(exc)
     return any(m in msg for m in TRANSIENT_MARKERS)
@@ -380,7 +398,7 @@ def _bench_image_train(metric, build, batch, steps, flops_per_img,
                  mfu=round(mfu, 4) if mfu is not None else None,
                  dtype='bf16' if use_bf16 else 'fp32', batch=batch,
                  baseline_ref=baseline_ref,
-                 pass_ops=_pass_ops(main_p, loss))
+                 **_static_fields(main_p, loss, batch))
     return _attach_device_time(line, lambda: _device_ms_scan(
         exe, main_p, feed, loss, _device_k(device_k)))
 
@@ -448,7 +466,7 @@ def bench_transformer():
                  tok_s / base_tok_s,
                  mfu=round(mfu, 4) if mfu is not None else None, dtype='bf16',
                  batch=batch, seq_len=seq_len, baseline_ref='flops_eq_xeon',
-                 pass_ops=_pass_ops(main_p, loss))
+                 **_static_fields(main_p, loss, batch))
     return _attach_device_time(line, lambda: _device_ms_scan(
         exe, main_p, feed, loss, _device_k(8)))
 
@@ -507,7 +525,7 @@ def bench_bert():
                  mfu=round(mfu, 4) if mfu is not None else None, dtype='bf16',
                  batch=batch, seq_len=seq_len, grad_merge_k=k_merge,
                  baseline_ref='flops_eq_xeon',
-                 pass_ops=_pass_ops(main_p, loss))
+                 **_static_fields(main_p, loss, batch))
     return _attach_device_time(line, lambda: _device_ms_scan(
         exe, main_p, feed, loss, _device_k(8)))
 
@@ -746,7 +764,7 @@ def bench_ocr():
     dt = _timed_steps(exe, main_p, feed, avg_cost, steps, warmup=3)
     line = _line('ocr_crnn_img_s_per_chip', batch * steps / dt, 'img/s',
                  1.0, dtype='bf16', batch=batch, baseline_ref='self',
-                 pass_ops=_pass_ops(main_p, avg_cost))
+                 **_static_fields(main_p, avg_cost, batch))
     return _attach_device_time(line, lambda: _device_ms_scan(
         exe, main_p, feed, avg_cost, _device_k(8)))
 
@@ -781,7 +799,7 @@ def bench_smallnet():
     base_ms = 33.113 * batch / 256.0
     line = _line('smallnet_cifar_ms_batch', ms_batch, 'ms/batch',
                  base_ms / ms_batch, dtype='bf16', batch=batch,
-                 baseline_ref='k40m', pass_ops=_pass_ops(main_p, loss))
+                 baseline_ref='k40m', **_static_fields(main_p, loss, batch))
     return _attach_device_time(line, lambda: _device_ms_scan(
         exe, main_p, feed, loss, _device_k(16)))
 
@@ -825,7 +843,7 @@ def bench_stacked_lstm():
                  base_ms / ms_batch,
                  mfu=round(mfu, 4) if mfu is not None else None,
                  dtype='bf16', batch=batch, baseline_ref='k40m',
-                 pass_ops=_pass_ops(main_p, loss))
+                 **_static_fields(main_p, loss, batch))
     return _attach_device_time(line, lambda: _device_ms_scan(
         exe, main_p, feed, loss, _device_k(8)))
 
@@ -1026,7 +1044,7 @@ def bench_ctr():
     line = _line(
         'ctr_deepfm_samples_s_per_chip', samples_s, 'samples/s', vs,
         mfu=round(mfu, 6) if mfu is not None else None, batch=batch,
-        baseline_ref=base, pass_ops=_pass_ops(main_p, loss))
+        baseline_ref=base, **_static_fields(main_p, loss, batch))
     return _attach_device_time(line, lambda: _device_ms_scan(
         exe, main_p, feed, loss, _device_k(8)))
 

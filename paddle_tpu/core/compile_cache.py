@@ -417,10 +417,13 @@ def _touch(key):
             pass
 
 
-def load(key):
+def load(key, donate_argnums=()):
     """Load an entry: tier-1 executable (zero compile), else tier-2
     StableHLO (compiles, skips re-trace). None on miss. Corrupt entries
-    drop loudly and return None."""
+    drop loudly and return None. `donate_argnums`: the caller's
+    certified donation plan — the tier-2 recompile applies it (a fresh
+    bookkept jit, so it is safe where a reloaded tier-1 alias is not),
+    keeping the warm-path copy recovery alive across jaxlib bumps."""
     exec_p, hlo_p, _meta_p = _paths(key)
     t0 = time.perf_counter()
     if os.path.exists(exec_p):
@@ -455,7 +458,8 @@ def load(key):
             with open(hlo_p, 'rb') as f:
                 blob = f.read()
             exp = jexport.deserialize(blob)
-            fn = jax.jit(exp.call)
+            fn = jax.jit(exp.call,
+                         donate_argnums=tuple(donate_argnums or ()))
             with _stats_lock:
                 _stats['hlo_hits'] += 1
                 _stats['bytes_read'] += len(blob)
@@ -475,7 +479,8 @@ def _drop_entry_file(path):
         pass
 
 
-def store(key, compiled=None, exported_bytes=None, tag='program'):
+def store(key, compiled=None, exported_bytes=None, tag='program',
+          donated=False):
     """Persist an entry (either tier may be absent) and LRU-evict over
     budget. Write failures warn and are non-fatal — the cache never
     breaks the run."""
@@ -500,7 +505,8 @@ def store(key, compiled=None, exported_bytes=None, tag='program'):
                 wrote += _atomic_write(hlo_p, exported_bytes)
             if wrote:
                 meta = {'tag': tag, 'created': time.time(),
-                        'ver': list(_versions()), 'schema': _SCHEMA}
+                        'ver': list(_versions()), 'schema': _SCHEMA,
+                        'donated': bool(donated)}
                 wrote += _atomic_write(
                     meta_p, json.dumps(meta).encode())
                 with _stats_lock:
@@ -668,7 +674,8 @@ def disk_stats():
 # -- the main entry: AOT-or-jit ----------------------------------------------
 
 def aot_or_jit(jitted, args, key_parts, tag='program', fun=None,
-               device=None, mesh=None, use_export=None):
+               device=None, mesh=None, use_export=None,
+               donate_argnums=None):
     """Warm-start for the avals of `args`, or compile-and-persist.
 
     Returns a callable with jitted's calling convention:
@@ -687,13 +694,20 @@ def aot_or_jit(jitted, args, key_parts, tag='program', fun=None,
     the arg avals (program fingerprint, fetch names, amp/K/rng flags);
     avals/shardings and the env fingerprint are appended here.
 
-    DONATION: cached executables are compiled WITHOUT input donation,
-    from `fun` (the raw step callable) when given. A serialized-then-
-    reloaded executable keeps its XLA input/output aliasing but jax's
-    buffer bookkeeping no longer knows the args were donated — the
-    computation then scribbles over buffers the caller still holds
-    (measured: nondeterministic fetches / NaN on the composed mesh
-    programs). Correctness beats the one extra state copy.
+    DONATION: by default cached executables compile WITHOUT input
+    donation, from `fun` (the raw step callable) when given. A
+    serialized-then-reloaded executable keeps its XLA input/output
+    aliasing but jax's buffer bookkeeping no longer knows the args were
+    donated — the computation then scribbles over buffers the caller
+    still holds (measured: nondeterministic fetches / NaN on the
+    composed mesh programs). Correctness beats the one extra state copy
+    — UNLESS the caller proves safety: pass `donate_argnums` only with
+    a dataflow donation certificate (passes/dataflow.certify_donation)
+    showing no caller-visible buffer aliases the donated args. Donated
+    and undonated entries never collide (the donation plan is part of
+    the entry key), the meta records `donated` for doctor/cache_ctl
+    visibility, and a donated compile that fails falls back to the
+    undonated path loudly.
 
     `use_export`: whether the miss path serializes through jax.export
     (both tiers) or direct-compiles (tier 1 only). Default: export for
@@ -708,24 +722,32 @@ def aot_or_jit(jitted, args, key_parts, tag='program', fun=None,
     import jax
     if use_export is None:
         use_export = mesh is None
+    donate = tuple(donate_argnums or ())
+    if donate and mesh is not None:
+        donate = ()  # round-8 NaN cliff: mesh programs never donate
     key = entry_key((tag, key_parts, args_signature(args),
-                     env_fingerprint(device=device, mesh=mesh)))
-    fn = load(key)
+                     env_fingerprint(device=device, mesh=mesh),
+                     ('donate', donate)))
+    fn = load(key, donate_argnums=donate)
     if fn is not None:
         return fn
     with _stats_lock:
         _stats['misses'] += 1
     t0 = time.perf_counter()
-    # the undonated jit the cached executable compiles from (docstring)
+    # the undonated jit the cached tier-2 module exports from (docstring);
+    # tier 1 compiles WITH certified donation so the serialized
+    # executable carries the state aliasing (warm runs skip the copy)
     cache_jit = jax.jit(fun) if fun is not None else jitted
     exported_bytes = None
     compiled = None
+    donated = False
     if use_export:
         try:
             from jax import export as jexport
             exp = jexport.export(cache_jit)(*args)
             exported_bytes = exp.serialize()
-            compiled = jax.jit(exp.call).lower(*args).compile()
+            compiled, donated = _compile_maybe_donated(jax, exp.call,
+                                                       donate, args)
         except Exception:
             exported_bytes = None
             compiled = None
@@ -733,15 +755,41 @@ def aot_or_jit(jitted, args, key_parts, tag='program', fun=None,
         # programs jax.export cannot carry (host callbacks, exotic
         # shardings): direct AOT compile — tier 1 only
         try:
-            compiled = cache_jit.lower(*args).compile()
+            if donate and fun is not None:
+                compiled, donated = _compile_maybe_donated(jax, fun,
+                                                           donate, args)
+            else:
+                compiled = cache_jit.lower(*args).compile()
         except TypeError:
             # a backend/jit wrapper without .lower: give up on caching
             return jitted
     with _stats_lock:
         _stats['compiles'] += 1
         _stats['compile_s'] += time.perf_counter() - t0
-    store(key, compiled=compiled, exported_bytes=exported_bytes, tag=tag)
+    # `donated` is the OUTCOME, not the request: a donated compile that
+    # fell back stores donated=False so doctor/cache_ctl/smoke guards
+    # never report a recovery that did not happen
+    store(key, compiled=compiled, exported_bytes=exported_bytes, tag=tag,
+          donated=donated)
     return compiled
+
+
+def _compile_maybe_donated(jax, fn, donate, args):
+    """AOT-compile `fn`, donating `donate` argnums when certified;
+    returns (compiled, donated_outcome). A donated compile that fails
+    warns and falls back to undonated (the copy tax returns,
+    correctness never leaves)."""
+    if donate:
+        try:
+            return (jax.jit(fn, donate_argnums=donate).lower(
+                *args).compile(), True)
+        except Exception as e:
+            warnings.warn(
+                'compile cache: donated compile failed (%s: %s) — '
+                'falling back to the undonated executable (one extra '
+                'state copy per step)' % (type(e).__name__, e),
+                RuntimeWarning)
+    return jax.jit(fn).lower(*args).compile(), False
 
 
 # -- shared in-memory LRU helper ---------------------------------------------

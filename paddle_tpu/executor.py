@@ -193,6 +193,16 @@ class Executor(object):
                                 'ckpt_stall_s': 0.0, 'run_s': 0.0}
         self._profile_role = 'training'
         self._prof_registered = False
+        # program uid -> last DonationCertificate (passes/dataflow.py)
+        self._donation_certs = {}
+        # id(array) -> array: state leaves OUR donated dispatches
+        # produced — the only buffers provably XLA-owned and therefore
+        # safe to donate through a RELOADED executable (everything else
+        # may be a zero-copy view of host memory: device_put of numpy,
+        # jnp.asarray over a checkpoint payload). Donation kills each
+        # generation's buffers, so the retained entries are tiny dead
+        # shells; the cap is a leak backstop, not a working set.
+        self._owned_out = {}
 
     # ------------------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, feed_var_name='feed',
@@ -323,6 +333,8 @@ class Executor(object):
     def close(self):
         self._cache.clear()
         self._cache_index.clear()
+        self._owned_out.clear()
+        self._donation_certs.clear()
         if self._prof_registered:
             from . import profiler as _profiler
             _profiler.unregister_training_source('executor@%x' % id(self))
@@ -410,7 +422,8 @@ class Executor(object):
         fn = self._cache.get(key)
         if fn is None:
             self._evict_stale(program)
-            fn = self._build_multi(program, tuple(fetch_names),
+            fn = self._build_multi(program, tuple(sorted(feed_vals)),
+                                   tuple(fetch_names),
                                    out_state_names, k, fetch_policy)
             self._cache[key] = fn
             self._cache_index.setdefault(program._uid, set()).add(key)
@@ -616,8 +629,8 @@ class Executor(object):
                              "values across the group" % name)
         return jnp.stack(vals)
 
-    def _build_multi(self, program, fetch_names, out_state_names, k,
-                     fetch_policy):
+    def _build_multi(self, program, feed_names, fetch_names,
+                     out_state_names, k, fetch_policy):
         """Compile a K-step dispatch: the single-step trace body wrapped
         in a lax.scan over stacked feeds + per-step rng keys. One cache
         entry per (signature, K) — an EOF tail group of m < K steps
@@ -662,7 +675,10 @@ class Executor(object):
             key_parts=self._aot_key_parts(program, fetch_names,
                                           out_state_names,
                                           extra=('multi', k, fetch_policy)),
-            tag='executor_steps', fun=step_k)
+            tag='executor_steps', fun=step_k,
+            donate_state=self._donation_safe(program, feed_names,
+                                             fetch_names,
+                                             out_state_names))
 
     def _aot_key_parts(self, program, fetch_names, out_state_names,
                        extra=()):
@@ -680,24 +696,51 @@ class Executor(object):
                 _config.rng_impl(),
                 int(_config.get_flag('dropout_bits') or 0)) + tuple(extra)
 
-    def _resolve_aot(self, jitted, fun, args, key_parts, tag):
+    def _donation_safe(self, program, feed_names, fetch_names,
+                       out_state_names):
+        """True when the dataflow certifier proves the state dict may be
+        donated on a RELOADED executable (passes/dataflow.py): the
+        round-8 warm-path copy tax is paid only when safety is
+        unprovable. PTPU_WARM_DONATION=0 opts out wholesale. The
+        certificate is kept on the executor (last per program uid) for
+        tests and the doctor to inspect."""
+        import os as _os
+        from .passes import dataflow as _dataflow
+        if _os.environ.get('PTPU_WARM_DONATION', '1') in (
+                '0', 'false', 'off'):
+            cert = _dataflow.DonationCertificate(
+                False, (), ['disabled by PTPU_WARM_DONATION=0'], 0,
+                out_state_names)
+        else:
+            cert = _dataflow.certify_donation(
+                program, out_state_names, feed_names=feed_names,
+                fetch_names=fetch_names)
+        self._donation_certs[program._uid] = cert
+        return cert.safe
+
+    def _resolve_aot(self, jitted, fun, args, key_parts, tag,
+                     donate_state=False):
         """Persistent-cache warm start for a (state, feed, rng) callable,
         resolved on the FIRST call (AOT needs concrete avals): a tier-1
         hit deserializes the executable (zero trace, zero compile); a miss
         compiles once and persists. Falls back to plain `jitted` when the
         cache is off or debug_nans needs the re-traceable path. `fun` is
-        the raw step callable: cached executables compile WITHOUT state
-        donation (compile_cache.aot_or_jit's reload-aliasing contract)."""
+        the raw step callable the cache compiles from; state donation is
+        applied only under a dataflow donation certificate
+        (`donate_state`, compile_cache.aot_or_jit's reload-aliasing
+        contract)."""
         from .core import compile_cache as _cc
         from .core import config as _config
         if key_parts is None or not _cc.enabled() \
                 or _config.get_flag('check_nan_inf'):
             return jitted
         return _cc.aot_or_jit(jitted, args, key_parts, tag=tag, fun=fun,
-                              device=self._device)
+                              device=self._device,
+                              donate_argnums=(0,) if donate_state
+                              else None)
 
     def _pin_and_call(self, jitted, key_parts=None, tag='executor',
-                      fun=None):
+                      fun=None, donate_state=False):
         """Wrap a jitted (state, feed, rng) callable so every input is
         pinned to this executor's device, COMMITTED — keeps
         avals/shardings identical across runs (no silent pjit recompiles)
@@ -717,7 +760,48 @@ class Executor(object):
                 return v
             return jax.device_put(v, dev)
 
+        def _own_leaf(x):
+            # donated-state leaves must live in XLA-OWNED buffers. A
+            # RELOADED donating executable honors its baked-in aliasing
+            # WITHOUT jax's external-buffer guard, and zero-copy views
+            # of host memory reach the scope from several doors —
+            # device_put of numpy on cpu backends, jnp.asarray over a
+            # checkpoint/model payload (io._deserialize_tensor), user
+            # arrays — so it would scribble over / free memory it does
+            # not own (measured: NaN then heap corruption on the
+            # kill-resume path). The only leaves provably XLA-owned are
+            # the ones OUR donated dispatches produced (_owned_out);
+            # everything else gets one owned copy at this boundary.
+            # Steady state (outputs feeding the next dispatch) passes
+            # through untouched: the per-step copy stays eliminated.
+            if isinstance(x, jax.Array) and id(x) in self._owned_out:
+                return x
+            with (jax.default_device(dev) if dev is not None
+                  else _nullcontext()):
+                return jnp.array(x, copy=True)
+
+        def _note_owned(tree):
+            owned = self._owned_out
+            leaves = [l for l in jax.tree.leaves(tree)
+                      if isinstance(l, jax.Array)]
+            cap = max(1024, 4 * len(leaves))
+            if len(owned) > cap:
+                # with donation in effect old generations are deleted
+                # shells (free); when a fallback executable is silently
+                # undonated they stay LIVE — prune the dead, then bound
+                # the live set to a few generations so the registry can
+                # never pin unbounded state memory
+                for k in [k for k, v in owned.items() if v.is_deleted()]:
+                    del owned[k]
+                while len(owned) > cap:
+                    owned.pop(next(iter(owned)))
+            for l in leaves:
+                owned[id(l)] = l
+
         def call(state, feed, rng):
+            if donate_state:
+                state = {n: jax.tree.map(_own_leaf, v)
+                         for n, v in state.items()}
             if dev is not None:
                 state = {n: _pin(v) for n, v in state.items()}
                 feed = {n: _pin(v) for n, v in feed.items()}
@@ -725,12 +809,29 @@ class Executor(object):
             fn = fn_box[0]
             if fn is None:
                 fn = self._resolve_aot(jitted, fun, (state, feed, rng),
-                                       key_parts, tag)
+                                       key_parts, tag,
+                                       donate_state=donate_state)
                 fn_box[0] = fn
-            if dev is not None:
-                with jax.default_device(dev):
-                    return fn(state, feed, rng)
-            return fn(state, feed, rng)
+            try:
+                if dev is not None:
+                    with jax.default_device(dev):
+                        out = fn(state, feed, rng)
+                else:
+                    out = fn(state, feed, rng)
+            finally:
+                if donate_state:
+                    # the dispatch CONSUMED these buffers (scribbled in
+                    # place on success, possibly torn on failure):
+                    # evict them so a stale object re-submitted later
+                    # is copied — or raises on a deleted array — never
+                    # passed through into a reloaded aliasing
+                    # executable
+                    for v in state.values():
+                        for l in jax.tree.leaves(v):
+                            self._owned_out.pop(id(l), None)
+            if donate_state:
+                _note_owned(out[1])   # new_state: next dispatch's input
+            return out
         return call
 
     # ------------------------------------------------------------------
@@ -1055,7 +1156,10 @@ class Executor(object):
                 jax.jit(step, donate_argnums=(0,)),
                 key_parts=self._aot_key_parts(program, fetch_names,
                                               out_state_names),
-                tag='executor_run', fun=step)
+                tag='executor_run', fun=step,
+                donate_state=self._donation_safe(program, feed_names,
+                                                 fetch_names,
+                                                 out_state_names))
 
         # SPMD: batch-shard the feeds over the data axis; state replicated
         # unless a parameter carries a sharding_spec (TP/EP annotation);

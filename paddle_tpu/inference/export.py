@@ -219,6 +219,28 @@ def _optimize_for_export(predictor):
     return program
 
 
+def _peak_bytes_est(program, feed_names, fetch_names, feed_sig):
+    """Static peak-memory estimate of one export bucket, from the
+    dataflow analyzer at the bucket's batch (the sample's leading dim).
+    None when estimation declines — the signature must never fail an
+    export over an analysis bug."""
+    try:
+        from ..passes import dataflow as _dataflow
+        # the bucket batch = the largest leading dim across the feeds (a
+        # rank-1 auxiliary feed like im_shape must not win over the real
+        # batched inputs)
+        batch = 1
+        for e in feed_sig:
+            shp = e.get('shape') or ()
+            if shp:
+                batch = max(batch, int(shp[0]))
+        dfa = _dataflow.analyze_program(program, feed_names=feed_names,
+                                        fetch_names=fetch_names)
+        return int(dfa.peak_memory(batch=batch).peak_bytes)
+    except Exception:
+        return None
+
+
 def _export_single(predictor, sample, out_dir, program=None,
                    precompile=None):
     """One fixed-shape export (the original export_compiled body);
@@ -316,6 +338,12 @@ def _export_single(predictor, sample, out_dir, program=None,
                  for n, ll, shp in zip(fetch_names, fetch_levels,
                                        fetch_shapes)]
     sig = {'version': 3, 'feeds': feed_sig, 'fetches': fetch_sig}
+    est = _peak_bytes_est(program, feed_names, fetch_names, feed_sig)
+    if est is not None:
+        # static peak-bytes at THIS bucket's batch (passes/dataflow.py):
+        # capacity planning reads it per bucket_<n>/signature.json before
+        # ever loading the module
+        sig['peak_bytes_est'] = est
     with open(os.path.join(out_dir, _SIGNATURE), 'w') as f:
         json.dump(sig, f, indent=1)
     if _should_precompile(precompile):

@@ -16,10 +16,19 @@ Program/Block (framework.py is the IR). Four passes ship today:
                         elementwise_add producers (tracer applies the
                         act lowering in the same expression)
 
+Alongside the rewriting passes sits the read-only dataflow analysis
+engine (dataflow.py, ISSUE 7): def-use chains + last-writer resolution
+across sub-blocks, per-var live intervals, alias/in-place hazards, a
+bytes-from-shape peak-memory estimator (per program and per export
+bucket), and the donation-safety certifier that lets warm-started
+cached executables donate state again (PERF_NOTES round 8/10).
+
 Consumers: Executor runs a fast warn-only verify per program epoch
-(PTPU_STRICT_VERIFY=1 raises), CompiledProgram and export_compiled run
-the optimization pipeline before lowering, InferenceTranspiler.transpile
-and memory_optimize are thin calls into PassManager.
+(PTPU_STRICT_VERIFY=1 raises) and certifies donation per run boundary,
+CompiledProgram and export_compiled run the optimization pipeline
+before lowering, InferenceTranspiler.transpile and memory_optimize are
+thin calls into PassManager (memory_optimize returns the liveness
+report), tools/program_doctor.py runs the whole suite over the zoo.
 
     import paddle_tpu as fluid
     prog, reports = fluid.passes.apply_optimization_pipeline(
@@ -37,6 +46,10 @@ from .verifier import (VerifyProgramPass, Diagnostic, ProgramVerifyError,
 from .dce import DeadOpEliminationPass
 from .const_fold import ConstantFoldPass
 from .fuse_act import FuseActivationPass
+from .dataflow import (DataflowAnalysis, DonationCertificate, Hazard,
+                       MemoryEstimate, MemoryOptimizeReport,
+                       analyze_program, certify_donation, donation_plan,
+                       var_bytes)
 
 # constant_fold runs first so dead_op_elimination sweeps the literal
 # producers whose consumers folded; fuse_activation last, on the final

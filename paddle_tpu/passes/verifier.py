@@ -112,8 +112,25 @@ def verify_program(program, feed_names=None, fetch_names=None, level='full'):
 
     defined0 = _initially_defined(program, feed_names)
 
+    # ordered recursive walk from block 0: sub-blocks verify against the
+    # names defined at their owning op's position plus the bindings the
+    # control op itself creates (rnn inner slots); orphan blocks nothing
+    # references fall back to the unordered declared-somewhere check
+    visited = set()
+    _verify_block(program, program.global_block(), set(defined0), diags,
+                  visited)
     for block in program.blocks:
-        _verify_block(program, block, defined0, diags, level, fetch_names)
+        if block.idx not in visited:
+            _verify_block(program, block, set(defined0), diags, visited,
+                          ordered=False)
+
+    if level == 'full':
+        for block in program.blocks:
+            _check_registry_consistency(program, block, diags)
+        _warn_dead_outputs(program, program.global_block(), diags,
+                           fetch_names)
+        _check_rebind_and_dead_persistables(program, diags, feed_names,
+                                            fetch_names)
 
     # fetch reachability: every fetch target must be produced by some op,
     # fed, or live in the scope (persistable)
@@ -133,13 +150,37 @@ def verify_program(program, feed_names=None, fetch_names=None, level='full'):
     return diags
 
 
-def _verify_block(program, block, defined0, diags, level, fetch_names=()):
-    # use-before-def is order-exact only in block 0: the executor traces
-    # the global block top to bottom, while sub-block bodies run under
-    # env bindings their owning control op creates (while carries, rnn
-    # step inputs) — there, only fully-undeclared names are errors.
-    ordered = block.idx == 0
-    defined = set(defined0)
+# inner sub-block names a control op binds into its body's env before
+# any body op runs (ops/control_ops.py): rnn step-input/static-input
+# slots and memory `pre` vars — each attr entry carries the inner name
+# at index 1
+_SUB_BLOCK_BINDING_ATTRS = ('rnn_step_inputs', 'rnn_static_inputs',
+                            'rnn_memories')
+
+
+def _op_sub_bindings(op):
+    names = set()
+    for key in _SUB_BLOCK_BINDING_ATTRS:
+        for entry in op.attrs.get(key, ()) or ():
+            try:
+                if entry[1]:
+                    names.add(entry[1])
+            except (TypeError, IndexError):
+                continue
+    return names
+
+
+def _verify_block(program, block, defined, diags, visited, ordered=True):
+    """Order-exact use-before-def walk, recursive through sub-blocks.
+
+    The tracer runs every body against `dict(tracer.env)` at the owning
+    op's position (while carries live in the outer env by construction;
+    rnn inner slots are bound by the op — _op_sub_bindings), so a
+    sub-block read of a name with neither an incoming binding nor an
+    earlier in-block write fails the trace on the first iteration:
+    order-exact checking inside sub-blocks is sound, not conservative.
+    `defined` is mutated (callers pass a copy per scope)."""
+    visited.add(block.idx)
 
     for i, op in enumerate(block.ops):
         if not _registered(op.type):
@@ -185,17 +226,25 @@ def _verify_block(program, block, defined0, diags, level, fetch_names=()):
                     % (op.type, name), block=block.idx, op_index=i,
                     var=name))
             elif ordered and name not in defined:
+                where = '' if block.idx == 0 else \
+                    ' inside sub-block %d' % block.idx
                 diags.append(Diagnostic(
                     'error', 'use-before-def',
-                    "op %r reads %r before any op produces it (not fed, "
-                    "not persistable — check op ordering)"
-                    % (op.type, name), block=block.idx, op_index=i,
-                    var=name))
-        defined |= op_writes(op, program)
+                    "op %r reads %r before any op produces it%s (not "
+                    "fed, not persistable, not bound by the owning "
+                    "control op — check op ordering)"
+                    % (op.type, name, where), block=block.idx,
+                    op_index=i, var=name))
 
-    if level == 'full':
-        _check_registry_consistency(program, block, diags)
-        _warn_dead_outputs(program, block, diags, fetch_names)
+        # recurse into bodies with the names defined AT THIS POINT plus
+        # the op's own inner bindings — the env the tracer hands them
+        for idx in sub_block_indices(op):
+            if 0 < idx < len(program.blocks) and idx != block.idx \
+                    and idx not in visited:
+                _verify_block(program, program.block(idx),
+                              defined | _op_sub_bindings(op), diags,
+                              visited, ordered=ordered)
+        defined |= op_writes(op, program)
 
 
 # ---------------------------------------------------------------------------
@@ -316,6 +365,37 @@ def _warn_dead_outputs(program, block, diags, fetch_names=()):
                     "op %r outputs %s are consumed by nothing (not "
                     "fetched, not persistable)" % (op.type, dead),
                     block=block.idx, op_index=i, var=dead[0]))
+
+
+def _check_rebind_and_dead_persistables(program, diags, feed_names=(),
+                                        fetch_names=()):
+    """Program-level full checks riding the dataflow engine:
+
+    double-write — two ops bind one name with no read of the first
+    binding in between (the first write is dead; usually a forgotten
+    rename). Warn: the tracer's rebinding semantics run it fine.
+
+    dead-persistable — a persistable var no op reads or writes and
+    nothing fetches: it costs scope memory and checkpoint bytes every
+    step for nothing (often a pruned branch's orphaned parameter).
+    """
+    from .dataflow import DataflowAnalysis
+    dfa = DataflowAnalysis(program, feed_names=feed_names,
+                           fetch_names=fetch_names)
+    for hz in dfa.hazards():
+        if hz.code == 'double-write':
+            diags.append(Diagnostic('warn', 'double-write', hz.message,
+                                    block=0, op_index=hz.op_index,
+                                    var=hz.var))
+    keep = set(fetch_names or ()) | set(feed_names or ())
+    for name in sorted(dfa.persistables):
+        if name in dfa.written or name in dfa.uses or name in keep:
+            continue
+        diags.append(Diagnostic(
+            'warn', 'dead-persistable',
+            "persistable %r is read and written by no op and never "
+            "fetched — it spends scope/checkpoint bytes for nothing"
+            % name, block=0, var=name))
 
 
 @register_pass

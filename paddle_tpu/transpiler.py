@@ -74,16 +74,19 @@ class DistributeTranspiler(object):
 
 
 def memory_optimize(input_program, skip_opt_set=None, print_log=False,
-                    level=0, skip_grads=False, fetch_list=None):
-    """Dead-op elimination over `input_program` (in place).
+                    level=0, skip_grads=False, fetch_list=None, batch=1):
+    """Dead-op elimination + a REAL liveness report over `input_program`
+    (sweep happens in place).
 
     Buffer REUSE stays with XLA: its liveness-based buffer assignment
     subsumes the reference's var-reuse rewrite
     (memory_optimization_transpiler.py:491), so no var renaming happens
-    here. What this call now does do is run the passes subsystem's
-    dead_op_elimination — ops that can reach neither a fetch target nor a
-    persistable write are dropped before tracing — and return its
-    PassReport (ops/vars removed) instead of silently returning None.
+    here. What this call does: run the passes subsystem's
+    dead_op_elimination, then the dataflow engine (passes/dataflow.py)
+    over the swept program, and return a MemoryOptimizeReport carrying
+    what the reference printed while rewriting — per-var live ranges,
+    the reuse opportunities a liveness allocator sees, and the static
+    peak-bytes estimate before/after the sweep (at `batch` for -1 dims).
 
     fetch_list: optional fetch Variables/names. Without it only vars
     feeding literally nothing are prunable (any terminal var is a
@@ -92,14 +95,22 @@ def memory_optimize(input_program, skip_opt_set=None, print_log=False,
     """
     from .framework import Variable
     from .passes import PassManager
+    from .passes import dataflow as _dataflow
     fetch_names = None
     if fetch_list is not None:
         fetch_names = [f.name if isinstance(f, Variable) else str(f)
                        for f in fetch_list]
+    peak_before = _dataflow.analyze_program(
+        input_program, fetch_names=fetch_names).peak_memory(
+            batch=batch, top=0).peak_bytes
     _, reports = PassManager(['dead_op_elimination']).apply(
         input_program, fetch_names=fetch_names,
         preserve=skip_opt_set, inplace=True)
-    report = reports[0]
+    dfa = _dataflow.analyze_program(input_program, fetch_names=fetch_names)
+    report = _dataflow.MemoryOptimizeReport(
+        reports[0], dfa.live_intervals(),
+        peak_before, dfa.peak_memory(batch=batch, top=0).peak_bytes,
+        dfa.reuse_report(batch=batch), batch)
     if print_log:
         print(report)
     return report

@@ -14,6 +14,10 @@ python tools/print_signatures.py --check API.spec
 echo "== program lint over models/ (passes verifier; errors fail the build) =="
 JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python tools/program_lint.py --models
 
+echo "== program doctor over models/ (dataflow engine: liveness, hazards, peak-bytes, donation plan; any NEW hazard vs the checked-in baseline fails) =="
+JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu PTPU_STRICT_VERIFY=1 \
+python tools/program_doctor.py --models --check-baseline tools/doctor_baseline.json
+
 echo "== tests (8-device virtual cpu mesh, tier-1: not slow) =="
 # tier-1 includes tests/test_multi_step.py (K-step dispatch bit-identity)
 # and the prefetch-ring units in test_data_pipeline.py; the threaded ring
@@ -28,6 +32,9 @@ PTPU_PLATFORM=cpu python scripts/infer_loop_smoke.py
 
 echo "== warm-start smoke (persistent compile cache: cold A/B warm in fresh processes, >=3x artifact cold-start cut, cache_ctl stats/prune/prewarm) =="
 JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/warm_start_smoke.py
+
+echo "== donation smoke (certified warm-path state donation: 0 compiles, in-place state update recovered, bit-identity across donated/undonated/uncached arms) =="
+JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/donation_smoke.py
 
 echo "== crash-resume smoke (SIGKILL mid-epoch -> seconds-scale resume with bit/loss parity; chaos kill+corrupt rounds; checkpoint stall < 2%) =="
 JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/crash_resume_smoke.py

@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Certified warm-path donation smoke (ISSUE 7, wired into scripts/ci.sh).
+
+PERF_NOTES round 8 recorded the blind tax: reloaded (warm-started)
+executables compiled WITHOUT state donation because aliasing safety was
+unprovable, costing one extra state copy per run_steps step. The
+dataflow donation certifier (passes/dataflow.py) now proves it, so this
+smoke runs tests/donation_worker.py in FOUR fresh processes against tmp
+cache dirs and asserts the recovery is real AND bit-identity guarded:
+
+  cold    cache on           — certifies, compiles donated, persists
+  warm    same cache dir     — executable-tier hits, ZERO XLA compiles,
+                               and the state update still lands IN PLACE
+                               (old buffers die / addresses reused: the
+                               round-8 copy is measurably gone)
+  nodon   PTPU_WARM_DONATION=0 — the control arm: same program, no
+                               donation, zero in-place updates (the tax)
+  ref     PTPU_COMPILE_CACHE=0 — the uncached reference semantics
+
+Every fetch and every final state var must be byte-identical across all
+four arms.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, 'tests', 'donation_worker.py')
+
+
+def run_worker(cache_dir, out_npz, env_extra=None):
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    p = subprocess.run([sys.executable, WORKER, cache_dir, out_npz],
+                       capture_output=True, text=True, env=env,
+                       cwd=REPO)
+    if p.returncode != 0 or 'DONATION_OK' not in p.stdout:
+        print(p.stdout)
+        print(p.stderr)
+        raise SystemExit('donation worker failed')
+    line = next(l for l in p.stdout.splitlines()
+                if l.startswith('DONATION_STATS '))
+    return json.loads(line[len('DONATION_STATS '):])
+
+
+def main():
+    import numpy as np
+    tmp = tempfile.mkdtemp(prefix='ptpu_donation_smoke_')
+    cache = os.path.join(tmp, 'cache')
+    arms = {}
+    stats = {}
+    stats['cold'] = run_worker(cache, os.path.join(tmp, 'cold.npz'))
+    stats['warm'] = run_worker(cache, os.path.join(tmp, 'warm.npz'))
+    stats['nodon'] = run_worker(os.path.join(tmp, 'cache_nodon'),
+                                os.path.join(tmp, 'nodon.npz'),
+                                {'PTPU_WARM_DONATION': '0'})
+    stats['ref'] = run_worker(os.path.join(tmp, 'cache_ref'),
+                              os.path.join(tmp, 'ref.npz'),
+                              {'PTPU_COMPILE_CACHE': '0'})
+    for k, s in stats.items():
+        print('%-5s %s' % (k, json.dumps(s)))
+        arms[k] = {n: v for n, v in
+                   np.load(os.path.join(tmp, k + '.npz')).items()}
+
+    # certifier verdicts
+    assert stats['cold']['cert_safe'] is True, 'certifier must accept'
+    assert stats['nodon']['cert_safe'] is False
+    assert stats['cold']['donated_entries'] >= 1, \
+        'cold run must persist donated entries'
+    assert stats['nodon']['donated_entries'] == 0
+
+    # warm start: executable-tier hits, zero real compiles
+    assert stats['warm']['exec_hits'] >= 2, stats['warm']
+    assert stats['warm']['misses'] == 0, stats['warm']
+    assert stats['warm']['xla_compiles_net'] == 0, stats['warm']
+
+    # the measured copy elimination: wherever this backend honors
+    # donation on the cold (bookkept) path, the RELOADED executable must
+    # keep updating state in place — and the no-donation control arm
+    # must not
+    if stats['cold']['aliased_state'] > 0:
+        assert stats['warm']['aliased_state'] >= \
+            stats['cold']['aliased_state'], \
+            'warm path lost the in-place state update: %s' % stats['warm']
+        assert stats['warm']['old_deleted'] > 0, stats['warm']
+    assert stats['nodon']['aliased_state'] == 0, stats['nodon']
+
+    # bit-identity across every arm (fetches + final state)
+    base = arms['cold']
+    for name in ('warm', 'nodon', 'ref'):
+        other = arms[name]
+        assert set(base) == set(other), (name, set(base) ^ set(other))
+        for k in sorted(base):
+            assert np.array_equal(base[k], other[k]), \
+                '%s: %r differs from cold' % (name, k)
+
+    print('DONATION SMOKE OK — warm run: %d exec hits, 0 compiles, '
+          '%d/%d state buffers updated in place (nodon control: %d)'
+          % (stats['warm']['exec_hits'], stats['warm']['aliased_state'],
+             stats['warm']['state_total'],
+             stats['nodon']['aliased_state']))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,529 @@
+"""Dataflow analysis engine tests (paddle_tpu/passes/dataflow.py):
+def-use chains + last-writer resolution (incl. sub-block scope walks),
+live intervals, hazard classes, the peak-memory estimator and its
+per-bucket/export wiring, the memory_optimize liveness report, the
+donation-safety certifier, the certified warm-donation path
+(fresh-subprocess bit-identity A/B), and the program_doctor /
+program_lint --json CLIs."""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import unique_name
+from paddle_tpu.passes import dataflow, verify_program
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# builders
+# ---------------------------------------------------------------------------
+def _dense_net(seed=11):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup), unique_name.guard():
+        x = fluid.layers.data(name='x', shape=[6], dtype='float32')
+        label = fluid.layers.data(name='y', shape=[1], dtype='int64')
+        h = fluid.layers.fc(x, size=16, act='relu')
+        logits = fluid.layers.fc(h, size=4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits=logits,
+                                                    label=label))
+        probs = fluid.layers.softmax(logits)
+        acc = fluid.layers.accuracy(input=probs, label=label)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss, acc
+
+
+def _while_net():
+    """Counter loop: while i < 5: s = s + i; i += 1 — one sub-block."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), unique_name.guard():
+        i = fluid.layers.fill_constant([1], 'int64', 0)
+        n = fluid.layers.fill_constant([1], 'int64', 5)
+        s = fluid.layers.fill_constant([1], 'int64', 0)
+        cond = fluid.layers.less_than(i, n)
+        w = fluid.layers.While(cond)
+        with w.block():
+            s2 = fluid.layers.elementwise_add(s, i)
+            fluid.layers.assign(s2, s)
+            fluid.layers.increment(i)
+            fluid.layers.less_than(i, n, cond=cond)
+    return main, s
+
+
+# ---------------------------------------------------------------------------
+# def-use / last-writer
+# ---------------------------------------------------------------------------
+def test_def_use_chains_and_last_writer():
+    main, _, loss, acc = _dense_net()
+    dfa = dataflow.analyze_program(main, feed_names=['x', 'y'],
+                                   fetch_names=[loss.name])
+    defs, uses = dfa.def_use(loss.name)
+    assert len(defs) == 1 and uses, (defs, uses)
+    # the loss's single def is its last writer seen from program end
+    assert dfa.last_writer(loss.name) == defs[0]
+    # a param is a program input: last writer before its optimizer
+    # update resolves to -1, after it to the sgd op
+    w = 'fc_0.w_0'
+    wdefs, wuses = dfa.def_use(w)
+    assert wdefs, 'optimizer must write the param'
+    assert dfa.last_writer(w, before=wdefs[0]) == -1
+    assert dfa.last_writer(w) == wdefs[-1]
+    # never-touched name
+    assert dfa.last_writer('no_such_var') is None
+
+
+def test_last_writer_at_walks_sub_block_scope():
+    main, s = _while_net()
+    dfa = dataflow.analyze_program(main)
+    sub_idx = next(idx for idx in range(1, main.num_blocks))
+    sub = main.block(sub_idx)
+    # inside the body, reading `s` at op 0 resolves through the parent
+    # chain (the owning while op models the loop carry)
+    got = dfa.last_writer_at(sub_idx, 0, s.name)
+    assert got is not None and got != -1
+    blk, op_idx = got
+    assert blk in (0, sub_idx)
+    # a body-local temp read after its in-block def resolves locally
+    local = next(n for n in sub.vars
+                 if dfa.block_defs.get((sub_idx, n)))
+    d0 = dfa.block_defs[(sub_idx, local)][0]
+    assert dfa.last_writer_at(sub_idx, d0 + 1, local) == (sub_idx, d0)
+
+
+# ---------------------------------------------------------------------------
+# live intervals / memory / reuse
+# ---------------------------------------------------------------------------
+def test_live_intervals_shape():
+    main, _, loss, acc = _dense_net()
+    dfa = dataflow.analyze_program(main, feed_names=['x', 'y'],
+                                   fetch_names=[loss.name])
+    iv = dfa.live_intervals()
+    n_ops = len(main.global_block().ops)
+    # fetch target lives to program end
+    assert iv[loss.name][1] == n_ops
+    # persistables live to program end and start as inputs
+    assert iv['fc_0.w_0'] == (-1, n_ops)
+    # a pure temp is born at its def and dies at its last use, strictly
+    # inside the program
+    s, e = iv['fc_0.tmp_0']
+    assert 0 <= s <= e < n_ops
+
+
+def test_peak_memory_scales_with_batch_and_buckets():
+    main, _, loss, acc = _dense_net()
+    dfa = dataflow.analyze_program(main, feed_names=['x', 'y'],
+                                   fetch_names=[loss.name])
+    e1 = dfa.peak_memory(batch=1)
+    e64 = dfa.peak_memory(batch=64)
+    assert e64.peak_bytes > e1.peak_bytes
+    assert e64.params_bytes == e1.params_bytes  # static state
+    assert e1.peak_op_index >= 0 and e1.peak_op_type
+    assert e1.top and all('name' in t and t['bytes'] > 0 for t in e1.top)
+    per = dfa.peak_memory_per_bucket([1, 8, 64])
+    assert set(per) == {1, 8, 64}
+    assert per[8].peak_bytes < per[64].peak_bytes
+    d = e1.as_dict()
+    assert d['peak_bytes'] == e1.peak_bytes
+
+
+def test_reuse_report_accounting():
+    main, _, loss, acc = _dense_net()
+    dfa = dataflow.analyze_program(main, feed_names=['x', 'y'],
+                                   fetch_names=[loss.name])
+    r = dfa.reuse_report(batch=32)
+    assert r['temps_total_bytes'] >= r['temps_peak_bytes'] > 0
+    assert r['reusable_bytes'] == (r['temps_total_bytes']
+                                   - r['temps_peak_bytes'])
+    for p in r['pairs']:
+        # each pair: disjoint live intervals, same byte size
+        iv = dfa.live_intervals()
+        assert iv[p['of']][1] < iv[p['reuse']][0]
+
+
+def test_var_bytes_dtypes():
+    class V(object):
+        def __init__(self, shape, dtype):
+            self.shape, self.dtype = shape, dtype
+    assert dataflow.var_bytes(V((4, 8), 'float32')) == (128, False)
+    assert dataflow.var_bytes(V((-1, 8), 'bfloat16'), batch=4) == (64,
+                                                                   True)
+    assert dataflow.var_bytes(V(None, 'float32')) == (0, False)
+
+
+# ---------------------------------------------------------------------------
+# hazards
+# ---------------------------------------------------------------------------
+def test_hazard_aliased_input_is_error():
+    main, _, loss, acc = _dense_net()
+    hz = dataflow.analyze_program(
+        main, feed_names=['x', 'fc_0.w_0'],
+        fetch_names=[loss.name]).hazards()
+    errs = [h for h in hz if h.level == 'error']
+    assert errs and errs[0].code == 'aliased-input'
+    assert errs[0].var == 'fc_0.w_0'
+
+
+def test_hazard_double_write_and_war():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), unique_name.guard():
+        a = fluid.layers.fill_constant([2], 'float32', 1.0)
+        b = fluid.layers.scale(a, scale=2.0)        # reads a
+        # rebind a AFTER b read it: write-after-read (info)
+        fluid.layers.assign(b, a)
+        # dead write: c bound twice, first binding never read
+        c = fluid.layers.fill_constant([2], 'float32', 3.0)
+        main.global_block().append_op(
+            type='assign', inputs={'X': [b.name]},
+            outputs={'Out': [c.name]}, infer_shape=False)
+    dfa = dataflow.analyze_program(main, fetch_names=[c.name])
+    codes = {h.code: h for h in dfa.hazards()}
+    assert 'war' in codes and codes['war'].level == 'info'
+    assert 'double-write' in codes \
+        and codes['double-write'].level == 'warn'
+    # the verifier surfaces the dead write as a warn diagnostic
+    diags = verify_program(main, fetch_names=[c.name])
+    assert any(d.code == 'double-write' and d.level == 'warn'
+               for d in diags)
+
+
+def test_verifier_dead_persistable_warn():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), unique_name.guard():
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.fc(x, size=2)
+        main.global_block().create_var(
+            name='orphan_state', shape=(4,), dtype='float32',
+            persistable=True)
+    diags = verify_program(main, fetch_names=[y.name])
+    hits = [d for d in diags if d.code == 'dead-persistable']
+    assert hits and hits[0].var == 'orphan_state' \
+        and hits[0].level == 'warn'
+    # parameters the program reads never warn
+    assert not any(d.code == 'dead-persistable' and 'fc_0' in (d.var or
+                                                               '')
+                   for d in diags)
+
+
+# ---------------------------------------------------------------------------
+# sub-block use-before-def (satellite: verifier upgrade)
+# ---------------------------------------------------------------------------
+def test_sub_block_use_before_def_flagged():
+    main, s = _while_net()
+    sub = next(b for b in main.blocks if b.idx != 0)
+    # corrupt the body: make its first op read a body-local temp that is
+    # only produced later in the body
+    local = sub.ops[0].output_arg_names()[0]
+    reader = sub.ops[0]
+    producer_idx = 0
+    op = sub.ops.pop(producer_idx)
+    sub.ops.append(op)   # producer now AFTER its consumers
+    diags = verify_program(main, fetch_names=[s.name], level='fast')
+    ubd = [d for d in diags if d.code == 'use-before-def'
+           and d.block == sub.idx]
+    assert ubd, 'expected sub-block use-before-def in %s' % diags
+    assert all(d.level == 'error' for d in ubd)
+
+
+def test_sub_block_clean_while_and_rnn_verify():
+    main, s = _while_net()
+    diags = verify_program(main, fetch_names=[s.name])
+    assert [d for d in diags if d.level == 'error'] == []
+
+    # StaticRNN: inner bindings (step inputs, memory pre) come from the
+    # owning op's attrs — order-exact checking must accept them
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2), unique_name.guard():
+        x = fluid.layers.data(name='x', shape=[3, 8], dtype='float32')
+        xt = fluid.layers.transpose(x, perm=[1, 0, 2])
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            xi = rnn.step_input(xt)
+            mem = rnn.memory(shape=[-1, 8], batch_ref=xi)
+            h = fluid.layers.elementwise_add(mem, xi)
+            rnn.update_memory(mem, h)
+            rnn.step_output(h)
+        out = rnn()
+    diags2 = verify_program(main2, fetch_names=[out[0].name]
+                            if isinstance(out, (list, tuple))
+                            else [out.name])
+    assert [d for d in diags2 if d.level == 'error'] == []
+
+
+# ---------------------------------------------------------------------------
+# donation certifier
+# ---------------------------------------------------------------------------
+def test_certifier_accepts_run_steps_state():
+    main, _, loss, acc = _dense_net()
+    plan = dataflow.donation_plan(main, feed_names=['x', 'y'],
+                                  fetch_names=[loss.name])
+    assert plan.safe and plan.donate and plan.bytes > 0
+    assert set(plan.donate) <= dataflow.analyze_program(
+        main).persistables
+
+
+def test_certifier_rejects_caller_visible_alias():
+    main, _, loss, acc = _dense_net()
+    state = sorted(dataflow.analyze_program(main).persistables)
+    # fed persistable: caller-visible aliased input
+    cert = dataflow.certify_donation(main, state,
+                                     feed_names=['x', state[0]],
+                                     fetch_names=[loss.name])
+    assert not cert.safe and cert.donate == ()
+    assert any('aliased input' in r for r in cert.reasons)
+    # fetched state: the returned array would alias a donated buffer
+    cert2 = dataflow.certify_donation(main, state, feed_names=['x'],
+                                      fetch_names=[state[0]])
+    assert not cert2.safe
+    assert any('alias of a donated state buffer' in r
+               for r in cert2.reasons)
+    # mesh programs never donate
+    cert3 = dataflow.certify_donation(main, state, feed_names=['x'],
+                                      fetch_names=[loss.name], mesh=True)
+    assert not cert3.safe and any('mesh' in r for r in cert3.reasons)
+    # non-persistable state name
+    cert4 = dataflow.certify_donation(main, state + ['fc_0.tmp_0'],
+                                      feed_names=['x'],
+                                      fetch_names=[loss.name])
+    assert not cert4.safe
+
+
+def test_executor_records_certificates(tmp_path):
+    main, startup, loss, acc = _dense_net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    feed = {'x': np.random.RandomState(0).randn(4, 6).astype(np.float32),
+            'y': np.zeros((4, 1), np.int64)}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[loss])
+    cert = exe._donation_certs[main._uid]
+    assert cert.safe, cert.reasons
+    # fetching a param makes the boundary unsafe — certificate flips
+    with fluid.scope_guard(scope):
+        exe.run(main, feed=feed, fetch_list=[loss, 'fc_0.w_0'])
+    cert2 = exe._donation_certs[main._uid]
+    assert not cert2.safe
+
+
+# ---------------------------------------------------------------------------
+# the certified warm-donation path: fresh-subprocess bit-identity A/B
+# ---------------------------------------------------------------------------
+def _run_donation_worker(cache_dir, out_npz, env_extra=None):
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tests',
+                                      'donation_worker.py'),
+         str(cache_dir), str(out_npz)],
+        capture_output=True, text=True, env=env, cwd=REPO)
+    assert p.returncode == 0 and 'DONATION_OK' in p.stdout, \
+        p.stdout + p.stderr
+    line = next(l for l in p.stdout.splitlines()
+                if l.startswith('DONATION_STATS '))
+    return json.loads(line[len('DONATION_STATS '):])
+
+
+def test_warm_donation_bit_identity_and_copy_elimination(tmp_path):
+    """The ISSUE 7 acceptance bar: warm-started run_steps with certified
+    donation performs zero compiles, stays bit-identical to both the
+    cold and the undonated paths, and measurably updates state in place
+    (the round-8 extra copy is gone) wherever the backend honors
+    donation at all."""
+    cache = str(tmp_path / 'cache')
+    cold = _run_donation_worker(cache, tmp_path / 'cold.npz')
+    warm = _run_donation_worker(cache, tmp_path / 'warm.npz')
+    nodon = _run_donation_worker(
+        str(tmp_path / 'cache2'), tmp_path / 'nodon.npz',
+        {'PTPU_WARM_DONATION': '0'})
+
+    assert cold['cert_safe'] and cold['donated_entries'] >= 1
+    assert warm['exec_hits'] >= 2 and warm['misses'] == 0
+    assert warm['xla_compiles_net'] == 0
+    assert not nodon['cert_safe'] and nodon['donated_entries'] == 0
+    assert nodon['aliased_state'] == 0
+    if cold['aliased_state']:  # backend honors donation: copy is gone
+        assert warm['aliased_state'] >= cold['aliased_state']
+        assert warm['old_deleted'] > 0
+
+    a = {k: v for k, v in np.load(tmp_path / 'cold.npz').items()}
+    for name in ('warm.npz', 'nodon.npz'):
+        b = np.load(tmp_path / name)
+        assert set(a) == set(b.files)
+        for k in sorted(a):
+            assert np.array_equal(a[k], b[k]), (name, k)
+
+
+def test_warm_donation_survives_host_backed_state(tmp_path):
+    """Zero-copy hazard regression: state that re-enters the scope as
+    HOST numpy (exactly what a checkpoint restore or io.load does) must
+    never be donated in place by a reloaded executable —
+    jax.device_put/jnp.asarray of host memory can be zero-copy, and the
+    deserialized executable's baked-in aliasing has no external-buffer
+    guard (measured pre-fix: NaN then heap corruption on kill-resume).
+    The executor copies non-owned leaves at the donated boundary, so a
+    mid-run host round-trip of the whole state must be a bit-exact
+    no-op."""
+    cache = str(tmp_path / 'cache')
+    _run_donation_worker(cache, tmp_path / 'cold.npz')
+    warm = _run_donation_worker(cache, tmp_path / 'warm.npz')
+    reseed = _run_donation_worker(cache, tmp_path / 'reseed.npz',
+                                  {'PTPU_DONATION_WORKER_RESEED': '1'})
+    assert warm['exec_hits'] >= 2 and reseed['exec_hits'] >= 2
+    a = np.load(tmp_path / 'warm.npz')
+    b = np.load(tmp_path / 'reseed.npz')
+    assert set(a.files) == set(b.files)
+    for k in sorted(a.files):
+        av, bv = a[k], b[k]
+        assert np.isfinite(av).all() if av.dtype.kind == 'f' else True
+        assert np.array_equal(av, bv), k
+
+
+# ---------------------------------------------------------------------------
+# memory_optimize liveness report (satellite b)
+# ---------------------------------------------------------------------------
+def test_memory_optimize_liveness_report():
+    from paddle_tpu.passes import PassReport
+    main, _, loss, acc = _dense_net()
+    report = fluid.memory_optimize(main, fetch_list=[loss], batch=32)
+    assert isinstance(report, PassReport)
+    assert isinstance(report, dataflow.MemoryOptimizeReport)
+    assert report.ops_removed >= 1               # metric branch pruned
+    assert report.peak_bytes_before >= report.peak_bytes_after > 0
+    assert report.live_ranges and report.batch == 32
+    assert report.reuse['reusable_bytes'] >= 0
+    d = report.as_dict()
+    assert d['memory']['peak_bytes_after'] == report.peak_bytes_after
+    assert d['details']['peak_bytes_before'] == report.peak_bytes_before
+    json.dumps(d)  # report must stay machine-serializable
+
+
+# ---------------------------------------------------------------------------
+# export bucket estimates (tentpole: per export bucket)
+# ---------------------------------------------------------------------------
+def test_export_signature_carries_peak_bytes(tmp_path):
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.inference.export import export_compiled
+    main, startup, loss, acc = _dense_net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        logits = 'softmax_0.tmp_0'
+        model_dir = str(tmp_path / 'model')
+        fluid.io.save_inference_model(
+            model_dir, ['x'], [main.global_block().var(logits)], exe,
+            main)
+    pred = create_predictor(Config(model_dir))
+    sample = np.zeros((8, 6), np.float32)
+    out_dir = str(tmp_path / 'artifact')
+    export_compiled(pred, [sample], out_dir, batch_sizes=[4, 8])
+    from paddle_tpu.inference.serve import _BUCKET_DIR
+    sigs = {}
+    for sub in (_BUCKET_DIR % 4, _BUCKET_DIR % 8, ''):
+        with open(os.path.join(out_dir, sub, 'signature.json')) as f:
+            sigs[sub] = json.load(f)
+    assert sigs[_BUCKET_DIR % 4]['peak_bytes_est'] > 0
+    assert sigs[_BUCKET_DIR % 8]['peak_bytes_est'] \
+        > sigs[_BUCKET_DIR % 4]['peak_bytes_est']
+    # top level mirrors the largest bucket
+    assert sigs['']['peak_bytes_est'] == sigs[_BUCKET_DIR % 8][
+        'peak_bytes_est']
+
+
+# ---------------------------------------------------------------------------
+# CLIs: program_doctor + program_lint --json
+# ---------------------------------------------------------------------------
+def _tool(name):
+    path = os.path.join(REPO, 'tools', name + '.py')
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_program_doctor_cli(tmp_path, capsys):
+    doctor = _tool('program_doctor')
+    main, _, loss, acc = _dense_net()
+    main._fetch_names = [loss.name]
+    good = tmp_path / 'good.json'
+    good.write_bytes(fluid.io.serialize_program(main))
+    assert doctor.main([str(good)]) == 0
+    human = capsys.readouterr().out
+    assert 'peak est' in human and 'donation: SAFE' in human
+
+    # --json: machine report with the full analysis payload
+    assert doctor.main([str(good), '--json', '--batch', '16']) == 0
+    rep = json.loads(capsys.readouterr().out)
+    prog = rep['programs'][0]
+    assert prog['errors'] == 0 and prog['peak']['batch'] == 16
+    assert prog['donation']['safe'] is True
+    assert prog['live_ranges']['temps'] > 0
+
+    # corrupt program: exit 1 with the error surfaced
+    bad_main, _, bloss, _ = _dense_net()
+    op = next(o for o in bad_main.global_block().ops
+              if o.type == 'mul')
+    op.inputs['X'] = ['ghost_var']
+    bad = tmp_path / 'bad.json'
+    bad.write_bytes(fluid.io.serialize_program(bad_main))
+    assert doctor.main([str(bad)]) == 1
+    capsys.readouterr()
+    assert doctor.main([str(tmp_path / 'missing.json')]) == 2
+    capsys.readouterr()
+    # --json still names the failing input
+    assert doctor.main([str(tmp_path / 'missing.json'), '--json']) == 2
+    rep = json.loads(capsys.readouterr().out)
+    assert rep['failures'] == 1
+    assert rep['build_failures'][0]['name'].endswith('missing.json')
+
+
+def test_program_doctor_baseline_gate(tmp_path, capsys):
+    doctor = _tool('program_doctor')
+    base = tmp_path / 'baseline.json'
+    assert doctor.main(['--models', 'smallnet',
+                        '--write-baseline', str(base)]) == 0
+    capsys.readouterr()
+    # clean re-run passes the gate
+    assert doctor.main(['--models', 'smallnet',
+                        '--check-baseline', str(base)]) == 0
+    capsys.readouterr()
+    # a model missing from the baseline is a regression (exit 1)
+    assert doctor.main(['--models', 'stacked_lstm',
+                        '--check-baseline', str(base)]) == 1
+    capsys.readouterr()
+
+
+def test_checked_in_doctor_baseline_covers_zoo():
+    with open(os.path.join(REPO, 'tools', 'doctor_baseline.json')) as f:
+        base = json.load(f)
+    lint = _tool('program_lint')
+    assert set(base['programs']) == set(lint._model_builders())
+    for name, entry in base['programs'].items():
+        assert entry['errors'] == 0, (name, entry)
+        assert entry['donation_safe'] is True, name
+
+
+def test_program_lint_json_mode(tmp_path, capsys):
+    lint = _tool('program_lint')
+    main, _, loss, acc = _dense_net()
+    good = tmp_path / 'good.json'
+    good.write_bytes(fluid.io.serialize_program(main))
+    assert lint.main([str(good), '--json']) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep['errors'] == 0 and rep['failures'] == 0
+    assert rep['programs'][0]['ops'] > 0
+    # exit-code contract documented in --help
+    with pytest.raises(SystemExit):
+        lint.main(['--help'])
+    help_text = capsys.readouterr().out
+    assert 'exit status' in help_text
+    assert '1 on any error-level diagnostic' in help_text.replace('\n',
+                                                                  ' ')

@@ -1,0 +1,352 @@
+"""program_doctor: the full static-analysis suite over Programs.
+
+Runs the verifier (passes/verifier.py, full level) AND the dataflow
+engine (passes/dataflow.py) — live ranges, alias/in-place hazards,
+static peak-memory estimate, buffer-reuse opportunity, donation plan —
+over serialized programs or the models/ zoo, and reports per program.
+
+Usage:
+    python tools/program_doctor.py PATH [PATH ...]  # serialized programs
+    python tools/program_doctor.py --models         # build + doctor zoo
+    python tools/program_doctor.py --models smallnet resnet --batch 64
+    python tools/program_doctor.py --models --json  # machine report
+    python tools/program_doctor.py --models --write-baseline tools/doctor_baseline.json
+    python tools/program_doctor.py --models --check-baseline tools/doctor_baseline.json
+
+PATH is a save_inference_model dir (containing __model__), a __model__
+file itself, or any serialize_program() JSON blob. With no arguments,
+--models is implied.
+
+The baseline flags drive the CI gate (scripts/ci.sh): --write-baseline
+records each model's error/warning/hazard fingerprint; --check-baseline
+fails (exit 1) when a model grows ANY new error, new warning code, or
+new hazard code relative to the checked-in baseline — peak-bytes drift
+is reported but does not fail (layer-size changes are legitimate).
+
+Exit status: 0 clean (warnings allowed), 1 on any error-level
+diagnostic/hazard or a baseline regression, 2 on a build/load failure.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _lint_mod():
+    """tools/program_lint.py (not a package): the zoo builder registry
+    and path loader live there; the doctor reuses them verbatim."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        'program_lint.py')
+    spec = importlib.util.spec_from_file_location('program_lint', path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# one-program examination
+# ---------------------------------------------------------------------------
+def examine_program(program, name, batch=32, level='full',
+                    feed_names=None, fetch_names=None):
+    """Run the whole suite over one Program; returns the report dict."""
+    from paddle_tpu.passes import verify_program
+    from paddle_tpu.passes import dataflow
+
+    t0 = time.perf_counter()
+    diags = verify_program(program, feed_names=feed_names,
+                           fetch_names=fetch_names, level=level)
+    dfa = dataflow.analyze_program(program, feed_names=feed_names,
+                                   fetch_names=fetch_names)
+    hazards = dfa.hazards()
+    est = dfa.peak_memory(batch=batch)
+    reuse = dfa.reuse_report(batch=batch)
+    plan = dataflow.donation_plan(program, feed_names=feed_names,
+                                  fetch_names=fetch_names, analysis=dfa)
+
+    intervals = dfa.live_intervals()
+    temps = [(n, s, e) for n, (s, e) in intervals.items()
+             if n not in dfa.persistables and n not in dfa.inputs]
+    temps.sort(key=lambda t: (t[1] - t[2], t[0]))  # longest span first
+    hz_codes = {}
+    for h in hazards:
+        hz_codes[h.code] = hz_codes.get(h.code, 0) + 1
+    diag_codes = {}
+    for d in diags:
+        diag_codes[d.code] = diag_codes.get(d.code, 0) + 1
+    # full-level verify already mirrors 'double-write' hazards as warn
+    # diagnostics — count each defect once in the totals
+    mirrored = set(diag_codes) if level == 'full' else set()
+
+    return {
+        'name': name,
+        'ops': sum(len(b.ops) for b in program.blocks),
+        'blocks': program.num_blocks,
+        'vars': len(dfa.vars),
+        'errors': sum(1 for d in diags if d.level == 'error')
+        + sum(1 for h in hazards if h.level == 'error'),
+        'warnings': sum(1 for d in diags if d.level == 'warn')
+        + sum(1 for h in hazards
+              if h.level == 'warn' and h.code not in mirrored),
+        'diagnostics': [d.as_dict() for d in diags],
+        'diag_codes': diag_codes,
+        'hazards': [h.as_dict() for h in hazards],
+        'hazard_codes': hz_codes,
+        'live_ranges': {
+            'temps': len(temps),
+            'longest': [{'name': n, 'start': s, 'end': e}
+                        for n, s, e in temps[:5]],
+        },
+        'peak': est.as_dict(),
+        'reuse': {k: reuse[k] for k in ('temps_total_bytes',
+                                        'temps_peak_bytes',
+                                        'reusable_bytes', 'n_temps')},
+        'donation': plan.as_dict(),
+        'seconds': round(time.perf_counter() - t0, 3),
+    }
+
+
+def _fmt_bytes(n):
+    from paddle_tpu.passes.dataflow import _fmt_bytes as f
+    return f(n)
+
+
+def print_report(rep, out=print):
+    p, d = rep['peak'], rep['donation']
+    out("%s: %d ops, %d block(s), %d var(s) — %d error(s), %d warning(s) "
+        "[%.2fs]" % (rep['name'], rep['ops'], rep['blocks'], rep['vars'],
+                     rep['errors'], rep['warnings'], rep['seconds']))
+    for diag in rep['diagnostics']:
+        out("  [%s] %s (block %d op %d): %s"
+            % (diag['level'], diag['code'], diag['block'],
+               diag['op_index'], diag['message']))
+    for hz in rep['hazards']:
+        # dependence facts ('war') stay in the counters; hazards the
+        # verifier already mirrored as diagnostics printed above
+        if hz['code'] != 'war' and hz['code'] not in rep['diag_codes']:
+            out("  [%s] hazard %s: %s" % (hz['level'], hz['code'],
+                                          hz['message']))
+    out("  peak est @batch=%d: %s (params %s + feeds %s resident, temps "
+        "peak %s) at op %s %s"
+        % (p['batch'], _fmt_bytes(p['peak_bytes']),
+           _fmt_bytes(p['params_bytes']), _fmt_bytes(p['feeds_bytes']),
+           _fmt_bytes(p['temps_peak_bytes']), p['peak_op_index'],
+           p['peak_op_type']))
+    lr = rep['live_ranges']
+    longest = ', '.join('%s [%d, %d]' % (e['name'], e['start'], e['end'])
+                        for e in lr['longest'][:2])
+    out("  live ranges: %d temps; longest %s" % (lr['temps'], longest))
+    out("  reuse: %s reusable of %s temp total"
+        % (_fmt_bytes(rep['reuse']['reusable_bytes']),
+           _fmt_bytes(rep['reuse']['temps_total_bytes'])))
+    if d['safe']:
+        out("  donation: SAFE — %d state var(s), %s"
+            % (len(d['donate']), _fmt_bytes(d['bytes'])))
+    else:
+        out("  donation: REJECTED — %s" % '; '.join(d['reasons'][:3]))
+    war = rep['hazard_codes'].get('war', 0)
+    if war:
+        out("  in-place facts: %d write-after-read rebind(s)" % war)
+
+
+# ---------------------------------------------------------------------------
+# inputs: the zoo and serialized programs
+# ---------------------------------------------------------------------------
+def doctor_models(names, batch, level, out=print):
+    import paddle_tpu as fluid
+    from paddle_tpu import unique_name
+    lint = _lint_mod()
+    builders = lint._model_builders()
+    unknown = [n for n in names if n not in builders]
+    if unknown:
+        raise SystemExit("unknown model(s) %s; have: %s"
+                         % (unknown, ', '.join(sorted(builders))))
+    reports, failed = [], []
+    for name in (names or sorted(builders)):
+        main, startup = fluid.Program(), fluid.Program()
+        try:
+            with fluid.program_guard(main, startup), unique_name.guard():
+                fetches = builders[name]()
+        except Exception as e:
+            out("%s: BUILD FAILED: %s: %s" % (name, type(e).__name__, e))
+            failed.append({'name': name, 'build_failed': True,
+                           'error': '%s: %s' % (type(e).__name__, e)})
+            continue
+        reports.append(examine_program(
+            main, name, batch=batch, level=level,
+            fetch_names=lint._fetch_names(fetches)))
+    return reports, failed
+
+
+def doctor_path(path, batch, level):
+    from paddle_tpu import io as ptpu_io
+    shown = path
+    if os.path.isdir(path):
+        path = os.path.join(path, '__model__')
+    with open(path, 'rb') as f:
+        blob = f.read()
+    if not blob.lstrip()[:1] == b'{':
+        raise ValueError(
+            "%s is not a paddle_tpu serialized program (JSON); the "
+            "reference protobuf format is out of scope" % path)
+    program = ptpu_io.deserialize_program(blob)
+    name = os.path.basename(os.path.dirname(path)) or shown
+    return examine_program(
+        program, name, batch=batch, level=level,
+        feed_names=getattr(program, '_feed_names', None),
+        fetch_names=getattr(program, '_fetch_names', None))
+
+
+# ---------------------------------------------------------------------------
+# baseline gate (the CI contract)
+# ---------------------------------------------------------------------------
+def baseline_entry(rep):
+    """The stable fingerprint the baseline stores per program: analysis
+    outcomes only — no timings, no op-index detail that churns with
+    benign layer edits."""
+    return {
+        'ops': rep['ops'],
+        'errors': rep['errors'],
+        'warnings': rep['warnings'],
+        'diag_codes': dict(rep['diag_codes']),
+        'hazard_codes': dict(rep['hazard_codes']),
+        'donation_safe': rep['donation']['safe'],
+        'donation_vars': len(rep['donation']['donate']),
+        'peak_bytes': rep['peak']['peak_bytes'],
+        'peak_batch': rep['peak']['batch'],
+    }
+
+
+def check_baseline(reports, baseline, out=print):
+    """Compare current reports to the checked-in baseline. Returns the
+    number of regressions: any new error, any warning/hazard CODE absent
+    from the baseline or exceeding its count. Peak drift only prints."""
+    regressions = 0
+    base = baseline.get('programs', {})
+    for rep in reports:
+        b = base.get(rep['name'])
+        if b is None:
+            out("%s: NOT IN BASELINE — regenerate with --write-baseline"
+                % rep['name'])
+            regressions += 1
+            continue
+        if rep['errors'] > b.get('errors', 0):
+            out("%s: REGRESSION: %d error(s), baseline has %d"
+                % (rep['name'], rep['errors'], b.get('errors', 0)))
+            regressions += 1
+        for kind in ('diag_codes', 'hazard_codes'):
+            want = b.get(kind, {})
+            for code, n in sorted(rep[kind].items()):
+                if n > int(want.get(code, 0)):
+                    out("%s: REGRESSION: new %s %r (%d, baseline %d)"
+                        % (rep['name'], kind.replace('_codes', ''),
+                           code, n, int(want.get(code, 0))))
+                    regressions += 1
+        if rep['peak']['batch'] == b.get('peak_batch') \
+                and rep['peak']['peak_bytes'] != b.get('peak_bytes'):
+            out("%s: note: peak estimate drifted %s -> %s (not gating)"
+                % (rep['name'], b.get('peak_bytes'),
+                   rep['peak']['peak_bytes']))
+    return regressions
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="static program doctor: verifier + dataflow engine "
+                    "(paddle_tpu/passes) over serialized programs or the "
+                    "models/ zoo",
+        epilog="exit status: 0 clean (warnings allowed); 1 error-level "
+               "diagnostics/hazards or a baseline regression; 2 "
+               "build/load failure")
+    ap.add_argument('paths', nargs='*',
+                    help="serialized program files/dirs, or model names "
+                         "with --models")
+    ap.add_argument('--models', action='store_true',
+                    help="build and doctor the models/ zoo (default when "
+                         "no paths are given)")
+    ap.add_argument('--json', action='store_true',
+                    help="emit one machine-readable JSON report to "
+                         "stdout instead of the human report")
+    ap.add_argument('--batch', type=int, default=32,
+                    help="batch substituted for -1 dims in the memory "
+                         "estimate (default 32)")
+    ap.add_argument('--fast', action='store_true',
+                    help="structural verifier only (skip the registry "
+                         "shape/dtype sweep)")
+    ap.add_argument('--write-baseline', metavar='FILE',
+                    help="write the stable per-program fingerprint JSON")
+    ap.add_argument('--check-baseline', metavar='FILE',
+                    help="fail (exit 1) on any new error/warning/hazard "
+                         "vs this baseline")
+    args = ap.parse_args(argv)
+    level = 'fast' if args.fast else 'full'
+    say = (lambda *a, **k: None) if args.json else print
+
+    reports, failed = [], []
+    if args.models or not args.paths:
+        reports, failed = doctor_models(args.paths if args.models
+                                        else [], args.batch, level,
+                                        out=say)
+    else:
+        for path in args.paths:
+            try:
+                reports.append(doctor_path(path, args.batch, level))
+            except Exception as e:
+                say("%s: LOAD FAILED: %s: %s"
+                    % (path, type(e).__name__, e))
+                failed.append({'name': path, 'load_failed': True,
+                               'error': '%s: %s'
+                               % (type(e).__name__, e)})
+    failures = len(failed)
+
+    if not args.json:
+        for rep in reports:
+            print_report(rep)
+
+    errors = sum(r['errors'] for r in reports)
+    regressions = 0
+    if args.check_baseline:
+        try:
+            with open(args.check_baseline) as f:
+                baseline = json.load(f)
+        except (OSError, ValueError) as e:
+            say("baseline %s unreadable: %s" % (args.check_baseline, e))
+            return 2
+        regressions = check_baseline(reports, baseline, out=say)
+        if not regressions:
+            say("baseline check OK (%d program(s))" % len(reports))
+    if args.write_baseline:
+        payload = {'batch': args.batch,
+                   'programs': {r['name']: baseline_entry(r)
+                                for r in reports}}
+        with open(args.write_baseline, 'w') as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write('\n')
+        say("baseline written: %s" % args.write_baseline)
+
+    if args.json:
+        print(json.dumps({
+            'programs': reports,
+            'build_failures': failed,
+            'errors': errors,
+            'failures': failures,
+            'regressions': regressions,
+        }, indent=1, sort_keys=True))
+    else:
+        print("doctor: %d program(s), %d error(s), %d failure(s)%s"
+              % (len(reports), errors, failures,
+                 ', %d regression(s)' % regressions
+                 if args.check_baseline else ''))
+    if failures:
+        return 2
+    return 1 if (errors or regressions) else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -72,8 +72,10 @@ def _fetch_names(fetches):
 
 
 def lint_program(program, label, level='full', feed_names=None,
-                 fetch_names=None, out=print):
-    """Run the verifier; prints diagnostics; returns the error count."""
+                 fetch_names=None, out=print, collect=None):
+    """Run the verifier; prints diagnostics; returns the error count.
+    `collect`: optional list the structured per-program record is
+    appended to (the --json report)."""
     from paddle_tpu.passes import verify_program
     t0 = time.perf_counter()
     diags = verify_program(program, feed_names=feed_names,
@@ -86,10 +88,16 @@ def lint_program(program, label, level='full', feed_names=None,
         out("%s: %s" % (label, d))
     out("%s: %d ops, %d blocks — %d error(s), %d warning(s) [%.2fs]"
         % (label, ops, program.num_blocks, errors, warns, dt))
+    if collect is not None:
+        collect.append({'name': label, 'ops': ops,
+                        'blocks': program.num_blocks,
+                        'errors': errors, 'warnings': warns,
+                        'diagnostics': [d.as_dict() for d in diags],
+                        'seconds': round(dt, 3)})
     return errors
 
 
-def lint_path(path, level, out=print):
+def lint_path(path, level, out=print, collect=None):
     from paddle_tpu import io as ptpu_io
     if os.path.isdir(path):
         path = os.path.join(path, '__model__')
@@ -105,10 +113,10 @@ def lint_path(path, level, out=print):
                         or path, level=level,
                         feed_names=getattr(program, '_feed_names', None),
                         fetch_names=getattr(program, '_fetch_names', None),
-                        out=out)
+                        out=out, collect=collect)
 
 
-def lint_models(names, level, out=print):
+def lint_models(names, level, out=print, collect=None):
     import paddle_tpu as fluid
     from paddle_tpu import unique_name
     builders = _model_builders()
@@ -126,16 +134,23 @@ def lint_models(names, level, out=print):
         except Exception as e:
             out("%s: BUILD FAILED: %s: %s" % (name, type(e).__name__, e))
             failures += 1
+            if collect is not None:
+                collect.append({'name': name, 'build_failed': True,
+                                'error': '%s: %s'
+                                % (type(e).__name__, e)})
             continue
         total_errors += lint_program(main, name, level=level,
                                      fetch_names=_fetch_names(fetches),
-                                     out=out)
+                                     out=out, collect=collect)
     return total_errors, failures
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser(
-        description="static program verifier (paddle_tpu/passes)")
+        description="static program verifier (paddle_tpu/passes)",
+        epilog="exit status: 0 clean (warnings allowed); 1 on any "
+               "error-level diagnostic; 2 on a build/load failure "
+               "(a model that stops building, an unreadable path)")
     ap.add_argument('paths', nargs='*',
                     help="serialized program files/dirs, or model names "
                          "with --models")
@@ -145,23 +160,40 @@ def main(argv=None):
     ap.add_argument('--fast', action='store_true',
                     help="structural checks only (skip the registry "
                          "shape/dtype consistency sweep)")
+    ap.add_argument('--json', action='store_true',
+                    help="emit one machine-readable JSON report "
+                         "{programs, errors, failures} to stdout instead "
+                         "of the human report (exit codes unchanged)")
     args = ap.parse_args(argv)
     level = 'fast' if args.fast else 'full'
+    out = (lambda *a, **k: None) if args.json else print
+    collect = [] if args.json else None
 
     errors = 0
     failures = 0
     if args.models or not args.paths:
-        e, f = lint_models(args.paths if args.models else [], level)
+        e, f = lint_models(args.paths if args.models else [], level,
+                           out=out, collect=collect)
         errors += e
         failures += f
     else:
         for path in args.paths:
             try:
-                errors += lint_path(path, level)
+                errors += lint_path(path, level, out=out,
+                                    collect=collect)
             except Exception as e:
-                print("%s: LOAD FAILED: %s: %s"
-                      % (path, type(e).__name__, e))
+                out("%s: LOAD FAILED: %s: %s"
+                    % (path, type(e).__name__, e))
                 failures += 1
+                if collect is not None:
+                    collect.append({'name': path, 'load_failed': True,
+                                    'error': '%s: %s'
+                                    % (type(e).__name__, e)})
+    if args.json:
+        import json
+        print(json.dumps({'programs': collect, 'errors': errors,
+                          'failures': failures}, indent=1,
+                         sort_keys=True))
     if failures:
         return 2
     return 1 if errors else 0
