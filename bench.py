@@ -724,6 +724,92 @@ def bench_resnet_serving():
         'SERVE', 217.69, 'xeon6148')
 
 
+def bench_decode_serving():
+    """Continuous in-flight DECODE serving (ISSUE 8): a Poisson arrival
+    stream of autoregressive generate requests drives
+    inference.DecodingPredictor over the two-program paged-KV artifact —
+    the scenario the north star names (token-streaming generative decode
+    for many concurrent users). The A/B inside the line is the point:
+    sequential (one-request-at-a-time) decode pays the full fixed-shape
+    [max_slots] step cost per token of ONE request, while iteration-level
+    scheduling packs every occupied slot into the same dispatch. Reports
+    continuous tokens/s, the sequential baseline, slot occupancy, and
+    p50/p99 time-to-first-token + inter-token latency under the offered
+    Poisson load.
+
+    Env knobs (PTPU_BENCH_DECODE_*): REQS, MAX_NEW, SLOTS, RATE_X
+    (offered load as a multiple of sequential capacity), DMODEL, LAYERS.
+    """
+    import tempfile
+    import paddle_tpu as fluid
+    from models.transformer import build_decode_spec
+    from paddle_tpu.inference import DecodingPredictor, export_decode
+
+    n_req = int(os.environ.get('PTPU_BENCH_DECODE_REQS', '64'))
+    max_new = int(os.environ.get('PTPU_BENCH_DECODE_MAX_NEW', '24'))
+    slots = int(os.environ.get('PTPU_BENCH_DECODE_SLOTS', '8'))
+    rate_x = float(os.environ.get('PTPU_BENCH_DECODE_RATE_X', '8'))
+    d_model = int(os.environ.get('PTPU_BENCH_DECODE_DMODEL', '64'))
+    n_layer = int(os.environ.get('PTPU_BENCH_DECODE_LAYERS', '2'))
+    vocab, buckets, cache = 512, (8, 16), 64
+
+    scope = fluid.core.Scope()
+    with tempfile.TemporaryDirectory() as d, fluid.scope_guard(scope):
+        art = os.path.join(d, 'decode_art')
+        spec = build_decode_spec(vocab=vocab, d_model=d_model, n_head=4,
+                                 n_layer=n_layer, d_ff=4 * d_model,
+                                 max_slots=slots, max_cache_len=cache,
+                                 prompt_buckets=buckets, eos_id=1)
+        exe, _ = _device()
+        exe.run(spec['startup'], scope=scope)
+        export_decode(spec, art, scope=scope)
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(2, vocab, int(rng.randint(4, max(buckets))))
+                   for _ in range(n_req)]
+        pred = DecodingPredictor(art)
+        try:
+            pred.warmup()
+            t0 = time.perf_counter()
+            seq = [pred.generate(p, max_new_tokens=max_new)
+                   for p in prompts]
+            seq_s = time.perf_counter() - t0
+            seq_tok_s = sum(len(t) for t in seq) / seq_s
+            pred.stats.reset()
+            # offered rate derives from the MEASURED request rate, not
+            # tokens/max_new: early-eos requests are cheaper than
+            # max_new tokens, and a token-derived rate under-offers and
+            # idles the slots (decode_serve_smoke.py calibration note)
+            rate = rate_x * n_req / seq_s
+            arrivals = np.cumsum(np.random.RandomState(1).exponential(
+                1.0 / rate, n_req))
+            streams = []
+            t0 = time.perf_counter()
+            for i, p in enumerate(prompts):
+                delay = t0 + arrivals[i] - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                streams.append(pred.submit(p, max_new_tokens=max_new))
+            con = [s.result(600) for s in streams]
+            wall = time.perf_counter() - t0
+            snap = pred.stats.snapshot()
+        finally:
+            pred.close()
+    if con != seq:
+        raise RuntimeError('continuous decode transcripts diverged from '
+                           'sequential (bit-identity contract)')
+    tok_s = sum(len(t) for t in con) / wall
+    return _line('decode_serving_tok_s_per_chip', tok_s, 'tok/s',
+                 tok_s / seq_tok_s, seq_tok_s=round(seq_tok_s, 1),
+                 slots=slots, max_new=max_new,
+                 offered_req_s=round(rate, 1),
+                 occupancy=snap['occupancy'],
+                 ttft_p50_ms=snap['ttft_p50_ms'],
+                 ttft_p99_ms=snap['ttft_p99_ms'],
+                 itl_p50_ms=snap['itl_p50_ms'],
+                 itl_p99_ms=snap['itl_p99_ms'],
+                 baseline_ref='sequential_decode_self')
+
+
 def bench_resnet_infer():
     """ResNet-50 INFERENCE vs the committed reference number: 217.69 img/s
     on 2S Xeon 6148 + MKL-DNN, bs=16 (benchmark/IntelOptimizedPaddle.md:87)."""
@@ -1059,6 +1145,7 @@ BENCHES = [
     ('alexnet_train_img_s_per_chip', bench_alexnet),
     ('resnet50_infer_img_s_per_chip', bench_resnet_infer),
     ('resnet50_serving_img_s_per_chip', bench_resnet_serving),
+    ('decode_serving_tok_s_per_chip', bench_decode_serving),
     ('stacked_lstm_text_cls_ms_batch', bench_stacked_lstm),
     ('googlenet_train_img_s_per_chip', bench_googlenet),
     ('googlenet_infer_img_s_per_chip', bench_googlenet_infer),
@@ -1077,6 +1164,7 @@ _SHORT_PREFIX = {
     'bert': 'bert', 'ctr': 'ctr', 'ocr': 'ocr', 'vgg': 'vgg',
     'alexnet': 'alexnet', 'infer': 'resnet50_infer',
     'serving': 'resnet50_serving',
+    'decode': 'decode_serving',
     'lstm': 'stacked_lstm_text', 'googlenet': 'googlenet_train',
     'ginfer': 'googlenet_infer', 'smallnet': 'smallnet_cifar_ms',
     'smallnet_k': 'smallnet_cifar_multistep',

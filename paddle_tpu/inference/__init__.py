@@ -14,6 +14,10 @@
 - batching: BatchingPredictor — dynamic request coalescing over the
   compiled artifacts (multi-bucket selection, async double-buffered
   dispatch, serving metrics through profiler).
+- decoding: DecodingPredictor — continuous in-flight batching for
+  autoregressive decode over export_decode's two-program artifact
+  (prompt-bucketed prefill + fixed-slot decode step over a paged,
+  donated KV cache; token-streaming futures).
 The reference's analysis/TensorRT/MKLDNN pass zoo is subsumed by XLA:
 clone(for_test) freezes BN/dropout, XLA does the fusion.
 """
@@ -21,11 +25,13 @@ from .predictor import Config, Predictor, create_predictor
 from .ref_format import (load_reference_inference_model,
                          save_reference_inference_model,
                          load_reference_persistables)
-from .export import export_compiled, export_train_step
+from .export import export_compiled, export_train_step, export_decode
 from .serve import (CompiledPredictor, load_compiled,
                     CompiledTrainer, load_trainer)
 from .batching import (BatchingPredictor, ServingStats, load_batching,
                        ServerOverloaded, DeadlineExceeded)
+from .decoding import (DecodingPredictor, DecodeStats, TokenStream,
+                       load_decoding)
 
 __all__ = ['Config', 'Predictor', 'create_predictor',
            'load_reference_inference_model',
@@ -33,5 +39,7 @@ __all__ = ['Config', 'Predictor', 'create_predictor',
            'load_reference_persistables',
            'export_compiled', 'CompiledPredictor', 'load_compiled',
            'export_train_step', 'CompiledTrainer', 'load_trainer',
+           'export_decode', 'DecodingPredictor', 'DecodeStats',
+           'TokenStream', 'load_decoding',
            'BatchingPredictor', 'ServingStats', 'load_batching',
            'ServerOverloaded', 'DeadlineExceeded']

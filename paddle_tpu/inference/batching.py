@@ -88,15 +88,36 @@ def _resolve(future, result=None, exc=None):
         pass
 
 
+def shed_if_overloaded(stats, max_queue, fail):
+    """Load-shedding check shared by BatchingPredictor and
+    decoding.DecodingPredictor. The CALLER must hold stats._lock: the
+    depth check and the enqueue increment form one critical section, or
+    N concurrent submits at depth max_queue-1 would ALL pass and
+    overshoot the bound by the submitter concurrency. Returns True when
+    the request was shed (fail(exc) already called)."""
+    if max_queue is not None and stats.queue_depth >= max_queue:
+        stats.shed += 1
+        fail(ServerOverloaded(
+            'queue depth %d >= max_queue %d — request shed'
+            % (stats.queue_depth, max_queue)))
+        return True
+    return False
+
+
 def select_bucket(buckets, rows):
-    """Smallest compiled bucket that fits `rows`. `buckets` must be sorted
-    ascending. Raises if even the largest bucket is too small."""
-    for b in buckets:
-        if rows <= b:
-            return b
+    """Smallest compiled bucket that fits `rows` — deterministic for ANY
+    bucket order. Loaders sort their bucket lists once at load (this
+    class, decoding.DecodingPredictor) so the scan stays a prefix walk,
+    but a caller handing an unsorted list still gets the smallest fit
+    rather than the first fit (a hand-edited signature once returned the
+    128-bucket for a 2-row batch). Raises if even the largest bucket is
+    too small."""
+    fit = [b for b in buckets if rows <= b]
+    if fit:
+        return min(fit)
     raise ValueError(
         "batch of %d rows exceeds the largest compiled bucket %d"
-        % (rows, buckets[-1]))
+        % (rows, max(buckets)))
 
 
 def _batch_rows(sig):
@@ -305,18 +326,8 @@ class BatchingPredictor(object):
         fut = Future()
 
         def _shed_locked():
-            # must hold stats._lock: the depth check and the enqueue
-            # increment form one critical section, or N concurrent
-            # submits at depth max_queue-1 would ALL pass and overshoot
-            # the bound by the submitter concurrency
-            if self._max_queue is not None \
-                    and self.stats.queue_depth >= self._max_queue:
-                self.stats.shed += 1
-                fut.set_exception(ServerOverloaded(
-                    'queue depth %d >= max_queue %d — request shed'
-                    % (self.stats.queue_depth, self._max_queue)))
-                return True
-            return False
+            return shed_if_overloaded(self.stats, self._max_queue,
+                                      fut.set_exception)
 
         with self.stats._lock:     # fast-fail before validation work
             if _shed_locked():

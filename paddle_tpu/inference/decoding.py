@@ -1,0 +1,834 @@
+"""Continuous in-flight batching for autoregressive decode (ISSUE 8).
+
+`DecodingPredictor` serves an `export_decode` artifact as a token-
+streaming endpoint, the stateful sibling of `BatchingPredictor`'s
+stateless request coalescing — the technique behind modern high-
+throughput LLM servers (Orca-style iteration-level scheduling over a
+vLLM-style preallocated, slot-paged KV cache):
+
+1. **Two compiled programs, fixed shapes forever** — a PREFILL program
+   per prompt-length bucket (one request: writes the prompt's K/V rows
+   into one cache slot and returns first-token logits) and ONE
+   DECODE-STEP program ([max_slots] requests advance one token each).
+   Idle slots are masked by each slot's own attention window, so a
+   partially full batch runs the same compiled shape — ZERO recompiles
+   in steady state, and zero compiles at all in a warm fresh process
+   (AOT sidecars per program, `tools/cache_ctl.py prewarm`).
+2. **Iteration-level scheduling** — new requests join the running batch
+   at step boundaries (one prefill dispatch, then their slot decodes
+   with everyone else); finished sequences (eos / max_new_tokens) free
+   their slot immediately for the next waiting request.
+3. **Donated paged KV state** — the cache lives in device buffers
+   threaded input->output through every dispatch with XLA input/output
+   aliasing (in-place update). Fresh state is routed once through the
+   UNDONATED reorder program, so only XLA-owned buffers ever reach a
+   donated reloaded executable (the executor's round-10 ownership
+   discipline).
+4. **Streaming futures** — `submit()` returns a `TokenStream` yielding
+   tokens as steps complete; `BatchingPredictor`'s deadline / max_queue
+   shedding contract applies, including deadline expiry MID-decode
+   (the slot frees at the next step boundary).
+
+Determinism contract: a request's token stream is bit-identical whether
+it decodes alone or co-resident with any other requests — every per-slot
+computation is row-independent and masked rows carry exactly-zero
+attention weight (ops/decode_ops.py). Greedy and fixed-width beam search
+run host-side over the fetched logits with deterministic tie-breaking.
+
+Framework-free: imports only stdlib + numpy + jax (+ sibling serve.py /
+batching.py for the artifact AOT helpers and the shedding exceptions).
+"""
+import json
+import os
+import queue
+import sys
+import threading
+import time
+import warnings
+from collections import deque
+from concurrent.futures import Future
+
+import numpy as np
+
+try:
+    from . import serve as _serve
+    from . import batching as _batching
+except ImportError:  # imported by file path: siblings sit alongside
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import serve as _serve
+    import batching as _batching
+
+_STOP = object()
+_SOURCE_SEQ = _serve._SOURCE_SEQ
+_maybe_profiler = _serve._maybe_profiler
+select_bucket = _batching.select_bucket
+ServerOverloaded = _batching.ServerOverloaded
+DeadlineExceeded = _batching.DeadlineExceeded
+
+# -- artifact layout (export.py export_decode writes exactly this) ----------
+_DECODE_SIGNATURE = 'decode_signature.json'
+_STEP_DIR = 'decode_step'
+_PREFILL_DIR = 'prefill_%05d'   # % prompt-length bucket
+_REORDER_DIR = 'decode_reorder'
+
+
+def _percentiles(values, qs):
+    if not values:
+        return [0.0 for _ in qs]
+    arr = np.asarray(values, np.float64) * 1e3
+    return [round(float(p), 3) for p in np.percentile(arr, qs)]
+
+
+def _log_softmax(row):
+    """Deterministic host log-softmax (float64): beam scoring must give
+    the same bits for the same logits regardless of co-residency."""
+    x = np.asarray(row, np.float64)
+    x = x - x.max()
+    return x - np.log(np.exp(x).sum())
+
+
+class DecodeStats(object):
+    """Thread-safe decode-serving counters: queue-depth gauge, token /
+    dispatch totals, slot occupancy, and sliding windows of TTFT and
+    inter-token latency for percentile reporting. `snapshot()` is the
+    profiler serving-source contract (kind='decode' rows render in
+    `profiler.serving_report()`'s decode table)."""
+
+    def __init__(self, window=8192):
+        self._lock = threading.Lock()
+        self._ttft = deque(maxlen=window)
+        self._itl = deque(maxlen=window)
+        self.queue_depth = 0
+        self.requests = 0        # completed requests
+        self.tokens = 0          # tokens decoded (all beams)
+        self.prefills = 0        # prefill dispatches
+        self.steps = 0           # decode-step dispatches
+        self.reorders = 0        # slot-gather dispatches (beam/replicate)
+        self.active_slot_steps = 0
+        self.slot_steps = 0
+        self.shed = 0
+        self.expired = 0
+        self.busy_s = 0.0        # wall time with >= 1 active slot
+
+    def reset(self):
+        """Zero counters and latency windows (queue_depth is a live gauge
+        and stays): separates warmup from the measured run."""
+        with self._lock:
+            self._ttft.clear()
+            self._itl.clear()
+            self.requests = 0
+            self.tokens = 0
+            self.prefills = 0
+            self.steps = 0
+            self.reorders = 0
+            self.active_slot_steps = 0
+            self.slot_steps = 0
+            self.shed = 0
+            self.expired = 0
+            self.busy_s = 0.0
+
+    def snapshot(self):
+        with self._lock:
+            ttft50, ttft99 = _percentiles(list(self._ttft), [50, 99])
+            itl50, itl99 = _percentiles(list(self._itl), [50, 99])
+            occ = (self.active_slot_steps / self.slot_steps
+                   if self.slot_steps else 0.0)
+            return {'kind': 'decode',
+                    'queue_depth': int(self.queue_depth),
+                    'requests': int(self.requests),
+                    'tokens': int(self.tokens),
+                    'prefills': int(self.prefills),
+                    'steps': int(self.steps),
+                    'reorders': int(self.reorders),
+                    'occupancy': round(occ, 4),
+                    'tokens_s': round(self.tokens / self.busy_s, 2)
+                    if self.busy_s else 0.0,
+                    'shed': int(self.shed),
+                    'expired': int(self.expired),
+                    'ttft_p50_ms': ttft50, 'ttft_p99_ms': ttft99,
+                    'itl_p50_ms': itl50, 'itl_p99_ms': itl99}
+
+
+class TokenStream(object):
+    """Per-request streaming future. Greedy requests: iterate to receive
+    tokens as decode steps complete (`for tok in stream: ...`), or call
+    `result()` for the full generated id list (eos included when
+    emitted). Beam requests: `result()` -> (ids [beam, n_tokens] int64,
+    scores [beam] float64), hypotheses sorted best-first; iteration
+    yields nothing until completion (beams reorder mid-flight)."""
+
+    def __init__(self, beam=None):
+        self.beam = beam
+        self._q = queue.Queue()
+        self._fut = Future()
+        self._cancelled = False
+
+    # -- consumer side ----------------------------------------------------
+    def __iter__(self):
+        while True:
+            kind, payload = self._q.get()
+            if kind == 'tok':
+                yield payload
+            elif kind == 'end':
+                return
+            else:
+                raise payload
+
+    def result(self, timeout=None):
+        return self._fut.result(timeout)
+
+    def done(self):
+        return self._fut.done()
+
+    def exception(self, timeout=None):
+        return self._fut.exception(timeout)
+
+    def cancel(self):
+        """Best-effort: the scheduler frees the slot(s) at the next step
+        boundary; already-streamed tokens remain delivered."""
+        self._cancelled = True
+
+    # -- producer side (scheduler thread) ---------------------------------
+    def _push(self, tok):
+        self._q.put(('tok', int(tok)))
+
+    def _finish(self, result):
+        try:
+            self._fut.set_result(result)
+        except Exception:
+            pass
+        self._q.put(('end', None))
+
+    def _fail(self, exc):
+        try:
+            self._fut.set_exception(exc)
+        except Exception:
+            pass
+        self._q.put(('err', exc))
+
+
+class _Request(object):
+    __slots__ = ('prompt', 'max_new', 'beam', 'stream', 't_submit',
+                 'deadline', 'slots', 'produced', 'tokens', 'last_tokens',
+                 'scores', 'finished', 'hyps', 't_first', 't_last')
+
+    def __init__(self, prompt, max_new, beam, stream, deadline_ms):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.beam = beam                  # None = greedy
+        self.stream = stream
+        self.t_submit = time.perf_counter()
+        self.deadline = (self.t_submit + deadline_ms / 1e3
+                         if deadline_ms is not None else None)
+        self.slots = []                   # slot indices, beam order
+        self.produced = 0                 # tokens generated so far
+        self.tokens = []                  # greedy transcript
+        self.last_tokens = []             # per beam: next step's input
+        self.scores = []                  # per beam accumulated logprob
+        self.finished = []                # per beam: emitted eos
+        self.hyps = []                    # per beam token lists
+        self.t_first = None
+        self.t_last = None
+
+
+class _DecodeModule(object):
+    """One exported decode program: lazy StableHLO deserialize, AOT
+    warm-start sidecar (zero compiles when present), fresh bookkept jit
+    fallback — donated state for step/prefill (jax's own donation
+    bookkeeping guards the cold path; the sidecar carries certified
+    aliasing for the warm path)."""
+
+    def __init__(self, d, donate_state, device=None):
+        with open(os.path.join(d, _serve._MODULE), 'rb') as f:
+            self._module_bytes = f.read()
+        self._donate = bool(donate_state)
+        self._fn = None
+        self._aot = None
+        if os.environ.get('PTPU_ARTIFACT_AOT', '1') not in ('0', 'false'):
+            # sidecar keyed on the PINNED device's platform (the
+            # CompiledPredictor discipline): an explicit platform= must
+            # never load an executable baked for the default backend
+            self._aot = _serve._load_aot(
+                os.path.join(d, _serve._AOT_SIDECAR
+                             % _serve._aot_platform(device)),
+                _serve._module_sha(self._module_bytes))
+
+    def _jitted(self):
+        if self._fn is None:
+            import jax
+            from jax import export as jexport
+            exp = jexport.deserialize(self._module_bytes)
+            kw = {'donate_argnums': (0,)} if self._donate else {}
+            self._fn = jax.jit(exp.call, **kw)
+        return self._fn
+
+    def call(self, *args):
+        fn = self._aot if self._aot is not None else self._jitted()
+        with warnings.catch_warnings():
+            # backends without donation support (XLA:CPU) warn per call;
+            # the fallback is a copy, not a correctness issue
+            warnings.filterwarnings(
+                'ignore', message='Some donated buffers were not usable')
+            return fn(*args)
+
+
+def _precompile_decode_dir(d, state_specs, arg_specs, donate, platform=None):
+    """AOT-compile one decode program for `platform` and write its
+    warm-start sidecar. Step/prefill compile WITH donate_argnums=(0,)
+    (the paged cache updates in place on warm replicas); the reorder
+    program compiles undonated — it doubles as the owned-buffer boundary
+    for freshly loaded state."""
+    import jax
+    from jax import export as jexport
+    with open(os.path.join(d, _serve._MODULE), 'rb') as f:
+        module_bytes = f.read()
+    plat = platform or _serve._aot_platform()
+    dev = jax.devices(plat)[0]
+    exp = jexport.deserialize(module_bytes)
+    kw = {'donate_argnums': (0,)} if donate else {}
+    with jax.default_device(dev):
+        compiled = jax.jit(exp.call, **kw).lower(
+            state_specs, *arg_specs).compile()
+    return _serve._save_aot(os.path.join(d, _serve._AOT_SIDECAR % plat),
+                            compiled, _serve._module_sha(module_bytes))
+
+
+def precompile_decode_artifact(artifact_dir, platform=None):
+    """Prewarm a continuous-decode artifact: AOT-compile the decode-step
+    program, EVERY prefill bucket, and the reorder program, writing
+    warm-start sidecars — a replica that loads the artifact afterwards
+    answers with zero traces and zero XLA compiles. Driven by
+    `tools/cache_ctl.py prewarm` (serve.precompile_artifact detects the
+    decode layout). Returns the sidecar paths written."""
+    import jax
+    with open(os.path.join(artifact_dir, _DECODE_SIGNATURE)) as f:
+        sig = json.load(f)
+    state_specs = [jax.ShapeDtypeStruct(tuple(e['shape']),
+                                        np.dtype(e['dtype']))
+                   for e in sig['state']]
+
+    def feed_specs(entries):
+        return [jax.ShapeDtypeStruct(tuple(e['shape']), np.dtype(e['dtype']))
+                for e in entries]
+
+    written = [_precompile_decode_dir(
+        os.path.join(artifact_dir, _STEP_DIR), state_specs,
+        [feed_specs(sig['step']['feeds'])], donate=True, platform=platform)]
+    for b in sig['prompt_buckets']:
+        written.append(_precompile_decode_dir(
+            os.path.join(artifact_dir, _PREFILL_DIR % int(b)), state_specs,
+            [feed_specs(sig['prefill'][str(b)]['feeds'])], donate=True,
+            platform=platform))
+    src_spec = jax.ShapeDtypeStruct((int(sig['max_slots']),), np.int32)
+    written.append(_precompile_decode_dir(
+        os.path.join(artifact_dir, _REORDER_DIR), state_specs, [src_spec],
+        donate=False, platform=platform))
+    return written
+
+
+class DecodingPredictor(object):
+    """Token-streaming decode endpoint with continuous in-flight batching
+    over an `export_decode` artifact.
+
+    submit(prompt_ids, ...) -> TokenStream   enqueue one decode request
+    generate(prompt_ids, ...)                submit + wait (synchronous)
+    warmup()                                 compile every program ahead
+                                             of traffic (no-op when AOT
+                                             sidecars loaded)
+    stats.snapshot()                         decode serving metrics (also
+                                             via profiler serving_report)
+    close()                                  stop the scheduler; waiting
+                                             and in-flight requests fail
+                                             with RuntimeError
+
+    `prompt_ids`: 1-D int sequence, 1 <= len <= the largest prompt
+    bucket. `beam=` runs fixed-width beam search (the request occupies
+    `beam` slots); default greedy. Admission is strict FIFO: a beam
+    request at the head waits for enough free slots.
+    """
+
+    def __init__(self, artifact_dir, platform=None, max_queue=None,
+                 default_max_new_tokens=32, stats_window=8192):
+        import jax
+        with open(os.path.join(artifact_dir, _DECODE_SIGNATURE)) as f:
+            self._sig = json.load(f)
+        self._S = int(self._sig['max_slots'])
+        self._T = int(self._sig['max_cache_len'])
+        self._eos = int(self._sig['eos_id'])
+        self._vocab = int(self._sig['vocab'])
+        # sorted once at load: select_bucket prefers the smallest fitting
+        # bucket deterministically (inference/batching.py discipline)
+        self._buckets = sorted(int(b) for b in self._sig['prompt_buckets'])
+        self._default_max_new = int(default_max_new_tokens)
+        self._max_queue = int(max_queue) if max_queue else None
+        platform = platform or os.environ.get('PTPU_PLATFORM')
+        self._device = jax.devices(platform)[0] if platform else None
+        self._step_mod = _DecodeModule(
+            os.path.join(artifact_dir, _STEP_DIR), donate_state=True,
+            device=self._device)
+        self._prefill_mods = {
+            b: _DecodeModule(os.path.join(artifact_dir, _PREFILL_DIR % b),
+                             donate_state=True, device=self._device)
+            for b in self._buckets}
+        self._reorder_mod = _DecodeModule(
+            os.path.join(artifact_dir, _REORDER_DIR), donate_state=False,
+            device=self._device)
+        self._step_feeds = [e['name'] for e in self._sig['step']['feeds']]
+        self._prefill_feeds = {
+            b: [e['name'] for e in self._sig['prefill'][str(b)]['feeds']]
+            for b in self._buckets}
+        self._state = None
+        self._slots = [None] * self._S    # slot -> (request, beam index)
+        self._closed = False
+        self._lifecycle = threading.Lock()
+        self._queue = queue.Queue()
+        self.stats = DecodeStats(stats_window)
+        self._reset_state()
+        self._sched_t = threading.Thread(
+            target=self._sched_loop, name='ptpu-decode-sched', daemon=True)
+        self._sched_t.start()
+        self._profiler_name = None
+        prof = _maybe_profiler()
+        if prof is not None and hasattr(prof, 'register_serving_source'):
+            name = 'decode:%s#%d' % (
+                os.path.basename(os.path.normpath(artifact_dir)),
+                next(_SOURCE_SEQ))
+            prof.register_serving_source(name, self.stats.snapshot)
+            self._profiler_name = name
+
+    # -- public API --------------------------------------------------------
+    @property
+    def max_slots(self):
+        return self._S
+
+    @property
+    def prompt_buckets(self):
+        return list(self._buckets)
+
+    def submit(self, prompt_ids, max_new_tokens=None, beam=None,
+               deadline_ms=None):
+        """Enqueue one decode request; returns a TokenStream. Validation
+        errors fail THIS stream only. With `deadline_ms`, a request still
+        queued — or still DECODING — when the deadline elapses resolves
+        to DeadlineExceeded at the next step boundary and frees its
+        slot(s). Beyond `max_queue` waiting requests, new submissions
+        shed with ServerOverloaded before any device work."""
+        if self._closed:
+            raise RuntimeError('DecodingPredictor is closed')
+        beam = int(beam) if beam else None
+        stream = TokenStream(beam=beam)
+
+        def _shed_locked():
+            return _batching.shed_if_overloaded(
+                self.stats, self._max_queue, stream._fail)
+
+        with self.stats._lock:          # fast-fail before validation work
+            if _shed_locked():
+                return stream
+        try:
+            prompt = np.asarray(prompt_ids, np.int64).reshape(-1).copy()
+            if not prompt.size:
+                raise ValueError('empty prompt')
+            if prompt.size > self._buckets[-1]:
+                raise ValueError(
+                    'prompt of %d tokens exceeds the largest compiled '
+                    'prompt bucket %d' % (prompt.size, self._buckets[-1]))
+            max_new = int(max_new_tokens if max_new_tokens is not None
+                          else self._default_max_new)
+            # cache capacity: the last generated token writes position
+            # len(prompt) + max_new - 2
+            max_new = max(1, min(max_new, self._T - prompt.size + 1))
+            if beam is not None and not 1 <= beam <= self._S:
+                raise ValueError(
+                    'beam width %d not in [1, max_slots=%d]'
+                    % (beam, self._S))
+        except Exception as e:
+            stream._fail(e)
+            return stream
+        req = _Request(prompt, max_new, beam, stream, deadline_ms)
+        with self._lifecycle:
+            if self._closed:
+                raise RuntimeError('DecodingPredictor is closed')
+            with self.stats._lock:
+                if _shed_locked():      # re-check atomically with enqueue
+                    return stream
+                self.stats.queue_depth += 1
+            self._queue.put(req)
+        return stream
+
+    def generate(self, prompt_ids, max_new_tokens=None, beam=None,
+                 deadline_ms=None, timeout=None):
+        """Synchronous single-request decode: submit + wait."""
+        return self.submit(prompt_ids, max_new_tokens=max_new_tokens,
+                           beam=beam, deadline_ms=deadline_ms
+                           ).result(timeout)
+
+    def warmup(self):
+        """Compile every program ahead of traffic (a no-op dispatch per
+        prefill bucket, one decode step, one reorder); state is re-zeroed
+        afterwards. With AOT sidecars loaded this costs three dispatches
+        and zero compiles. Must run BEFORE any submit(): it dispatches on
+        the scheduler's donated state from this thread, so it refuses
+        loudly once traffic has started."""
+        if self.stats.queue_depth or any(s is not None
+                                         for s in self._slots):
+            raise RuntimeError(
+                'warmup() must run before traffic: requests are queued or '
+                'decoding, and a caller-thread dispatch would race the '
+                "scheduler over the donated cache state")
+        for b in self._buckets:
+            self._dispatch_prefill(b, np.zeros((1, b), np.int64), 1, 0)
+        self._dispatch_step(np.zeros((self._S, 1), np.int64),
+                            np.zeros((self._S, 1), np.int32))
+        self._reset_state()
+        return self
+
+    def close(self):
+        """Stop the scheduler thread. Waiting and in-flight requests
+        resolve with RuntimeError. Idempotent; submit() afterwards
+        raises. Also finalizes an endpoint that already closed ITSELF
+        after an unrecoverable dispatch failure (joins the scheduler,
+        unregisters the profiler source)."""
+        with self._lifecycle:
+            if not self._closed:
+                self._closed = True
+                self._queue.put(_STOP)
+        if threading.current_thread() is not self._sched_t:
+            self._sched_t.join()
+        name, self._profiler_name = self._profiler_name, None
+        if name:
+            prof = _maybe_profiler()
+            if prof is not None:
+                prof.unregister_serving_source(name)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- device plumbing ---------------------------------------------------
+    def _dev_ctx(self):
+        import jax
+        import contextlib
+        return (jax.default_device(self._device)
+                if self._device is not None else contextlib.nullcontext())
+
+    def _reset_state(self):
+        """(Re)zero the paged KV cache. The zeros route through the
+        UNDONATED reorder program so every leaf handed to the donated
+        step/prefill executables is an XLA-owned buffer (a reloaded
+        donating executable honors its baked-in aliasing without jax's
+        external-buffer guard — round-8/10 cliff)."""
+        import jax
+        zeros = [np.zeros(tuple(e['shape']), np.dtype(e['dtype']))
+                 for e in self._sig['state']]
+        src = np.arange(self._S, dtype=np.int32)
+        with self._dev_ctx():
+            state = [jax.device_put(z, self._device) for z in zeros]
+            self._state = list(self._reorder_mod.call(state, src))
+
+    def _dispatch_step(self, tokens, pos):
+        feed = {'tokens': tokens, 'pos': pos}
+        args = [feed[n] for n in self._step_feeds]  # signature feed order
+        with self._dev_ctx():
+            fetches, new_state = self._step_mod.call(self._state, args)
+        self._state = list(new_state)
+        with self.stats._lock:
+            self.stats.steps += 1
+        return np.asarray(fetches[0])                      # [S, V] sync
+
+    def _dispatch_prefill(self, bucket, padded, plen, slot):
+        feed = {'prompt_ids': padded,
+                'prompt_len': np.full((1, 1), plen, np.int32),
+                'slot': np.full((1, 1), slot, np.int32)}
+        args = [feed[n] for n in self._prefill_feeds[bucket]]
+        with self._dev_ctx():
+            fetches, new_state = self._prefill_mods[bucket].call(
+                self._state, args)
+        self._state = list(new_state)
+        with self.stats._lock:
+            self.stats.prefills += 1
+        return np.asarray(fetches[0])[0]                   # [V] sync
+
+    def _dispatch_reorder(self, src):
+        with self._dev_ctx():
+            self._state = list(self._reorder_mod.call(
+                self._state, np.asarray(src, np.int32)))
+        with self.stats._lock:
+            self.stats.reorders += 1
+
+    # -- scheduler ---------------------------------------------------------
+    def _active_requests(self):
+        seen = []
+        for entry in self._slots:
+            if entry is not None and entry[0] not in seen:
+                seen.append(entry[0])
+        return seen
+
+    def _free_slots(self):
+        return [i for i, s in enumerate(self._slots) if s is None]
+
+    def _release(self, req):
+        for s in req.slots:
+            self._slots[s] = None
+
+    def _sched_loop(self):
+        waiting = deque()
+        while True:
+            have_work = waiting or any(s is not None for s in self._slots)
+            try:
+                item = self._queue.get(block=not have_work)
+            except queue.Empty:
+                item = None
+            if item is _STOP:
+                self._drain_on_close(waiting)
+                return
+            if item is not None:
+                waiting.append(item)
+                continue  # keep draining submissions before dispatching
+            t0 = time.perf_counter()
+            self._expire(waiting)
+            self._admit(waiting)
+            if any(s is not None for s in self._slots):
+                try:
+                    self._step()
+                except Exception as e:
+                    self._fail_all(e)
+                with self.stats._lock:
+                    self.stats.busy_s += time.perf_counter() - t0
+
+    def _drain_on_close(self, waiting):
+        err = RuntimeError('DecodingPredictor closed')
+        for req in self._active_requests():
+            self._release(req)
+            req.stream._fail(err)
+        for req in waiting:
+            with self.stats._lock:
+                self.stats.queue_depth -= 1
+            req.stream._fail(err)
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if req is not _STOP:
+                with self.stats._lock:
+                    self.stats.queue_depth -= 1
+                req.stream._fail(err)
+
+    def _expire(self, waiting):
+        now = time.perf_counter()
+        # waiting requests: reap expired/cancelled before they cost work
+        alive = deque()
+        for req in waiting:
+            cancelled = req.stream._cancelled
+            if cancelled or (req.deadline is not None
+                             and now > req.deadline):
+                with self.stats._lock:
+                    self.stats.queue_depth -= 1
+                    if not cancelled:
+                        self.stats.expired += 1
+                if cancelled:
+                    req.stream._fail(RuntimeError('request cancelled'))
+                else:
+                    req.stream._fail(DeadlineExceeded(
+                        'request expired after %.1f ms in queue'
+                        % ((now - req.t_submit) * 1e3)))
+            else:
+                alive.append(req)
+        waiting.clear()
+        waiting.extend(alive)
+        # ACTIVE requests: deadline expiry mid-decode frees the slot(s)
+        # at this step boundary (the satellite contract)
+        for req in self._active_requests():
+            if req.stream._cancelled or (req.deadline is not None
+                                         and now > req.deadline):
+                self._release(req)
+                if req.stream._cancelled:
+                    req.stream._fail(RuntimeError('request cancelled'))
+                else:
+                    with self.stats._lock:
+                        self.stats.expired += 1
+                    req.stream._fail(DeadlineExceeded(
+                        'deadline elapsed mid-decode after %d token(s); '
+                        'slot freed' % req.produced))
+
+    def _admit(self, waiting):
+        """Strict-FIFO admission at the step boundary: one prefill
+        dispatch per admitted request; beam requests wait for enough
+        free slots."""
+        while waiting:
+            req = waiting[0]
+            need = req.beam or 1
+            free = self._free_slots()
+            if len(free) < need:
+                return
+            waiting.popleft()
+            with self.stats._lock:
+                self.stats.queue_depth -= 1
+            req.slots = free[:need]
+            try:
+                self._prefill(req)
+            except Exception as e:
+                # the donated prefill dispatch may have consumed the
+                # state even though it raised: this is the same hazard
+                # as a step failure, so recover the same way (fail the
+                # co-resident requests loudly, rebuild zero state)
+                self._release(req)
+                req.stream._fail(e)
+                self._fail_all(e)
+                return
+
+    def _prefill(self, req):
+        plen = int(req.prompt.size)
+        bucket = select_bucket(self._buckets, plen)
+        padded = np.zeros((1, bucket), np.int64)
+        padded[0, :plen] = req.prompt
+        logits = self._dispatch_prefill(bucket, padded, plen, req.slots[0])
+        now = time.perf_counter()
+        for i, s in enumerate(req.slots):
+            self._slots[s] = (req, i)
+        if req.beam is None:
+            tok = int(np.argmax(logits))
+            req.last_tokens = [tok]
+            req.tokens = [tok]
+            req.produced = 1
+            self._record_emit(req, now)
+            req.stream._push(tok)
+            if tok == self._eos or req.produced >= req.max_new:
+                self._finish_greedy(req)
+            return
+        # beam: replicate slot 0's cache to the other beam slots, then
+        # seed the W beams with the top-W DISTINCT first tokens (the
+        # standard first-expansion; a naive W*V step over identical
+        # beams would collapse onto one token)
+        if len(req.slots) > 1:
+            src = np.arange(self._S, dtype=np.int32)
+            for s in req.slots[1:]:
+                src[s] = req.slots[0]
+            self._dispatch_reorder(src)
+        lp = _log_softmax(logits)
+        order = np.argsort(-lp, kind='stable')[:req.beam]
+        req.last_tokens = [int(t) for t in order]
+        req.scores = [float(lp[t]) for t in order]
+        req.finished = [int(t) == self._eos for t in order]
+        req.hyps = [[int(t)] for t in order]
+        req.produced = 1
+        self._record_emit(req, now, count=req.beam)
+        if all(req.finished) or req.produced >= req.max_new:
+            self._finish_beam(req)
+
+    def _record_emit(self, req, now, count=1):
+        with self.stats._lock:
+            self.stats.tokens += count
+            if req.t_first is None:
+                req.t_first = now
+                self.stats._ttft.append(now - req.t_submit)
+            else:
+                self.stats._itl.append(now - req.t_last)
+        req.t_last = now
+
+    def _finish_greedy(self, req):
+        self._release(req)
+        with self.stats._lock:
+            self.stats.requests += 1
+        req.stream._finish(list(req.tokens))
+
+    def _finish_beam(self, req):
+        self._release(req)
+        with self.stats._lock:
+            self.stats.requests += 1
+        ids = np.asarray(req.hyps, np.int64)
+        scores = np.asarray(req.scores, np.float64)
+        req.stream._finish((ids, scores))
+
+    def _step(self):
+        """One iteration of the continuous batch: every active slot
+        advances one token through ONE fixed-shape dispatch."""
+        tokens = np.zeros((self._S, 1), np.int64)
+        pos = np.zeros((self._S, 1), np.int32)
+        active = 0
+        for s, entry in enumerate(self._slots):
+            if entry is None:
+                continue
+            req, bi = entry
+            active += 1
+            tokens[s, 0] = req.last_tokens[bi]
+            # this token writes at position len(prompt) + produced - 1
+            pos[s, 0] = req.prompt.size + req.produced - 1
+        with self.stats._lock:
+            self.stats.active_slot_steps += active
+            self.stats.slot_steps += self._S
+        logits = self._dispatch_step(tokens, pos)
+        now = time.perf_counter()
+        src = np.arange(self._S, dtype=np.int32)
+        for req in self._active_requests():
+            if req.beam is None:
+                tok = int(np.argmax(logits[req.slots[0]]))
+                req.last_tokens[0] = tok
+                req.tokens.append(tok)
+                req.produced += 1
+                self._record_emit(req, now)
+                req.stream._push(tok)
+                if tok == self._eos or req.produced >= req.max_new:
+                    self._finish_greedy(req)
+                continue
+            # fixed-width beam: finished beams contribute one frozen
+            # eos candidate (ops/decode_ops.py beam_search discipline)
+            W, V = req.beam, self._vocab
+            cand = np.full((W, V), -np.inf, np.float64)
+            for i in range(W):
+                if req.finished[i]:
+                    cand[i, self._eos] = req.scores[i]
+                else:
+                    cand[i] = req.scores[i] + _log_softmax(
+                        logits[req.slots[i]])
+            order = np.argsort(-cand, axis=None, kind='stable')[:W]
+            parents = order // V
+            toks = order % V
+            req.scores = [float(cand[p, t]) for p, t in zip(parents, toks)]
+            req.hyps = [req.hyps[p] + [int(t)]
+                        for p, t in zip(parents, toks)]
+            req.finished = [req.finished[p] or int(t) == self._eos
+                            for p, t in zip(parents, toks)]
+            req.last_tokens = [int(t) for t in toks]
+            for i in range(W):
+                src[req.slots[i]] = req.slots[parents[i]]
+            req.produced += 1
+            self._record_emit(req, now, count=W)
+            if all(req.finished) or req.produced >= req.max_new:
+                self._finish_beam(req)
+                for s in req.slots:   # a finished group never reorders
+                    src[s] = s
+        if not np.array_equal(src, np.arange(self._S, dtype=np.int32)):
+            # one slot-gather for every surviving beam group: each beam's
+            # cache follows its parent before the next step writes
+            self._dispatch_reorder(src)
+
+    def _fail_all(self, exc):
+        """A dispatch failure mid-step may have consumed the donated
+        state: fail every in-flight request loudly and rebuild a clean
+        zero state so the endpoint keeps serving. If even the rebuild
+        dispatch fails (wedged backend), the endpoint closes itself —
+        queued and future requests fail fast instead of hanging on a
+        dead scheduler."""
+        for req in self._active_requests():
+            self._release(req)
+            req.stream._fail(exc)
+        try:
+            self._reset_state()
+        except Exception as e:
+            warnings.warn(
+                'DecodingPredictor: state rebuild after a dispatch '
+                'failure itself failed (%s: %s) — closing the endpoint'
+                % (type(e).__name__, e), RuntimeWarning)
+            # runs ON the scheduler thread: close() skips the self-join
+            # and unregisters the profiler source; the loop drains the
+            # queued requests when it sees _STOP
+            self.close()
+
+
+def load_decoding(artifact_dir, **kwargs):
+    return DecodingPredictor(artifact_dir, **kwargs)

@@ -193,6 +193,198 @@ def export_compiled(predictor, sample_inputs, out_dir, batch_sizes=None,
     return out_dir
 
 
+def export_decode(spec, out_dir, scope=None, precompile=None):
+    """Export a TWO-PROGRAM continuous-decode serving artifact (ISSUE 8).
+
+    `spec` is the dict a decode model builder produces (e.g.
+    models/transformer.build_decode_spec):
+
+      startup      Program that initializes every shared parameter and
+                   zeroes the KV cache state — run it in `scope` BEFORE
+                   exporting.
+      step         {'program', 'feeds', 'samples', 'fetches'}: the
+                   decode-step program. Feeds must be named exactly
+                   'tokens' [max_slots, 1] int64 and 'pos'
+                   [max_slots, 1] int32; fetch 0 is the per-slot logits
+                   [max_slots, vocab].
+      prefill      {bucket_len: {...}}: one prefill program per prompt-
+                   length bucket. Feeds must be named 'prompt_ids'
+                   [1, bucket] int64, 'prompt_len' [1, 1] int32, 'slot'
+                   [1, 1] int32; fetch 0 is the last-real-position
+                   logits [1, vocab].
+      cache_vars   persistable KV-cache state vars present in every
+                   program ([max_slots, max_cache_len, ...]).
+      max_slots / max_cache_len / eos_id / vocab.
+
+    Every program is traced ONCE as fn(state, feeds) -> (fetches,
+    new_state): parameters bake in as constants, the cache state threads
+    through as donated inputs/outputs. The artifact also carries a
+    REORDER program (state gathered by a per-slot source index — beam
+    reordering, cache replication, and the serving tier's owned-buffer
+    init boundary) and per-program AOT warm-start sidecars, the step and
+    prefill tiers compiled WITH state donation (the paged cache updates
+    in place; the loader passes only XLA-owned buffers, the executor's
+    round-10 ownership discipline).
+
+    Artifact layout (out_dir/):
+      decode_signature.json   shapes, buckets, state specs, eos/vocab
+      decode_step/            module.jaxexport (+ aot_<platform>.jaxexec)
+      prefill_<bucket>/       one per prompt bucket
+      decode_reorder/         slot-gather program (undonated)
+
+    Load with inference/decoding.py DecodingPredictor (framework-free).
+    Returns out_dir.
+    """
+    import jax
+    from .. import global_scope
+    from . import decoding as _decoding
+
+    scope = scope if scope is not None else global_scope()
+    state_names = list(spec['cache_vars'])
+    state0 = []
+    for n in state_names:
+        val = scope.get(n)
+        if val is None:
+            raise ValueError(
+                "cache var %r has no value in the scope — run the spec's "
+                "startup program before export_decode" % n)
+        state0.append(np.asarray(val))
+    step = spec['step']
+    if sorted(step['feeds']) != ['pos', 'tokens']:
+        raise ValueError("decode-step feeds must be ['tokens', 'pos'], "
+                         "got %r" % (step['feeds'],))
+    buckets = sorted(int(b) for b in spec['prefill'])
+    if not buckets:
+        raise ValueError("export_decode needs at least one prompt bucket")
+    os.makedirs(out_dir, exist_ok=True)
+
+    step_feeds = _export_decode_program(
+        step, state_names, state0, scope,
+        os.path.join(out_dir, _decoding._STEP_DIR))
+    prefill_sig = {}
+    for L in buckets:
+        p = spec['prefill'][L]
+        if sorted(p['feeds']) != ['prompt_ids', 'prompt_len', 'slot']:
+            raise ValueError(
+                "prefill feeds must be ['prompt_ids', 'prompt_len', "
+                "'slot'], got %r" % (p['feeds'],))
+        prefill_sig[str(L)] = {
+            'feeds': _export_decode_program(
+                p, state_names, state0, scope,
+                os.path.join(out_dir, _decoding._PREFILL_DIR % L)),
+            'fetches': list(p['fetches'])}
+    _export_decode_reorder(state0, int(spec['max_slots']),
+                           os.path.join(out_dir, _decoding._REORDER_DIR))
+
+    sig = {'version': 1, 'kind': 'decode',
+           'max_slots': int(spec['max_slots']),
+           'max_cache_len': int(spec['max_cache_len']),
+           'eos_id': int(spec['eos_id']), 'vocab': int(spec['vocab']),
+           'prompt_buckets': buckets,
+           'state': [{'name': n, 'shape': list(a.shape),
+                      'dtype': a.dtype.name}
+                     for n, a in zip(state_names, state0)],
+           'step': {'feeds': step_feeds, 'fetches': list(step['fetches'])},
+           'prefill': prefill_sig}
+    with open(os.path.join(out_dir, _decoding._DECODE_SIGNATURE), 'w') as f:
+        json.dump(sig, f, indent=1)
+    if _should_precompile(precompile):
+        import warnings
+        try:
+            _decoding.precompile_decode_artifact(out_dir)
+        except Exception as e:
+            warnings.warn(
+                'export_decode: could not precompile warm-start sidecars '
+                'for %s (%s: %s); the artifact still serves through the '
+                'normal compile path' % (out_dir, type(e).__name__, e),
+                RuntimeWarning)
+    return out_dir
+
+
+def _export_decode_program(entry, state_names, state0, scope, out_dir):
+    """Trace one decode program as fn(state, feeds) -> (fetches,
+    new_state) — export_train_step's state-threading convention minus
+    the rng (decode programs draw no randomness) — and serialize it.
+    Returns the feed signature entries."""
+    import jax
+    from jax import export as jexport
+    from ..core.lowering import Tracer
+    from ..core.lod import LoDArray
+    from .. import passes
+
+    program = entry['program']
+    feed_names = list(entry['feeds'])
+    fetch_names = list(entry['fetches'])
+    samples = {n: np.asarray(entry['samples'][n]) for n in feed_names}
+    state_set = set(state_names)
+    try:
+        # liveness roots include the cache state: its in-place writes are
+        # program outputs even though they are not fetched
+        program, _ = passes.apply_inference_pipeline(
+            program, fetch_names=fetch_names + list(state_names),
+            feed_names=feed_names)
+    except passes.ProgramVerifyError:
+        raise
+    except Exception as e:
+        import warnings
+        warnings.warn(
+            "export_decode optimization pipeline failed (%s: %s); "
+            "exporting the unoptimized program" % (type(e).__name__, e),
+            RuntimeWarning)
+        program = entry['program']
+
+    baked = {}
+    for v in program.list_vars():
+        if v.persistable and v.name not in state_set:
+            val = scope.get(v.name)
+            if val is not None:
+                baked[v.name] = np.asarray(
+                    val.data if isinstance(val, LoDArray) else val)
+    rng = jax.random.key(0)  # decode programs draw no randomness
+
+    def fn(state_list, feed_list):
+        tracer = Tracer(program, rng)
+        tracer.env.update(baked)
+        tracer.env.update(dict(zip(state_names, state_list)))
+        tracer.env.update(dict(zip(feed_names, feed_list)))
+        tracer.run_block(program.global_block())
+        return ([tracer.env[n] for n in fetch_names],
+                [tracer.env[n] for n in state_names])
+
+    state_specs = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in state0]
+    feed_specs = [jax.ShapeDtypeStruct(samples[n].shape, samples[n].dtype)
+                  for n in feed_names]
+    exp = jexport.export(jax.jit(fn), platforms=['cpu', 'tpu'])(
+        state_specs, feed_specs)
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, _MODULE), 'wb') as f:
+        f.write(exp.serialize())
+    return [{'name': n, 'shape': list(samples[n].shape),
+             'dtype': samples[n].dtype.name} for n in feed_names]
+
+
+def _export_decode_reorder(state0, max_slots, out_dir):
+    """Serialize the slot-gather program: new_state[i] = state[i][src]
+    per cache var (src [max_slots] int32). Pure structural jax — no
+    Program IR needed. Undonated by design: besides beam reordering, the
+    serving tier routes freshly loaded state through it once so every
+    buffer reaching the DONATED step/prefill executables is XLA-owned."""
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jexport
+
+    def fn(state_list, src):
+        return [jnp.take(s, src, axis=0) for s in state_list]
+
+    state_specs = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in state0]
+    src_spec = jax.ShapeDtypeStruct((max_slots,), np.int32)
+    exp = jexport.export(jax.jit(fn), platforms=['cpu', 'tpu'])(
+        state_specs, src_spec)
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, _MODULE), 'wb') as f:
+        f.write(exp.serialize())
+
+
 def _optimize_for_export(predictor):
     """Run the optimization pass pipeline (paddle_tpu/passes/) on the
     predictor's program before lowering: constant chains fold, dead

@@ -213,16 +213,34 @@ def _precompile_train_dir(d, platform=None):
                      _module_sha(module_bytes))
 
 
+def _decoding_module():
+    """Sibling decoding.py (the continuous-decode tier), importable both
+    as a package module and by file path (this module's own contract)."""
+    try:
+        from . import decoding
+    except ImportError:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import decoding
+    return decoding
+
+
 def precompile_artifact(artifact_dir, platform=None):
     """Prewarm a serving artifact: AOT-compile EVERY bucket's module (and
     the train module when present) for this process's platform, writing
     warm-start sidecars — a replica that loads the artifact afterwards
     performs zero traces and zero XLA compiles before its first answer.
-    The engine behind `tools/cache_ctl.py prewarm`. Returns the sidecar
-    paths written."""
+    Continuous-decode artifacts (export_decode's two-program layout)
+    prewarm BOTH tiers: every prompt-length prefill bucket plus the
+    decode-step and reorder programs. The engine behind
+    `tools/cache_ctl.py prewarm`. Returns the sidecar paths written."""
     import shutil
     written = []
     plat = platform or _aot_platform()
+    decoding = _decoding_module()
+    if os.path.exists(os.path.join(artifact_dir,
+                                   decoding._DECODE_SIGNATURE)):
+        written.extend(decoding.precompile_decode_artifact(
+            artifact_dir, platform=plat))
     sig_p = os.path.join(artifact_dir, _SIGNATURE)
     if os.path.exists(sig_p):
         with open(sig_p) as f:
@@ -899,11 +917,59 @@ def _loop_cli(argv):
     return 0
 
 
+def _decode_cli(argv):
+    # serve.py decode ARTIFACT_DIR PROMPTS.npz OUT.npz [MAX_NEW [BEAM]]
+    # PROMPTS.npz: 'prompts' [N, L] int64 (0-padded) + optional 'lens'
+    # [N]. Greedy (default) writes OUT.npz 'tokens' [N, max_new] padded
+    # with -1 after each transcript plus 'n_tokens' [N]; with BEAM, the
+    # best hypothesis per request plus 'scores' [N]. Every request runs
+    # through the continuous-batching scheduler — submit all, then wait.
+    if len(argv) not in (5, 6, 7):
+        print("usage: serve.py decode ARTIFACT_DIR PROMPTS.npz OUT.npz "
+              "[MAX_NEW [BEAM]]", file=sys.stderr)
+        return 2
+    artifact_dir, in_path, out_path = argv[2:5]
+    max_new = int(argv[5]) if len(argv) >= 6 else 32
+    beam = int(argv[6]) if len(argv) == 7 else None
+    decoding = _decoding_module()
+    with np.load(in_path) as z:
+        prompts = np.asarray(z['prompts'], np.int64)
+        lens = (np.asarray(z['lens'], np.int64) if 'lens' in z.files
+                else np.full(prompts.shape[0], prompts.shape[1], np.int64))
+    with decoding.DecodingPredictor(artifact_dir) as pred:
+        streams = [pred.submit(prompts[i, :lens[i]], max_new_tokens=max_new,
+                               beam=beam) for i in range(prompts.shape[0])]
+        results = [s.result() for s in streams]
+        snap = pred.stats.snapshot()
+    toks = np.full((len(results), max_new), -1, np.int64)
+    n_tok = np.zeros(len(results), np.int64)
+    scores = np.zeros(len(results), np.float64)
+    for i, r in enumerate(results):
+        ids = r[0][0] if beam else np.asarray(r, np.int64)
+        if beam:
+            scores[i] = r[1][0]
+        n_tok[i] = len(ids)
+        toks[i, :len(ids)] = ids
+    save = {'tokens': toks, 'n_tokens': n_tok}
+    if beam:
+        save['scores'] = scores
+    np.savez(out_path, **save)
+    print(json.dumps({'requests': len(results),
+                      'tokens': int(snap['tokens']),
+                      'tokens_s': snap['tokens_s'],
+                      'occupancy': snap['occupancy'],
+                      'ttft_p50_ms': snap['ttft_p50_ms'],
+                      'ttft_p99_ms': snap['ttft_p99_ms']}))
+    return 0
+
+
 def main(argv):
     if len(argv) >= 2 and argv[1] == 'bench':
         return _bench_cli(argv)
     if len(argv) >= 2 and argv[1] == 'loop':
         return _loop_cli(argv)
+    if len(argv) >= 2 and argv[1] == 'decode':
+        return _decode_cli(argv)
     if len(argv) >= 2 and argv[1] == 'train':
         # serve.py train ARTIFACT_DIR FEEDS.npz OUT.npz STEPS [CKPT.npz]
         # runs STEPS train steps on the (fixed) feeds; OUT.npz holds each
@@ -929,7 +995,9 @@ def main(argv):
               "       serve.py train ARTIFACT_DIR FEEDS.npz OUT.npz STEPS "
               "[CKPT.npz]\n"
               "       serve.py bench ARTIFACT_DIR IN.npz N_REQUESTS "
-              "[TIMEOUT_MS]", file=sys.stderr)
+              "[TIMEOUT_MS]\n"
+              "       serve.py decode ARTIFACT_DIR PROMPTS.npz OUT.npz "
+              "[MAX_NEW [BEAM]]", file=sys.stderr)
         return 2
     artifact_dir, in_path, out_path = argv[1:]
     pred = CompiledPredictor(artifact_dir)

@@ -1570,6 +1570,53 @@ def pipelined_ffn_stack(input, num_layers, d_ff, num_microbatches=0,
     return out
 
 
+def kv_cache_write(cache, kv, pos):
+    """Continuous-decode primitive: write this step's K or V rows
+    [max_slots, d] into the persistable slot-paged `cache`
+    [max_slots, max_cache_len, d] at each slot's `pos` (int32
+    [max_slots] or [max_slots, 1]). Updates `cache` IN PLACE (output
+    aliases the input var, the optimizer ParamOut==Param discipline) and
+    returns it, so downstream kv_cache_attention reads the post-write
+    binding. Serving-only (no gradient)."""
+    helper = LayerHelper('kv_cache_write')
+    helper.append_op(type='kv_cache_write',
+                     inputs={'Cache': cache, 'KV': kv, 'Pos': pos},
+                     outputs={'Out': cache}, attrs={})
+    return cache
+
+
+def kv_cache_prefill_write(cache, kv, slot):
+    """Continuous-decode primitive: write a whole prompt's K or V rows
+    [1, bucket_len, d] into ONE slot of the paged `cache`
+    [max_slots, max_cache_len, d] (int32 `slot`, shape [1] or [1, 1]).
+    In-place on `cache`, like kv_cache_write."""
+    helper = LayerHelper('kv_cache_prefill_write')
+    helper.append_op(type='kv_cache_prefill_write',
+                     inputs={'Cache': cache, 'KV': kv, 'Slot': slot},
+                     outputs={'Out': cache}, attrs={})
+    return cache
+
+
+def kv_cache_attention(query, k_cache, v_cache, pos, n_head, scale=None):
+    """One-token-per-slot attention over the slot-paged KV cache:
+    `query` [max_slots, d] attends rows j <= pos of its own slot in
+    k_cache/v_cache [max_slots, max_cache_len, d]; heads split inside
+    the op. Returns the merged context [max_slots, d]. Masked rows get
+    exactly-zero softmax weight, so inactive/stale slots never perturb
+    active ones (the continuous-batching bit-identity contract;
+    ops/decode_ops.py)."""
+    helper = LayerHelper('kv_cache_attention')
+    out = helper.create_variable_for_type_inference(query.dtype)
+    helper.append_op(type='kv_cache_attention',
+                     inputs={'Q': query, 'KCache': k_cache,
+                             'VCache': v_cache, 'Pos': pos},
+                     outputs={'Out': out},
+                     attrs={'n_head': int(n_head),
+                            'scale': float(scale or 0.0)})
+    out.stop_gradient = True
+    return out
+
+
 def fused_multihead_attention(q, k, v, causal=False, scale=1.0,
                               sequence_parallel=False, name=None):
     """Fused [B, H, S, D] attention: Pallas flash attention on TPU where

@@ -419,6 +419,77 @@ def _chunk_eval(ctx, ins):
 
 
 # ---------------------------------------------------------------------------
+# KV-cache decode steps (continuous in-flight batching, ISSUE 8)
+#
+# The decode-serving tier (inference/decoding.py) runs autoregressive
+# models as TWO fixed-shape programs over a preallocated slot-paged KV
+# cache [max_slots, max_cache_len, d_model] held as persistable state:
+# a bucketed PREFILL program writes a whole prompt's K/V rows into one
+# slot, and a DECODE-STEP program advances every slot by one token.
+# These ops are the cache-aware attention primitives both programs use.
+# Per-slot math never mixes rows, so a slot's outputs are bit-identical
+# regardless of which other requests co-reside in the batch — the
+# continuous-batching determinism contract.
+# ---------------------------------------------------------------------------
+
+@register('kv_cache_write', no_grad=True, lod='none')
+def _kv_cache_write(ctx, ins):
+    """Write one decode step's K or V row into the slot-paged cache:
+    Cache [S, T, D], KV [S, D], Pos [S] int32 (each slot's write
+    position). Out aliases Cache (in-place update of the persistable
+    buffer, the sgd ParamOut==Param discipline)."""
+    cache = ins['Cache'][0]
+    kv = ins['KV'][0]
+    pos = ins['Pos'][0].reshape(-1).astype(jnp.int32)
+
+    def upd(c, k, p):
+        return jax.lax.dynamic_update_slice(c, k[None, :], (p, 0))
+
+    return {'Out': [jax.vmap(upd)(cache, kv.astype(cache.dtype), pos)]}
+
+
+@register('kv_cache_prefill_write', no_grad=True, lod='none')
+def _kv_cache_prefill_write(ctx, ins):
+    """Write a whole prompt's K/V rows into ONE slot of the paged cache:
+    Cache [S, T, D], KV [1, L, D] (prefill batch is one request), Slot
+    [1] int32. Rows beyond the true prompt length carry pad garbage;
+    the decode step overwrites position p before any step attends it
+    (mask j <= pos), so stale rows are never read."""
+    cache = ins['Cache'][0]
+    kv = ins['KV'][0]
+    slot = ins['Slot'][0].reshape(-1).astype(jnp.int32)[0]
+    return {'Out': [jax.lax.dynamic_update_slice(
+        cache, kv.astype(cache.dtype), (slot, 0, 0))]}
+
+
+@register('kv_cache_attention', no_grad=True, lod='none')
+def _kv_cache_attention(ctx, ins):
+    """One-token-per-slot attention over the paged cache: Q [S, D],
+    KCache/VCache [S, T, D], Pos [S] int32. Each slot attends its own
+    cache rows j <= pos (already written this step), heads split
+    inside the op (attr n_head); masked rows get exactly-zero weight
+    (-inf before softmax), so stale finite cache garbage in masked or
+    foreign rows can never perturb an active slot's output."""
+    q = ins['Q'][0]
+    kc = ins['KCache'][0]
+    vc = ins['VCache'][0]
+    pos = ins['Pos'][0].reshape(-1).astype(jnp.int32)
+    n_head = int(ctx.attr('n_head', 1))
+    s, t, d = kc.shape
+    dh = d // n_head
+    scale = float(ctx.attr('scale', 0.0) or 0.0) or dh ** -0.5
+    qh = q.reshape(s, n_head, dh)
+    kh = kc.reshape(s, t, n_head, dh)
+    vh = vc.reshape(s, t, n_head, dh)
+    scores = jnp.einsum('shd,sthd->sht', qh, kh) * scale
+    valid = jnp.arange(t, dtype=jnp.int32)[None, :] <= pos[:, None]
+    scores = jnp.where(valid[:, None, :], scores, -jnp.inf)
+    w = jax.nn.softmax(scores, axis=-1)
+    ctxv = jnp.einsum('sht,sthd->shd', w, vh)
+    return {'Out': [ctxv.reshape(s, d).astype(q.dtype)]}
+
+
+# ---------------------------------------------------------------------------
 # beam search (fixed-width; see module docstring)
 # ---------------------------------------------------------------------------
 

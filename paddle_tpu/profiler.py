@@ -134,16 +134,24 @@ def unregister_serving_source(name):
 
 def serving_report():
     """Print serving metrics for every registered source and return them
-    as {source name: snapshot dict}."""
+    as {source name: snapshot dict}. Decode-serving sources (snapshots
+    with kind='decode': inference/decoding.DecodingPredictor) render in
+    their own table — tokens/s, slot occupancy, prefill/decode dispatch
+    split, TTFT and inter-token latency percentiles — next to the
+    request-batching table."""
     out = {}
     rows = []
+    decode_rows = []
     for name in sorted(_serving_sources):
         try:
             snap = _serving_sources[name]()
         except Exception:
             continue  # a closing batcher must not break the report
         out[name] = snap
-        rows.append((name, snap))
+        if snap.get('kind') == 'decode':
+            decode_rows.append((name, snap))
+        else:
+            rows.append((name, snap))
     if rows:
         print("%-32s %6s %8s %8s %5s %7s %7s %9s %9s %9s" %
               ('Serving source', 'queue', 'requests', 'batches', 'occ',
@@ -155,6 +163,21 @@ def serving_report():
                    s.get('occupancy', 0.0), s.get('shed', 0),
                    s.get('expired', 0), s.get('p50_ms', 0.0),
                    s.get('p95_ms', 0.0), s.get('p99_ms', 0.0)))
+    if decode_rows:
+        print("%-26s %5s %6s %7s %8s %8s %6s %5s %5s %10s %10s %9s %9s" %
+              ('Decode source', 'queue', 'reqs', 'tokens', 'tok/s',
+               'prefills', 'steps', 'occ', 'shed',
+               'ttftp50(ms)', 'ttftp99(ms)', 'itlp50(ms)', 'itlp99(ms)'))
+        for name, s in decode_rows:
+            print("%-26s %5d %6d %7d %8.1f %8d %6d %5.2f %5d %10.2f "
+                  "%10.2f %9.2f %9.2f" %
+                  (name[:26], s.get('queue_depth', 0),
+                   s.get('requests', 0), s.get('tokens', 0),
+                   s.get('tokens_s', 0.0), s.get('prefills', 0),
+                   s.get('steps', 0), s.get('occupancy', 0.0),
+                   s.get('shed', 0) + s.get('expired', 0),
+                   s.get('ttft_p50_ms', 0.0), s.get('ttft_p99_ms', 0.0),
+                   s.get('itl_p50_ms', 0.0), s.get('itl_p99_ms', 0.0)))
     return out
 
 

@@ -50,6 +50,9 @@ PTPU_PLATFORM=cpu python bench.py
 echo "== serving bench smoke (serve.py bench on a tiny artifact) =="
 python scripts/serve_bench_smoke.py
 
+echo "== decode serving smoke (continuous in-flight batching: Poisson A/B >=3x tokens/s vs sequential decode, bit-identical transcripts, 0-compile warm replica) =="
+JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/decode_serve_smoke.py
+
 echo "== tpu smoke tier (when a real chip is visible) =="
 if env -u JAX_PLATFORMS -u PTPU_PLATFORM -u XLA_FLAGS python - <<'EOF'
 import sys

@@ -155,6 +155,50 @@ def test_partial_batch_row_dependent_fetch_errors_loudly(tmp_path):
 
 # -- batcher core ------------------------------------------------------------
 
+def test_select_bucket_unsorted_prefers_smallest_fit():
+    """Regression (ISSUE 8 satellite): with an UNSORTED bucket list the
+    old prefix walk returned the first fit, not the smallest — a
+    hand-edited signature once routed 2-row batches to the 128 bucket.
+    select_bucket is now order-independent; loaders still sort once at
+    load so the common path stays a prefix walk."""
+    import random
+    buckets = [1, 8, 32, 128]
+    for seed in range(6):
+        shuffled = list(buckets)
+        random.Random(seed).shuffle(shuffled)
+        for rows, want in ((1, 1), (2, 8), (8, 8), (9, 32), (33, 128),
+                           (128, 128)):
+            assert select_bucket(shuffled, rows) == want, shuffled
+    with pytest.raises(ValueError):
+        select_bucket([128, 1, 32, 8], 129)
+
+
+def test_batcher_routes_through_smallest_bucket_with_shuffled_sig(
+        artifacts):
+    """A signature whose bucket list is NOT sorted ascending (hand-edited
+    or produced by an older exporter) still routes each batch to the
+    smallest fitting bucket: the predictor sorts once at load."""
+    import shutil
+    shuffled_dir = artifacts['multi'] + '_shuffled'
+    if not os.path.isdir(shuffled_dir):
+        shutil.copytree(artifacts['multi'], shuffled_dir)
+        sig_path = os.path.join(shuffled_dir, 'signature.json')
+        with open(sig_path) as f:
+            sig = json.load(f)
+        sig['buckets'] = [32, 1, 8]
+        with open(sig_path, 'w') as f:
+            json.dump(sig, f)
+    b = BatchingPredictor(shuffled_dir, batch_timeout_ms=1.0)
+    try:
+        assert b.buckets == [1, 8, 32]
+        b.run([_x(77, 2)])
+        snap = b.stats.snapshot()
+        # 2 rows padded into the 8-bucket (occupancy 2/8), never 32
+        assert snap['occupancy'] == pytest.approx(0.25)
+    finally:
+        b.close()
+
+
 def test_select_bucket_boundaries():
     buckets = [1, 8, 32]
     assert select_bucket(buckets, 1) == 1

@@ -13,9 +13,19 @@ PTPU_COMPILE_CACHE_MAX_MB), or clears everything with --all.
 (and its train module, when present) for this host's platform and writes
 warm-start sidecars — run it on a new replica image ahead of first
 traffic, and CompiledPredictor/BatchingPredictor/CompiledTrainer load
-with zero traces and zero XLA compiles.
+with zero traces and zero XLA compiles. Continuous-decode artifacts
+(export_decode's two-program layout, decode_signature.json) prewarm BOTH
+tiers: every prompt-length prefill bucket plus the decode-step and
+reorder programs, so DecodingPredictor replicas answer their first token
+with zero compiles.
 
-Exit codes: 0 success, 1 operation failed, 2 usage error.
+Exit codes (all subcommands, including the decode prewarm path):
+  0  success (prewarm: at least one sidecar written)
+  1  operation failed (compile error, unreadable module, no sidecar
+     written)
+  2  usage error (unknown subcommand, missing/non-artifact directory —
+     a dir carrying none of decode_signature.json / signature.json /
+     train_module.jaxexport)
 """
 from __future__ import annotations
 
@@ -76,13 +86,17 @@ def _cmd_prewarm(args):
     # serve.py owns the artifact AOT contract; import it directly so
     # prewarm works on a serving host that carries only the deploy half
     from paddle_tpu.inference import serve
+    decoding = serve._decoding_module()
     has_infer = os.path.exists(os.path.join(args.artifact,
                                             serve._SIGNATURE))
     has_train = os.path.exists(os.path.join(args.artifact,
                                             serve._TRAIN_MODULE))
-    if not has_infer and not has_train:
-        print('prewarm: %s carries no exported module (missing %s / %s)'
-              % (args.artifact, serve._SIGNATURE, serve._TRAIN_MODULE),
+    has_decode = os.path.exists(os.path.join(args.artifact,
+                                             decoding._DECODE_SIGNATURE))
+    if not has_infer and not has_train and not has_decode:
+        print('prewarm: %s carries no exported module (missing %s / %s '
+              '/ %s)' % (args.artifact, serve._SIGNATURE,
+                         serve._TRAIN_MODULE, decoding._DECODE_SIGNATURE),
               file=sys.stderr)
         return 2
     t0 = time.perf_counter()
