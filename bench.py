@@ -1080,6 +1080,56 @@ def bench_ocr_multistep():
         exe, main_p, feed, avg_cost, _device_k(k)))
 
 
+def bench_data_plane():
+    """Feeder saturation (ISSUE 9 acceptance): serial vs pooled decode
+    throughput on the synthetic image pipeline (dataset/synthetic.py —
+    zlib+numpy decode plus a modeled remote-fetch latency), SAME shards
+    and SAME decode fn in both arms, delivery bit-identical (digest
+    compared). value = pooled samples/s; vs_baseline = pooled/serial,
+    the >=3x acceptance ratio. Host-only: no device work — this measures
+    the data plane that has to hit ~320k img/s for a v5p-128 ResNet pod
+    (ROADMAP item 5). Scale PTPU_BENCH_DP_WORKERS to host cores."""
+    import hashlib
+    import tempfile
+    from paddle_tpu.dataset import synthetic
+    from paddle_tpu.reader.sharded import ShardedFileReader
+
+    shards = int(os.environ.get('PTPU_BENCH_DP_SHARDS', '4'))
+    per = int(os.environ.get('PTPU_BENCH_DP_SAMPLES', '256'))
+    workers = int(os.environ.get('PTPU_BENCH_DP_WORKERS',
+                                 str(max(8, os.cpu_count() or 8))))
+    mode = os.environ.get('PTPU_BENCH_DP_MODE', 'thread')
+    lat_ms = float(os.environ.get('PTPU_BENCH_DP_LATENCY_MS', '3.0'))
+
+    tmp = tempfile.mkdtemp(prefix='ptpu_bench_dp_')
+    files = synthetic.write_shards(tmp, num_shards=shards,
+                                   samples_per_shard=per, seed=11)
+    decode = synthetic.make_decode_fn(latency_s=lat_ms * 1e-3)
+
+    def drain(it):
+        h = hashlib.sha256()
+        n = 0
+        t0 = time.perf_counter()
+        for img, label in it:
+            h.update(img.tobytes())
+            h.update(label.tobytes())
+            n += 1
+        return h.hexdigest(), n / (time.perf_counter() - t0)
+
+    d_serial, r_serial = drain(decode(r)
+                               for r in ShardedFileReader(files).records())
+    pooled = ShardedFileReader(files).pooled(decode, num_workers=workers,
+                                             mode=mode)
+    d_pooled, r_pooled = drain(pooled())
+    stats = pooled.feeder_stats()
+    return _line('data_plane_samples_s', r_pooled, 'samples/s',
+                 r_pooled / r_serial,
+                 serial_samples_s=round(r_serial, 1), workers=workers,
+                 mode=mode, latency_ms=lat_ms,
+                 occupancy=round(stats['occupancy'], 2),
+                 bit_identical=bool(d_serial == d_pooled))
+
+
 def bench_ctr():
     import paddle_tpu as fluid
     from models.deepfm import build_deepfm_train
@@ -1155,6 +1205,9 @@ BENCHES = [
     ('smallnet_cifar_multistep_ms_batch', bench_smallnet_multistep),
     ('stacked_lstm_multistep_ms_batch', bench_stacked_lstm_multistep),
     ('ocr_crnn_multistep_img_s_per_chip', bench_ocr_multistep),
+    # data-plane feeder saturation (ISSUE 9): host-side serial-vs-pooled
+    # A/B; vs_baseline is the pooled/serial ratio (>=3x acceptance)
+    ('data_plane_samples_s', bench_data_plane),
 ]
 
 # PTPU_BENCH_ONLY token -> metric-name prefix; indices derive from BENCHES
@@ -1169,6 +1222,7 @@ _SHORT_PREFIX = {
     'ginfer': 'googlenet_infer', 'smallnet': 'smallnet_cifar_ms',
     'smallnet_k': 'smallnet_cifar_multistep',
     'lstm_k': 'stacked_lstm_multistep', 'ocr_k': 'ocr_crnn_multistep',
+    'data_plane': 'data_plane',
 }
 _SHORT = {tok: next(i for i, (n, _) in enumerate(BENCHES)
                     if n.startswith(pref))

@@ -169,19 +169,34 @@ class AsyncExecutor(object):
         self._exe = Executor(place)
 
     def run(self, program, data_feed, filelist, thread_num, fetch=None,
-            mode='', debug=False, epochs=1, scope=None, journal_dir=None):
+            mode='', debug=False, epochs=1, scope=None, journal_dir=None,
+            shard_id=0, num_shards=1):
         """File-driven train loop. With `journal_dir`, file dispatch runs
         through the elastic TaskService (reader/elastic.py — the Go
         master's lease/timeout/failure-cap design, go/master/service.go:89)
         with per-batch progress journaled AFTER the train step, so a
         killed run resumed with the same journal_dir skips batches already
-        trained on — mid-epoch resume without loss or duplication."""
+        trained on — mid-epoch resume without loss or duplication.
+
+        `shard_id`/`num_shards` take this host's strided slice of the
+        (sorted) filelist (reader/sharded.shard_assignment — disjoint and
+        covering across hosts), so a pod runs one AsyncExecutor per host
+        over the same glob without double-training a file; give each
+        host its own journal_dir (the journal describes ONE shard's
+        progress)."""
         program = program or default_main_program()
         scope = scope or global_scope()
         if isinstance(filelist, str):
             filelist = sorted(_glob.glob(filelist))
         if not filelist:
             raise ValueError("AsyncExecutor.run: empty filelist")
+        if num_shards != 1 or shard_id != 0:
+            from .reader.sharded import shard_assignment
+            filelist = shard_assignment(filelist, num_shards, shard_id)
+            if not filelist:
+                raise ValueError(
+                    "AsyncExecutor.run: shard %d/%d holds no files"
+                    % (shard_id, num_shards))
         # parse ALL slots (the file contains every slot), feed only is_used
         # ones — reference MultiSlotDataFeed semantics
         slots = list(data_feed.slots)

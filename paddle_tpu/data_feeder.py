@@ -57,6 +57,10 @@ class DataFeeder(object):
         self.feed_names = []
         self.feed_shapes = []
         self.feed_lod_level = []
+        # cumulative rows->arrays conversion seconds: feed() runs on the
+        # feeder thread, so this is feeder-side work the data plane
+        # surfaces (profiler feeder_report conv(ms)), not step-loop stall
+        self.convert_s = 0.0
         if program is None:
             program = default_main_program()
         for each_var in feed_list:
@@ -71,6 +75,8 @@ class DataFeeder(object):
         self.place = place
 
     def feed(self, iterable):
+        import time as _time
+        t0 = _time.perf_counter()
         converters = []
         for lod_level, shape, dtype in zip(self.feed_lod_level,
                                            self.feed_shapes, self.feed_dtypes):
@@ -86,6 +92,7 @@ class DataFeeder(object):
         ret_dict = {}
         for each_name, each_converter in zip(self.feed_names, converters):
             ret_dict[each_name] = each_converter.done()
+        self.convert_s += _time.perf_counter() - t0
         return ret_dict
 
     def feed_parallel(self, iterable, num_places=None):

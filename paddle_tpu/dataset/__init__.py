@@ -21,3 +21,4 @@ from . import common     # noqa: F401
 from . import sentiment  # noqa: F401
 from . import voc2012  # noqa: F401
 from . import mq2007  # noqa: F401
+from . import synthetic  # noqa: F401  (data-plane benchmark shards)

@@ -487,6 +487,12 @@ class Executor(object):
                     'steps_per_dispatch': st['steps'] / d,
                     'tail_flushes': st['tail_flushes'],
                     'host_stall_ms': st['host_stall_s'] * 1e3,
+                    # the feeder-saturation headline: share of run_steps
+                    # wall time spent WAITING for input (ISSUE 9 drives
+                    # this to ~0 with the sharded/pooled data plane)
+                    'host_stall_pct': (100.0 * st['host_stall_s']
+                                       / st['run_s'])
+                    if st['run_s'] else 0.0,
                     'ckpt_stall_ms': st['ckpt_stall_s'] * 1e3,
                     'ckpt_stall_pct': (100.0 * st['ckpt_stall_s']
                                        / st['run_s'])

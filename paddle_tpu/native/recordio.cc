@@ -110,15 +110,19 @@ void* rio_scanner_open(const char* path) {
 }
 
 // returns record length and sets *data to an internal buffer valid until
-// the next call; -1 = EOF, -2 = corrupt
+// the next call; -1 = EOF, -2 = corrupt, -3 = torn tail (truncated chunk:
+// a writer died mid-chunk — distinguished from clean EOF so the reader
+// can fail loudly instead of silently dropping the tail records)
 int64_t rio_scanner_next(void* h, const char** data) {
   auto* s = static_cast<Scanner*>(h);
   while (s->cursor >= s->records.size()) {
     uint32_t hdr[5];
-    if (fread(hdr, 4, 5, s->f) != 5) return -1;  // EOF
+    size_t got = fread(hdr, 1, 20, s->f);
+    if (got == 0) return -1;    // clean EOF: file ends at a chunk boundary
+    if (got < 20) return -3;    // torn header
     if (hdr[0] != kMagic) return -2;
     std::string raw(hdr[4], '\0');
-    if (fread(&raw[0], 1, raw.size(), s->f) != raw.size()) return -2;
+    if (fread(&raw[0], 1, raw.size(), s->f) != raw.size()) return -3;
     uint32_t crc = static_cast<uint32_t>(
         crc32(crc32(0, nullptr, 0),
               reinterpret_cast<const Bytef*>(raw.data()), raw.size()));

@@ -106,11 +106,15 @@ class MultiStepTrainer(object):
     def iter_epoch(self, reader):
         """Drive one epoch from a PyReader, yielding fetches per dispatch;
         starts the reader when needed, flushes the EOF tail group through
-        its smaller compiled bucket, and resets the reader on exit."""
+        its smaller compiled bucket, and resets the reader on exit. With
+        a sharded/pooled reader decorated in (reader/sharded.py), the
+        feeder-side counters land in profiler.training_report() next to
+        this loop's host-stall column."""
         from ..core import EOFException
-        # start when never started OR drained (EOF consumed: _closed is
-        # set but the dead feeder thread object lingers until reset —
-        # skipping start() there would block forever on the empty queue)
+        # start when never started OR drained; the reader rejoins its
+        # feeder thread the moment EOF is consumed (pipeline._pop), so
+        # repeated sessions never accumulate dead threads and a drained
+        # reader is indistinguishable from a fresh one here
         if getattr(reader, '_thread', None) is None \
                 or getattr(reader, '_closed', True):
             reader.start()

@@ -63,7 +63,9 @@ def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
     if _serving_sources:
         serving_report()
     if _training_sources:
-        training_report()
+        training_report()   # renders feeder sources too
+    elif _feeder_sources:
+        feeder_report()
     if _infer_sources:
         infer_report()
     if _compile_sources:
@@ -202,7 +204,13 @@ def unregister_training_source(name):
 
 def training_report():
     """Print multi-step training dispatch metrics for every registered
-    source and return them as {source name: snapshot dict}."""
+    source and return them as {source name: snapshot dict}. stall% is
+    the share of run_steps wall time spent WAITING for input (the
+    feeder-saturation headline: the data plane's job is driving it to
+    ~0). When feeder sources are registered (sharded/pooled readers,
+    reader/sharded.py), their table renders right below — decode time,
+    queue depth, worker occupancy — so a stall reads straight across to
+    its cause."""
     out = {}
     rows = []
     for name in sorted(_training_sources):
@@ -213,16 +221,72 @@ def training_report():
         out[name] = snap
         rows.append((name, snap))
     if rows:
-        print("%-32s %10s %8s %10s %6s %12s %9s %6s" %
+        print("%-32s %10s %8s %10s %6s %12s %7s %9s %6s" %
               ('Training source', 'dispatches', 'steps', 'steps/disp',
-               'tails', 'stall(ms)', 'ckpt(ms)', 'ckpt%'))
+               'tails', 'stall(ms)', 'stall%', 'ckpt(ms)', 'ckpt%'))
         for name, s in rows:
-            print("%-32s %10d %8d %10.2f %6d %12.2f %9.2f %6.2f" %
+            print("%-32s %10d %8d %10.2f %6d %12.2f %7.2f %9.2f %6.2f" %
                   (name[:32], s.get('dispatches', 0), s.get('steps', 0),
                    s.get('steps_per_dispatch', 0.0),
                    s.get('tail_flushes', 0), s.get('host_stall_ms', 0.0),
+                   s.get('host_stall_pct', 0.0),
                    s.get('ckpt_stall_ms', 0.0),
                    s.get('ckpt_stall_pct', 0.0)))
+    if _feeder_sources:
+        out['feeders'] = feeder_report()
+    return out
+
+
+# -- feeder / data-plane metrics ---------------------------------------------
+# Input-pipeline sources (reader/pipeline.PyReader over a pooled/sharded
+# reader, reader/sharded.FeederStats) register a zero-arg snapshot callable
+# here; feeder_report() renders per-source decode time, queue depth, worker
+# occupancy, deaths/retries, and ring staging time, and training_report()
+# appends the same table so host-stall and its feeder-side cause print
+# together.
+_feeder_sources = {}
+
+
+def register_feeder_source(name, snapshot):
+    """Register a feeder-metrics source: `snapshot()` -> dict with
+    samples, decode_ms_avg, queue_depth, occupancy, workers,
+    workers_live, deaths, retries, and optionally stage_ms/ring_depth/
+    convert_ms (the contract of sharded.FeederStats.snapshot plus
+    PyReader's ring counters)."""
+    _feeder_sources[name] = snapshot
+
+
+def unregister_feeder_source(name):
+    _feeder_sources.pop(name, None)
+
+
+def feeder_report():
+    """Print feeder/data-plane metrics for every registered source and
+    return them as {source name: snapshot dict}."""
+    out = {}
+    rows = []
+    for name in sorted(_feeder_sources):
+        try:
+            snap = _feeder_sources[name]()
+        except Exception:
+            continue  # a collected reader must not break the report
+        out[name] = snap
+        rows.append((name, snap))
+    if rows:
+        print("%-26s %8s %9s %6s %5s %8s %7s %8s %10s %9s" %
+              ('Feeder source', 'samples', 'dec(ms)', 'queue', 'occ',
+               'workers', 'deaths', 'retries', 'stage(ms)', 'conv(ms)'))
+        for name, s in rows:
+            workers = s.get('workers')
+            wl = s.get('workers_live', workers)
+            print("%-26s %8d %9.3f %6d %5.2f %8s %7d %8d %10.2f %9.2f" %
+                  (name[:26], s.get('samples', 0),
+                   s.get('decode_ms_avg', 0.0),
+                   s.get('queue_depth', s.get('ring_depth', 0)),
+                   s.get('occupancy', 0.0),
+                   ('%d/%d' % (wl, workers)) if workers else '-',
+                   s.get('deaths', 0), s.get('retries', 0),
+                   s.get('stage_ms', 0.0), s.get('convert_ms', 0.0)))
     return out
 
 

@@ -3,3 +3,5 @@ from .decorator import (map_readers, buffered, compose, chain, shuffle,  # noqa
                         batch, bucket_by_length, Fake, ComposeNotAligned)
 from .pipeline import PyReader  # noqa: F401
 from .elastic import TaskService, elastic_sample_stream  # noqa: F401
+from .sharded import (shard_assignment, ShardedFileReader,  # noqa: F401
+                      pooled_map, WorkerDied, FeederStats)

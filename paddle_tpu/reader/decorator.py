@@ -17,6 +17,17 @@ __all__ = ['map_readers', 'buffered', 'compose', 'chain', 'shuffle',
            'batch', 'bucket_by_length', 'Fake', 'ComposeNotAligned']
 
 
+def _carry_feeder_stats(inner, outer):
+    """Composition keeps the data-plane telemetry: a decorator wrapping a
+    pooled/sharded reader (reader/sharded.py) forwards its
+    `feeder_stats` so PyReader still finds the decode-pool counters
+    behind batch()/shuffle()/... (profiler feeder_report)."""
+    fs = getattr(inner, 'feeder_stats', None)
+    if callable(fs):
+        outer.feeder_stats = fs
+    return outer
+
+
 def map_readers(func, *readers):
     """Zip several readers and map `func` over the tuples of samples."""
     def mapped():
@@ -24,19 +35,27 @@ def map_readers(func, *readers):
     return mapped
 
 
-def shuffle(reader, buf_size):
+def shuffle(reader, buf_size, seed=None):
     """Block shuffle: fill a window of `buf_size` samples, emit it in random
     order, repeat. Same locality/memory trade-off as the reference's
-    decorator; implemented via islice windows."""
+    decorator; implemented via islice windows.
+
+    `seed=None` (default) draws from the global `random` stream — the
+    reference's behavior, unchanged. With an explicit seed, every
+    invocation of the returned reader replays the SAME shuffle from a
+    private Random(seed): sharded runs become reproducible per worker
+    (seed with e.g. base_seed + shard_id) and the serial-vs-pooled
+    bit-identity A/B can shuffle without losing comparability."""
     def shuffled():
+        rng = random if seed is None else random.Random(seed)
         it = iter(reader())
         while True:
             window = list(itertools.islice(it, buf_size))
             if not window:
                 return
-            random.shuffle(window)
+            rng.shuffle(window)
             yield from window
-    return shuffled
+    return _carry_feeder_stats(reader, shuffled)
 
 
 def chain(*readers):
@@ -106,7 +125,7 @@ def buffered(reader, size):
         while e is not end:
             yield e
             e = q.get()
-    return data_reader
+    return _carry_feeder_stats(reader, data_reader)
 
 
 def firstn(reader, n):
@@ -115,11 +134,16 @@ def firstn(reader, n):
             if i == n:
                 break
             yield item
-    return firstn_reader
+    return _carry_feeder_stats(reader, firstn_reader)
 
 
 def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
-    """Parallel map over a reader with worker threads."""
+    """Parallel map over a reader with worker threads (reference
+    surface). Unordered mode delivers in completion order — a
+    nondeterministic stream. For the production data plane use
+    reader.pooled_map instead: deterministic delivery order regardless
+    of decode order, bounded in-flight window, and loud degrade on
+    worker death (reader/sharded.py)."""
     end = object()
 
     def data_reader():
@@ -201,7 +225,7 @@ def batch(reader, batch_size, drop_last=False):
                 b = []
         if drop_last is False and len(b) != 0:
             yield b
-    return batch_reader
+    return _carry_feeder_stats(reader, batch_reader)
 
 
 def bucket_by_length(reader, length_fn, bucket_boundaries, batch_size,
@@ -226,7 +250,7 @@ def bucket_by_length(reader, length_fn, bucket_boundaries, batch_size,
             for b, items in buckets.items():
                 if items:
                     yield items
-    return bucket_reader
+    return _carry_feeder_stats(reader, bucket_reader)
 
 
 class Fake(object):

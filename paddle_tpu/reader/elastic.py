@@ -300,6 +300,23 @@ class TaskService(object):
             self._progress.pop(task_id, None)
             self._journal({'event': 'done', 'task': task_id})
 
+    def release_task(self, task_id, gen=None):
+        """Return a leased task to the queue WITHOUT a failure mark: a
+        consumer that stops cleanly mid-epoch (reader reset, controlled
+        shutdown) is not a task failure — the journaled progress stands
+        and the task re-dispatches immediately with the right skip,
+        instead of waiting out the lease timeout or burning the failure
+        cap (the Go master equivalent: client disconnect re-queues the
+        task, service.go:140 only counts timeouts)."""
+        with self._lock:
+            if self._stale(task_id, gen):
+                return
+            if self._pending.pop(task_id, None) is None:
+                return  # not leased (already done/failed/released)
+            if task_id not in self._todo and task_id not in self._done \
+                    and task_id not in self._dropped:
+                self._todo.insert(0, task_id)  # resume-first: keep order
+
     def task_failed(self, task_id, gen=None):
         """Report a failure. With `gen`, a late report from an expired
         lease (whose task may already be re-leased) is a no-op instead of

@@ -40,12 +40,16 @@ class PyReader(object):
         self._closed = True
         self._exc = None
         self._converter = feed_converter
+        self._source = None
+        self._data_feeder = None
+        self._feeder_registered = False
         self._prefetch_k = None
         self._prefetch_depth = 2
         self._mode_k = 0        # group size the LAST start() ran with
         self._pending_eof = False
         self.prefetch_stats = {'groups': 0, 'tail_groups': 0,
                                'stage_s': 0.0}
+        self._stage_s_total = 0.0   # lifetime staging s across epochs
 
     def prefetch_to_device(self, steps, depth=2):
         """Stage fixed groups of `steps` stacked batches to the device.
@@ -83,6 +87,8 @@ class PyReader(object):
         from ..data_feeder import DataFeeder
         feeder = DataFeeder(self.feed_vars, program=None) \
             if self._converter is None else None
+        self._source = reader       # a pooled reader exposes feeder_stats
+        self._data_feeder = feeder  # row->array convert time rides along
 
         def fn():
             for batch in reader():
@@ -95,6 +101,9 @@ class PyReader(object):
     decorate_sample_list_generator = decorate_paddle_reader
 
     def decorate_tensor_provider(self, reader, places=None):
+        self._source = reader
+        self._data_feeder = None
+
         def fn():
             for batch in reader():
                 if isinstance(batch, dict):
@@ -104,6 +113,39 @@ class PyReader(object):
         self._feeder_fn = fn
 
     decorate_batch_generator = decorate_tensor_provider
+
+    def _register_feeder_source(self):
+        """Surface this reader's feeder-side counters (decode pool stats
+        when the decorated reader is a sharded/pooled one, plus ring
+        staging time and queue depth) in profiler.training_report()."""
+        if self._feeder_registered:
+            return
+        self._feeder_registered = True
+        import weakref
+        from .. import profiler as _profiler
+        ref = weakref.ref(self)
+        name = 'pyreader@%x' % id(self)
+
+        def snap():
+            rd = ref()
+            if rd is None:
+                _profiler.unregister_feeder_source(name)
+                raise ReferenceError('py_reader collected')
+            out = {}
+            src_stats = getattr(rd._source, 'feeder_stats', None)
+            if callable(src_stats):
+                out.update(src_stats())
+            out['stage_ms'] = (rd._stage_s_total
+                               + rd.prefetch_stats['stage_s']) * 1e3
+            try:
+                out['ring_depth'] = rd._queue.qsize()
+            except Exception:
+                out['ring_depth'] = 0
+            df = rd._data_feeder
+            if df is not None:
+                out['convert_ms'] = df.convert_s * 1e3
+            return out
+        _profiler.register_feeder_source(name, snap)
 
     def start(self):
         assert self._feeder_fn is not None, (
@@ -116,6 +158,11 @@ class PyReader(object):
         self._mode_k = self._prefetch_k or 0
         if self._mode_k:
             self._queue = _q.Queue(maxsize=self._prefetch_depth)
+            # prefetch_stats is per-epoch; fold the finished epoch's
+            # staging time into the lifetime accumulator first so the
+            # feeder table's stage(ms) shares a time base with the
+            # cumulative samples/decode/convert columns
+            self._stage_s_total += self.prefetch_stats['stage_s']
             self.prefetch_stats = {'groups': 0, 'tail_groups': 0,
                                    'stage_s': 0.0}
             target = self._prefetch_work
@@ -129,6 +176,7 @@ class PyReader(object):
         self._thread = threading.Thread(target=target, args=(self._queue,),
                                         daemon=True)
         self._thread.start()
+        self._register_feeder_source()
 
     def _work(self, q):
         try:
@@ -254,6 +302,15 @@ class PyReader(object):
         item = self._queue.get()
         if item is _EOF:
             self._closed = True
+            # rejoin the feeder HERE, not only at reset(): the thread has
+            # already queued _EOF and is exiting, so the join is
+            # immediate — and a caller that loops sessions without ever
+            # calling reset() (the parallel/api.py iter_epoch pattern)
+            # no longer accumulates one dead Thread object per epoch
+            t = self._thread
+            self._thread = None
+            if t is not None:
+                t.join(timeout=5)
             if self._exc is not None:
                 raise self._exc
             raise EOFException("py_reader reached end of data")

@@ -1,0 +1,729 @@
+"""Sharded streaming input: the production data plane (ROADMAP item 5).
+
+Three composable pieces rebuild the reference's AsyncExecutor/data-feed
+story (framework/data_feed.cc, executor_thread_worker.cc) at TPU scale,
+where the host's job is to keep ONE compiled step program fed:
+
+- `shard_assignment(items, num_shards, shard_id)`: the per-host/worker
+  split — strided, disjoint, covering, deterministic.
+- `ShardedFileReader`: a shard-assigned record source over RecordIO
+  chunk tasks (seekable via recordio.chunk_index) or whole-file tasks,
+  with exactly-once accounting through the reader/elastic.py
+  flock-journal: progress is journaled at delivery, `journal_position()`
+  feeds the checkpoint manager, and `journal_limit=` rewinds the journal
+  to a restored checkpoint so params and data accounting describe the
+  same history.
+- `DecodePool` (via `pooled_map` / `ShardedFileReader.pooled`): a
+  parallel decode+augment worker pool (thread- or process-based) that
+  decodes OUT OF ORDER but delivers in the source's deterministic order,
+  with a bounded in-flight window for backpressure and loud degrade —
+  a dead worker re-dispatches its in-flight sample to the survivors
+  with a RuntimeWarning; the pool only errors when NO worker is left or
+  a sample exhausts its retry cap. It never deadlocks: every queue is
+  bounded by the window, and the window is bounded by the consumer.
+
+Ordering contract: the pooled stream is bit-identical to the serial
+stream (same shard, same seed) — out-of-order decode is an
+implementation detail, invisible to training. This is what makes the
+serial-vs-pooled A/B in scripts/data_plane_smoke.py meaningful.
+"""
+from __future__ import annotations
+
+import glob as _glob
+import threading
+import time
+import warnings
+
+__all__ = ['shard_assignment', 'ShardedFileReader', 'pooled_map',
+           'WorkerDied', 'FeederStats']
+
+
+def shard_assignment(items, num_shards, shard_id):
+    """Strided per-shard slice: items[shard_id::num_shards].
+
+    Disjoint and covering by construction (each item belongs to exactly
+    one shard), deterministic given a stable item order, and balanced to
+    within one item — the properties per-host data feeding needs so a
+    pod never trains a sample twice per epoch nor drops one."""
+    num_shards = int(num_shards)
+    shard_id = int(shard_id)
+    if num_shards < 1:
+        raise ValueError("num_shards must be >= 1, got %d" % num_shards)
+    if not 0 <= shard_id < num_shards:
+        raise ValueError("shard_id must be in [0, %d), got %d"
+                         % (num_shards, shard_id))
+    return list(items)[shard_id::num_shards]
+
+
+class ShardTask(object):
+    """One dispatchable unit of input: a whole file, or one RecordIO
+    chunk of a file (`offset` set). str() is the stable journal id."""
+
+    __slots__ = ('path', 'offset', 'num_records')
+
+    def __init__(self, path, offset=None, num_records=None):
+        self.path = path
+        self.offset = None if offset is None else int(offset)
+        self.num_records = num_records
+
+    def __str__(self):
+        if self.offset is None:
+            return self.path
+        return '%s@%d' % (self.path, self.offset)
+
+    def __repr__(self):
+        return 'ShardTask(%s)' % str(self)
+
+
+class WorkerDied(Exception):
+    """Raised FROM a decode_fn to declare its worker dead (a cooperative
+    death signal: fault-injection tests, or a worker that detects its own
+    corruption). The pool logs a RuntimeWarning, re-dispatches the
+    in-flight sample to the surviving workers, and keeps going — loud
+    degrade, not silent loss. Process workers can also die hard
+    (SIGKILL); the pool detects that by liveness polling."""
+
+
+class FeederStats(object):
+    """Shared feeder-side counters for one decode pool, thread-safe, and
+    cumulative across epochs. snapshot() is the
+    profiler.register_feeder_source contract."""
+
+    def __init__(self, num_workers=0, mode='thread'):
+        self._lock = threading.Lock()
+        self.num_workers = num_workers
+        self.mode = mode
+        self.samples = 0
+        self.decode_s = 0.0       # summed worker decode seconds (parallel)
+        self.wall_s = 0.0         # pool wall-clock seconds (completed runs)
+        self.deaths = 0
+        self.retries = 0
+        self.max_inflight = 0
+        self._run_started = None
+        self._live = num_workers
+        self._depth_fn = None     # out-queue depth probe of the live run
+
+    def _start_run(self, depth_fn):
+        with self._lock:
+            self._run_started = time.perf_counter()
+            self._live = self.num_workers
+            self._depth_fn = depth_fn
+
+    def _end_run(self):
+        with self._lock:
+            if self._run_started is not None:
+                self.wall_s += time.perf_counter() - self._run_started
+                self._run_started = None
+            self._depth_fn = None
+
+    def snapshot(self):
+        with self._lock:
+            wall = self.wall_s
+            if self._run_started is not None:
+                wall += time.perf_counter() - self._run_started
+            depth = 0
+            if self._depth_fn is not None:
+                try:
+                    depth = self._depth_fn()
+                except Exception:
+                    depth = 0
+            denom = max(self.num_workers, 1) * wall
+            return {
+                'samples': self.samples,
+                'decode_ms': self.decode_s * 1e3,
+                'decode_ms_avg': (self.decode_s * 1e3
+                                  / max(self.samples, 1)),
+                'queue_depth': depth,
+                'occupancy': (self.decode_s / denom) if denom else 0.0,
+                'workers': self.num_workers,
+                'workers_live': self._live,
+                'deaths': self.deaths,
+                'retries': self.retries,
+                'max_inflight': self.max_inflight,
+                'mode': self.mode,
+            }
+
+
+def _worker_loop(wid, decode_fn, in_q, out_q, pickle_results=False):
+    """One decode worker (thread target or forked process body): pop
+    (seq, payload), decode, report. Exits on the None pill or on
+    WorkerDied; any other decode exception is reported per-sample and
+    the worker keeps serving (the sample, not the worker, is sick).
+
+    pickle_results (process mode): serialize the decoded value HERE so
+    an unpicklable result becomes a loud per-sample 'err' — mp.Queue's
+    own feeder thread pickles asynchronously and silently DROPS a value
+    it cannot pickle, which would hang the consumer forever."""
+    from time import perf_counter
+    import pickle
+    while True:
+        msg = in_q.get()
+        if msg is None:
+            out_q.put(('bye', wid))
+            return
+        seq, payload = msg
+        t0 = perf_counter()
+        try:
+            val = decode_fn(payload)
+            if pickle_results:
+                val = pickle.dumps(val, protocol=pickle.HIGHEST_PROTOCOL)
+        except WorkerDied as e:
+            out_q.put(('died', wid, seq, repr(e)))
+            return
+        except Exception as e:
+            # Exception, not BaseException: KeyboardInterrupt/SystemExit
+            # must terminate the worker (liveness detection re-dispatches
+            # its sample), not masquerade as a rotten record and burn the
+            # retry cap
+            out_q.put(('err', seq, repr(e), wid))
+            continue
+        out_q.put(('ok', seq, val, perf_counter() - t0, wid))
+
+
+class _PoolRun(object):
+    """One epoch of pooled decoding: a dispatcher thread pulls tagged
+    (payload, meta) pairs from the source and feeds the worker pool; the
+    consumer generator reorders results back into source order and acks
+    each sample's meta at delivery. In-flight samples are bounded by
+    `window` (the backpressure contract): the dispatcher blocks until
+    delivery catches up, so a slow consumer bounds memory no matter how
+    fast the source or the workers are."""
+
+    def __init__(self, source_iter, decode_fn, num_workers, mode, window,
+                 max_retries, stats, on_deliver):
+        self.source_iter = source_iter
+        self.decode_fn = decode_fn
+        self.num_workers = int(num_workers)
+        self.mode = mode
+        self.window = int(window)
+        self.max_retries = int(max_retries)
+        self.stats = stats
+        self.on_deliver = on_deliver
+        if self.num_workers < 1:
+            raise ValueError("num_workers must be >= 1")
+        if self.window < self.num_workers:
+            raise ValueError("window (%d) must be >= num_workers (%d) — "
+                             "a smaller window starves the pool"
+                             % (self.window, self.num_workers))
+        if mode not in ('thread', 'process'):
+            raise ValueError("mode must be 'thread' or 'process', got %r"
+                             % (mode,))
+
+    # -- queue/worker construction per mode --------------------------------
+    def _build(self):
+        if self.mode == 'thread':
+            import queue as q
+            in_q = q.Queue()
+            out_q = q.Queue()
+            workers = {
+                wid: threading.Thread(
+                    target=_worker_loop,
+                    args=(wid, self.decode_fn, in_q, out_q), daemon=True)
+                for wid in range(self.num_workers)}
+        else:
+            import multiprocessing as mp
+            # fork: decode_fn and payloads need no pickling to START the
+            # pool (results still cross a pickling queue); spawn would
+            # re-import the main module and reject closures
+            ctx = mp.get_context('fork')
+            in_q = ctx.Queue()
+            out_q = ctx.Queue()
+            workers = {
+                wid: ctx.Process(
+                    target=_worker_loop,
+                    args=(wid, self.decode_fn, in_q, out_q, True),
+                    daemon=True)
+                for wid in range(self.num_workers)}
+        return in_q, out_q, workers
+
+    @staticmethod
+    def _alive(w):
+        return w.is_alive()
+
+    def run(self):
+        """The ordered delivery generator."""
+        in_q, out_q, workers = self._build()
+        pending = {}      # seq -> payload (redispatch source)
+        meta = {}         # seq -> meta (controller-side only, never sent)
+        attempts = {}     # seq -> dispatch count
+        ready = {}        # seq -> decoded value, arrived out of order
+        state = {'next_out': 0, 'total': None, 'src_exc': None,
+                 'closed': False}
+        cond = threading.Condition()
+        stats = self.stats
+        stats._start_run(lambda: out_q.qsize())
+
+        def dispatch():
+            seq = 0
+            try:
+                for payload, m in self.source_iter:
+                    with cond:
+                        # backpressure: never run more than `window`
+                        # samples ahead of delivery
+                        cond.wait_for(
+                            lambda: state['closed']
+                            or seq - state['next_out'] < self.window)
+                        if state['closed']:
+                            return
+                        pending[seq] = payload
+                        meta[seq] = m
+                        attempts[seq] = 1
+                        infl = seq + 1 - state['next_out']
+                        if infl > stats.max_inflight:
+                            stats.max_inflight = infl
+                    in_q.put((seq, payload))
+                    seq += 1
+            except BaseException as e:
+                state['src_exc'] = e
+            finally:
+                with cond:
+                    state['total'] = seq
+                    cond.notify_all()
+                # NO poison pills here: retries of failed samples can be
+                # enqueued after the source is exhausted, and a worker
+                # that eats a pill first would strand them. Workers stay
+                # parked on in_q.get(); the consumer's cleanup pills them
+                # once delivery is complete (termination is detected by
+                # next_out == total, not by worker exit).
+
+        disp = threading.Thread(target=dispatch, daemon=True)
+        for w in workers.values():
+            w.start()
+        disp.start()
+        live = set(workers)
+        import queue as _q
+        try:
+            while True:
+                with cond:
+                    done = (state['total'] is not None
+                            and state['next_out'] >= state['total'])
+                if done:
+                    break
+                # deliver everything already in order
+                while state['next_out'] in ready:
+                    s = state['next_out']
+                    val = ready.pop(s)
+                    m = meta.pop(s)
+                    pending.pop(s, None)
+                    attempts.pop(s, None)
+                    stats.samples += 1
+                    if self.on_deliver is not None:
+                        self.on_deliver(m, val)
+                    yield val
+                    with cond:
+                        state['next_out'] = s + 1
+                        cond.notify_all()
+                with cond:
+                    if (state['total'] is not None
+                            and state['next_out'] >= state['total']):
+                        break
+                try:
+                    msg = out_q.get(timeout=0.2)
+                except _q.Empty:
+                    live = self._check_liveness(live, workers, in_q,
+                                                pending, ready, state)
+                    continue
+                kind = msg[0]
+                if kind == 'ok':
+                    _, s, val, dt, _wid = msg
+                    stats.decode_s += dt
+                    if s >= state['next_out'] and s not in ready \
+                            and s in meta:
+                        if self.mode == 'process':
+                            import pickle
+                            val = pickle.loads(val)
+                        ready[s] = val
+                elif kind == 'err':
+                    _, s, err, wid = msg
+                    if s < state['next_out'] or s in ready:
+                        continue  # stale duplicate of a retried sample
+                    if attempts.get(s, 0) > self.max_retries:
+                        raise RuntimeError(
+                            "decode of sample %d failed %d times (worker "
+                            "%d, last error: %s) — a deterministic decode "
+                            "failure; inspect the record" %
+                            (s, attempts[s], wid, err))
+                    attempts[s] = attempts.get(s, 1) + 1
+                    stats.retries += 1
+                    warnings.warn(
+                        "decode error on sample %d (worker %d): %s — "
+                        "retrying (%d/%d)" % (s, wid, err,
+                                              attempts[s] - 1,
+                                              self.max_retries),
+                        RuntimeWarning)
+                    in_q.put((s, pending[s]))
+                elif kind == 'died':
+                    _, wid, s, err = msg
+                    live.discard(wid)
+                    stats.deaths += 1
+                    with stats._lock:
+                        stats._live = len(live)
+                    warnings.warn(
+                        "decode worker %d died (%s) — continuing with "
+                        "%d of %d workers; its in-flight sample "
+                        "re-dispatches" % (wid, err, len(live),
+                                           self.num_workers),
+                        RuntimeWarning)
+                    if s is not None and s >= state['next_out'] \
+                            and s not in ready and s in pending:
+                        in_q.put((s, pending[s]))
+                    self._require_live(live, state)
+                elif kind == 'bye':
+                    live.discard(msg[1])
+                    with stats._lock:
+                        stats._live = len(live)
+            if state['src_exc'] is not None:
+                raise state['src_exc']
+        finally:
+            with cond:
+                state['closed'] = True
+                cond.notify_all()
+            stats._end_run()
+            # close the source DETERMINISTICALLY (not at GC): its
+            # GeneratorExit path releases journal leases, and a consumer
+            # that stops this epoch and immediately starts the next must
+            # find them released, not pending. Join the dispatcher first
+            # — closing a generator another thread is executing raises.
+            disp.join(timeout=5)
+            src_close = getattr(self.source_iter, 'close', None)
+            if src_close is not None:
+                try:
+                    src_close()
+                except Exception:
+                    pass
+            # workers (daemon threads/processes) are parked on in_q.get();
+            # pill them so they exit promptly instead of lingering
+            for _ in range(self.num_workers):
+                try:
+                    in_q.put_nowait(None)
+                except Exception:
+                    pass
+            if self.mode == 'process':
+                for w in workers.values():
+                    w.join(timeout=2)
+                for w in workers.values():
+                    if w.is_alive():
+                        w.terminate()
+
+    def _check_liveness(self, live, workers, in_q, pending, ready, state):
+        """Timeout path: detect hard-killed process workers (they die
+        without a message) and re-dispatch every unaccounted sample.
+        Duplicate decodes are possible (an item may still be in in_q) —
+        the receive path dedups by seq, so correctness holds."""
+        dead = {wid for wid in live if not self._alive(workers[wid])}
+        if dead:
+            live -= dead
+            self.stats.deaths += len(dead)
+            with self.stats._lock:
+                self.stats._live = len(live)
+            warnings.warn(
+                "%d decode worker(s) died without reporting (hard kill?) "
+                "— continuing with %d of %d; unaccounted samples "
+                "re-dispatch" % (len(dead), len(live), self.num_workers),
+                RuntimeWarning)
+            for s in sorted(set(pending) - set(ready)):
+                if s >= state['next_out']:
+                    in_q.put((s, pending[s]))
+        self._require_live(live, state)
+        return live
+
+    def _require_live(self, live, state):
+        undelivered = (state['total'] is None
+                       or state['next_out'] < state['total'])
+        if not live and undelivered:
+            raise RuntimeError(
+                "all %d decode workers died with samples still pending — "
+                "the feeder cannot make progress (degrade floor reached); "
+                "see the RuntimeWarnings above for each death"
+                % self.num_workers)
+
+
+class _PooledReader(object):
+    """A reader callable: each invocation runs one pooled epoch over the
+    tagged source. Carries cumulative FeederStats; PyReader discovers
+    `feeder_stats` at decorate time and registers it with the profiler."""
+
+    def __init__(self, source_fn, decode_fn, num_workers=4, mode='thread',
+                 window=None, max_retries=2, stats=None, on_deliver=None):
+        self._source_fn = source_fn
+        self._decode_fn = decode_fn
+        self._num_workers = int(num_workers)
+        self._mode = mode
+        self._window = (int(window) if window is not None
+                        else 4 * self._num_workers + 4)
+        self._max_retries = int(max_retries)
+        self._on_deliver = on_deliver
+        self.stats = stats if stats is not None else FeederStats(
+            self._num_workers, mode)
+
+    def __call__(self):
+        run = _PoolRun(self._source_fn(), self._decode_fn,
+                       self._num_workers, self._mode, self._window,
+                       self._max_retries, self.stats, self._on_deliver)
+        return run.run()
+
+    def feeder_stats(self):
+        return self.stats.snapshot()
+
+
+def pooled_map(mapper, reader, num_workers=4, mode='thread', window=None,
+               max_retries=2):
+    """xmap_readers, rebuilt for the production data plane: map `mapper`
+    over `reader`'s samples on a worker pool (threads by default;
+    mode='process' forks real processes for GIL-bound decodes), decoding
+    out of order but DELIVERING in reader order — the pooled stream is
+    bit-identical to map(mapper, reader()). In-flight samples are
+    bounded by `window` (default 4*workers+4); a dead worker degrades
+    loudly instead of deadlocking. Returns a reader callable whose
+    `.feeder_stats()` snapshot feeds profiler.training_report()."""
+    def source():
+        for item in reader():
+            yield item, None
+    return _PooledReader(source, mapper, num_workers=num_workers,
+                         mode=mode, window=window, max_retries=max_retries)
+
+
+class ShardedFileReader(object):
+    """Shard-assigned, chunk-granular, journaled record source.
+
+    `files` is a glob or list. RecordIO files split into per-chunk tasks
+    (seekable via recordio.chunk_index — indexing reads 20 bytes per
+    chunk); other files are whole-file tasks read by `read_task_fn(task)`
+    (required for non-recordio inputs). The GLOBAL task list is built in
+    deterministic (file, offset) order, then strided across
+    `num_shards`; this host leases only its own disjoint slice, so a pod
+    covers every sample exactly once per epoch with no coordination
+    beyond the shared file listing.
+
+    With `journal_path`, dispatch runs through the elastic TaskService
+    flock-journal: progress is journaled AT DELIVERY (the moment a
+    record is handed to the consumer — or, via `pooled()`, the moment
+    the decoded record leaves the pool in order), every
+    `progress_every` records and at each task end. The margin is the
+    DELIVERY point: a clean stop (generator close / reader reset)
+    resumes exactly-once with zero loss and zero replay; a hard kill
+    replays up to `progress_every - 1` records journaled-pending, and
+    records a kill caught BUFFERED DOWNSTREAM of delivery (batch(),
+    the PyReader prefetch ring) are journaled-but-untrained. For
+    training, close that window the way AsyncExecutor does at batch
+    granularity: couple this reader to the checkpoint —
+    `CheckpointManager(..., task_service=reader)` snapshots
+    `journal_position()` at every step boundary, and a restore passes
+    it back as `journal_limit=`, rewinding the journal so everything
+    after the restored step (including anything that died in a
+    downstream buffer) re-dispatches.
+
+    Each call of the reader (``reader()``) is one pass over the shard's
+    REMAINING work: the first call after a crash resumes mid-epoch; a
+    call when the epoch is complete starts the next epoch."""
+
+    def __init__(self, files, shard_id=0, num_shards=1, journal_path=None,
+                 chunk_granular=True, read_task_fn=None,
+                 lease_timeout_s=3600.0, max_failures=3,
+                 progress_every=32, journal_limit=None):
+        from .. import recordio as _rio
+        from .elastic import TaskService
+        if isinstance(files, str):
+            files = sorted(_glob.glob(files))
+        files = list(files)
+        if not files:
+            raise ValueError("ShardedFileReader: empty file set")
+        tasks = []
+        for path in files:
+            if chunk_granular and _rio.is_recordio(path):
+                for c in _rio.chunk_index(path):  # torn tails fail HERE,
+                    # loudly, before any training starts
+                    tasks.append(ShardTask(path, c.offset, c.num_records))
+            else:
+                tasks.append(ShardTask(path))
+        self.all_tasks = tasks
+        self.tasks = shard_assignment(tasks, num_shards, shard_id)
+        if not self.tasks:
+            raise ValueError(
+                "shard %d/%d holds no tasks (%d total) — fewer tasks than "
+                "shards; write more/smaller chunks or reduce num_shards"
+                % (shard_id, num_shards, len(tasks)))
+        self.shard_id = int(shard_id)
+        self.num_shards = int(num_shards)
+        self._read_task_fn = read_task_fn
+        self._progress_every = max(1, int(progress_every))
+        if read_task_fn is None:
+            missing = [t for t in self.tasks if t.offset is None]
+            if missing:
+                raise ValueError(
+                    "non-recordio files in the set (%s, ...) need a "
+                    "read_task_fn(task) that yields their records"
+                    % missing[0].path)
+        self._service = TaskService(
+            self.tasks, journal_path=journal_path,
+            lease_timeout_s=lease_timeout_s, max_failures=max_failures,
+            journal_limit=journal_limit)
+        self._held = {}       # live generator's leases (see _tagged/_ack)
+        self._delivered = {}  # live generator's delivered positions
+
+    # -- accounting surface -------------------------------------------------
+    # duck-types core/checkpoint.CheckpointManager's task_service
+    # contract (journal_position / epoch / _journal_path), so
+    # `CheckpointManager(..., task_service=sharded_reader)` snapshots the
+    # data-plane position next to the params with no adapter
+    @property
+    def service(self):
+        return self._service
+
+    @property
+    def _journal_path(self):
+        return getattr(self._service, '_journal_path', None)
+
+    @property
+    def epoch(self):
+        return self._service.epoch
+
+    def journal_position(self):
+        """Byte offset for checkpoint coupling (see elastic.py)."""
+        return self._service.journal_position()
+
+    @property
+    def epoch_done(self):
+        return self._service.epoch_done
+
+    def counts(self):
+        return self._service.counts
+
+    def close(self):
+        self._service.close()
+
+    # -- record streams -----------------------------------------------------
+    def _read(self, task):
+        from .. import recordio as _rio
+        if task.offset is not None:
+            return _rio.read_chunk(task.path, task.offset)
+        return self._read_task_fn(task)
+
+    def _tagged(self):
+        """(record, meta) stream in deterministic task order; acks happen
+        in _ack at DELIVERY, not here — with a decode pool in between,
+        this generator runs in the dispatcher thread, records ahead of
+        what training has actually consumed."""
+        svc = self._service
+        if svc.epoch_done:
+            svc.new_epoch()
+        # task_id -> lease gen. Shared with _ack (consumer side): a
+        # task leaves `held` when its LAST record is DELIVERED
+        # (task_finished), not when it is read — with a decode pool in
+        # between, the dispatcher is ahead of delivery, and popping at
+        # read time would strand the lease of a finished-but-undelivered
+        # task on a clean stop (it would sit pending until the lease
+        # timeout, stalling an in-session resume)
+        held = self._held = {}
+        self._delivered = {}  # task_id -> last DELIVERED record number
+        task_seen = {}  # task_id -> records THIS generator already
+        # yielded: a mid-task read failure re-leases the task, and
+        # re-yielding records still in flight downstream would duplicate
+        # them in the stream — so an in-session retry resumes past them
+        # (a crashed process starts a fresh generator, where the journal
+        # governs instead)
+        try:
+            while True:
+                leased = svc.get_task()
+                if leased is None:
+                    if svc.epoch_done:
+                        return
+                    time.sleep(0.02)  # leases in flight; wait for requeue
+                    continue
+                task_id, task, skip = leased
+                gen = getattr(leased, 'gen', None)
+                held[task_id] = gen
+                skip = max(skip, task_seen.get(task_id, 0))
+                n = 0
+                prev = None  # one-record lookahead marks the LAST record
+                try:
+                    for rec in iter(self._read(task)):
+                        n += 1
+                        if n <= skip:
+                            continue
+                        if prev is not None:
+                            yield prev
+                            task_seen[task_id] = prev[1][1]
+                            svc.renew_lease(task_id, gen=gen)
+                        prev = (rec, (task_id, n, gen, False))
+                except Exception:
+                    # read failure — at construction OR mid-iteration of
+                    # a lazy read_task_fn (flaky mount, rotting shard):
+                    # route through the lease/failure machinery (backoff,
+                    # retry, failure cap) instead of sinking the stream;
+                    # the buffered `prev` was never yielded and re-reads
+                    # on retry. GeneratorExit is a BaseException: it
+                    # still unwinds through the release path below.
+                    held.pop(task_id, None)
+                    svc.task_failed(task_id, gen=gen)
+                    if svc.is_dropped(task_id):
+                        raise
+                    continue
+                if prev is not None:
+                    rec, (tid, nlast, g, _last) = prev
+                    yield rec, (tid, nlast, g, True)
+                    # held.pop happens in _ack at DELIVERY of this last
+                    # record, where task_finished fires
+                else:
+                    # nothing new to deliver (empty task, or the journal
+                    # already covers every record): finish immediately
+                    svc.task_finished(task_id, gen=gen)
+                    held.pop(task_id, None)
+                task_seen.pop(task_id, None)
+        except GeneratorExit:
+            # clean stop: journal each held task's exact DELIVERED
+            # position first (zero replay, zero loss — the docstring's
+            # clean-stop contract even with progress_every > 1), then
+            # release newest-first: release_task front-inserts, so the
+            # net todo order equals lease order and a resumed stream
+            # continues deterministically where this one stopped
+            delivered = self._delivered
+            for task_id, gen in reversed(list(held.items())):
+                n = delivered.get(task_id)
+                if n:
+                    svc.report_progress(task_id, n, gen=gen)
+                svc.release_task(task_id, gen=gen)
+            raise
+
+    def _ack(self, m, _val=None):
+        """Delivery-time accounting (the on_deliver hook): journal done
+        at a task's last record, progress every progress_every records;
+        the exact delivered position is tracked so a clean stop journals
+        it (zero replay) before releasing the lease."""
+        task_id, n, gen, last = m
+        svc = self._service
+        self._delivered[task_id] = n
+        if last:
+            svc.task_finished(task_id, gen=gen)
+            self._held.pop(task_id, None)
+            self._delivered.pop(task_id, None)
+        elif n % self._progress_every == 0:
+            svc.report_progress(task_id, n, gen=gen)
+        else:
+            svc.renew_lease(task_id, gen=gen)
+
+    def records(self):
+        """Serial epoch generator (the baseline arm of the A/B): yields
+        raw records, acking each at hand-off."""
+        tagged = self._tagged()
+        try:
+            for rec, m in tagged:
+                self._ack(m)
+                yield rec
+        finally:
+            tagged.close()  # deterministic lease release on early stop
+
+    def __call__(self):
+        return self.records()
+
+    def pooled(self, decode_fn, num_workers=4, mode='thread', window=None,
+               max_retries=2):
+        """The saturation path: decode this shard's records on a worker
+        pool, delivering decoded samples in the same deterministic order
+        as records() and journaling consumption at ordered delivery.
+        Returns a reader callable (`reader()` per epoch) carrying
+        `.feeder_stats()` for profiler.training_report()."""
+        return _PooledReader(self._tagged, decode_fn,
+                             num_workers=num_workers, mode=mode,
+                             window=window, max_retries=max_retries,
+                             on_deliver=self._ack)

@@ -33,12 +33,15 @@ def _native():
     if _lib_tried:
         return _lib
     _lib_tried = True
-    if not os.path.exists(_LIB_PATH):
-        try:
-            subprocess.run(['make', '-C', _NATIVE_DIR], check=True,
-                           capture_output=True, timeout=120)
-        except Exception:
-            return None
+    # ALWAYS invoke make (a fresh .so makes it a ~10 ms no-op): loading a
+    # stale prebuilt library would silently run old codec semantics —
+    # e.g. a pre-torn-tail-fix scanner that truncates instead of erroring
+    try:
+        subprocess.run(['make', '-C', _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=120)
+    except Exception:
+        if not os.path.exists(_LIB_PATH):
+            return None  # no toolchain and no library: python fallback
     try:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError:
@@ -145,30 +148,23 @@ class Scanner(object):
                                               ctypes.byref(data))
             if n == -1:
                 raise StopIteration
+            if n == -3:
+                raise IOError(_TORN_MSG)
             if n < 0:
                 raise IOError("corrupt recordio chunk")
             return ctypes.string_at(data, n)
         while self._i >= len(self._buf):
             hdr = self._f.read(20)
+            if not hdr:
+                raise StopIteration  # clean EOF: ends at a chunk boundary
             if len(hdr) < 20:
-                raise StopIteration
-            magic, nrec, crc, comp, size = struct.unpack('<IIIII', hdr)
-            if magic != _MAGIC:
+                raise IOError(_TORN_MSG)
+            # validate magic BEFORE trusting the size field: a corrupt
+            # header must error now, not drive a multi-GiB read first
+            if struct.unpack_from('<I', hdr)[0] != _MAGIC:
                 raise IOError("bad recordio magic")
-            raw = self._f.read(size)
-            if (zlib.crc32(raw) & 0xFFFFFFFF) != crc:
-                raise IOError("recordio crc mismatch")
-            if comp == 2:
-                raw = zlib.decompress(raw)
-            elif comp != 0:
-                raise IOError("unsupported compressor %d" % comp)
-            self._buf = []
-            pos = 0
-            for _ in range(nrec):
-                (sz,) = struct.unpack_from('<I', raw, pos)
-                pos += 4
-                self._buf.append(raw[pos:pos + sz])
-                pos += sz
+            raw = self._f.read(struct.unpack('<IIIII', hdr)[4])
+            self._buf = _parse_chunk(hdr, raw)
             self._i = 0
         r = self._buf[self._i]
         self._i += 1
@@ -198,8 +194,119 @@ class Scanner(object):
             pass
 
 
-def write_recordio(path, records, compressor=0):
-    with Writer(path, compressor=compressor) as w:
+# torn tail = the file ends INSIDE a chunk (header or payload cut short):
+# a writer died mid-chunk. Silently treating it as EOF would truncate the
+# dataset without anyone noticing — fail loudly instead; the preceding
+# complete chunks are still readable (chunk_index/read_chunk).
+_TORN_MSG = ("torn recordio tail: file ends inside a chunk (writer died "
+             "mid-chunk?) — the trailing partial chunk is unreadable; "
+             "rewrite the file or truncate it to the last complete chunk "
+             "boundary (recordio.chunk_index reports it)")
+
+
+def _parse_chunk(hdr, raw):
+    """Validate one chunk (magic/size/crc/compressor) and split it into
+    records. `hdr` is the 20-byte header, `raw` the payload bytes as read
+    (possibly short on a torn tail)."""
+    magic, nrec, crc, comp, size = struct.unpack('<IIIII', hdr)
+    if magic != _MAGIC:
+        raise IOError("bad recordio magic")
+    if len(raw) < size:
+        raise IOError(_TORN_MSG)
+    if (zlib.crc32(raw) & 0xFFFFFFFF) != crc:
+        raise IOError("recordio crc mismatch")
+    if comp == 2:
+        raw = zlib.decompress(raw)
+    elif comp != 0:
+        raise IOError("unsupported compressor %d" % comp)
+    buf = []
+    pos = 0
+    for _ in range(nrec):
+        if pos + 4 > len(raw):
+            raise IOError("corrupt recordio chunk: record overruns payload")
+        (sz,) = struct.unpack_from('<I', raw, pos)
+        pos += 4
+        if pos + sz > len(raw):
+            raise IOError("corrupt recordio chunk: record overruns payload")
+        buf.append(raw[pos:pos + sz])
+        pos += sz
+    return buf
+
+
+class ChunkInfo(object):
+    """One seekable chunk of a recordio file: byte `offset` of its header,
+    `num_records` it holds, and `size` of its (compressed) payload."""
+
+    __slots__ = ('offset', 'num_records', 'size', 'compressor')
+
+    def __init__(self, offset, num_records, size, compressor):
+        self.offset = int(offset)
+        self.num_records = int(num_records)
+        self.size = int(size)
+        self.compressor = int(compressor)
+
+    def __repr__(self):
+        return ('ChunkInfo(offset=%d, num_records=%d, size=%d, '
+                'compressor=%d)' % (self.offset, self.num_records,
+                                    self.size, self.compressor))
+
+
+def chunk_index(path):
+    """Index the chunks of a recordio file WITHOUT decoding payloads:
+    header-only scan (20 bytes + one seek per chunk), so indexing a
+    multi-GB shard costs milliseconds. Returns [ChunkInfo, ...] — the
+    seek table that makes shards chunk-dispatchable (read_chunk) for the
+    sharded streaming reader. Raises IOError on a torn tail (writer died
+    mid-chunk) instead of silently dropping it."""
+    out = []
+    with open(path, 'rb') as f:
+        f.seek(0, os.SEEK_END)
+        end = f.tell()
+        off = 0
+        while off < end:
+            f.seek(off)
+            hdr = f.read(20)
+            if len(hdr) < 20:
+                raise IOError(_TORN_MSG)
+            magic, nrec, _crc, comp, size = struct.unpack('<IIIII', hdr)
+            if magic != _MAGIC:
+                raise IOError("bad recordio magic at offset %d" % off)
+            if off + 20 + size > end:
+                raise IOError(_TORN_MSG)
+            out.append(ChunkInfo(off, nrec, size, comp))
+            off += 20 + size
+    return out
+
+
+def read_chunk(path, offset):
+    """Read the records of ONE chunk at `offset` (from chunk_index) —
+    the random-access read path for sharded/chunk-granular dispatch; a
+    seek plus one bounded read, independent of file size."""
+    with open(path, 'rb') as f:
+        f.seek(int(offset))
+        hdr = f.read(20)
+        if len(hdr) < 20:
+            raise IOError(_TORN_MSG)
+        if struct.unpack_from('<I', hdr)[0] != _MAGIC:
+            raise IOError("bad recordio magic at offset %d (not a chunk "
+                          "boundary?)" % int(offset))
+        raw = f.read(struct.unpack('<IIIII', hdr)[4])
+    return _parse_chunk(hdr, raw)
+
+
+def is_recordio(path):
+    """True when `path` starts with the recordio chunk magic."""
+    try:
+        with open(path, 'rb') as f:
+            head = f.read(4)
+    except IOError:
+        return False
+    return len(head) == 4 and struct.unpack('<I', head)[0] == _MAGIC
+
+
+def write_recordio(path, records, compressor=0, max_chunk_bytes=1 << 20):
+    with Writer(path, compressor=compressor,
+                max_chunk_bytes=max_chunk_bytes) as w:
         for r in records:
             w.append(r)
 

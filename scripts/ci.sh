@@ -39,6 +39,9 @@ JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/donation_smoke.py
 echo "== crash-resume smoke (SIGKILL mid-epoch -> seconds-scale resume with bit/loss parity; chaos kill+corrupt rounds; checkpoint stall < 2%) =="
 JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/crash_resume_smoke.py
 
+echo "== data plane smoke (sharded streaming input: serial-vs-pooled feeder A/B >=3x with bit-identical epochs, exactly-once journal resume, host-stall < 2% on the smallnet loop) =="
+JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/data_plane_smoke.py
+
 echo "== slow tier (threaded stress, Poisson serving scenario) =="
 python -m pytest tests/ -q -m slow
 

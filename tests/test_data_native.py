@@ -46,6 +46,85 @@ def test_recordio_roundtrip_native_and_python(tmp_path):
     assert recordio.read_recordio(p3) == [b'rec%02d' % i for i in range(20)]
 
 
+def _force_python_codec(monkeypatch):
+    """Route recordio through the pure-Python fallback regardless of the
+    built .so (both engines must agree on every behavior)."""
+    monkeypatch.setattr(recordio, '_lib', None)
+    monkeypatch.setattr(recordio, '_lib_tried', True)
+
+
+def test_recordio_chunk_index_and_read_chunk(tmp_path):
+    """The seek table for sharded dispatch: header-only index, chunk
+    random access, and agreement with the sequential scan — for plain
+    and gzip chunks."""
+    recs = [b'r%03d' % i + b'y' * 40 for i in range(60)]
+    for comp in (0, 2):
+        p = str(tmp_path / ('idx%d.recordio' % comp))
+        recordio.write_recordio(p, recs, compressor=comp,
+                                max_chunk_bytes=200)
+        idx = recordio.chunk_index(p)
+        assert len(idx) > 3
+        assert sum(c.num_records for c in idx) == 60
+        assert idx[0].offset == 0
+        assert all(b.offset == a.offset + 20 + a.size
+                   for a, b in zip(idx, idx[1:]))
+        got = []
+        for c in idx:
+            chunk = recordio.read_chunk(p, c.offset)
+            assert len(chunk) == c.num_records
+            got.extend(chunk)
+        assert got == recs
+        assert recordio.is_recordio(p)
+    assert not recordio.is_recordio(str(tmp_path / 'missing'))
+
+
+@pytest.mark.parametrize('engine', ['native', 'python'])
+def test_recordio_torn_tail_is_loud(tmp_path, monkeypatch, engine):
+    """A writer that died mid-chunk leaves a torn tail. Reading it must
+    ERROR (IOError mentioning the torn tail), never silently truncate —
+    in the scanner, the chunk index, and the random-access chunk read;
+    the complete leading chunks stay readable."""
+    if engine == 'native':
+        if recordio._native() is None:
+            pytest.skip('native codec not built')
+    else:
+        _force_python_codec(monkeypatch)
+    recs = [b'rec%02d' % i + b'z' * 30 for i in range(20)]
+    p = str(tmp_path / 'whole.recordio')
+    recordio.write_recordio(p, recs, max_chunk_bytes=120)
+    with open(p, 'rb') as f:
+        data = f.read()
+    n_chunks = len(recordio.chunk_index(p))
+    assert n_chunks > 2
+
+    # torn payload: cut inside the last chunk's payload
+    p_torn = str(tmp_path / 'torn.recordio')
+    with open(p_torn, 'wb') as f:
+        f.write(data[:-9])
+    for fn in (lambda: recordio.read_recordio(p_torn),
+               lambda: recordio.chunk_index(p_torn)):
+        with pytest.raises(IOError, match='torn'):
+            fn()
+    # ... but every COMPLETE chunk before the tear still reads
+    idx = recordio.chunk_index(p)
+    assert recordio.read_chunk(p_torn, idx[0].offset) \
+        == recordio.read_chunk(p, idx[0].offset)
+    with pytest.raises(IOError, match='torn'):
+        recordio.read_chunk(p_torn, idx[-1].offset)
+
+    # torn header: a partial 20-byte header at EOF
+    p_hdr = str(tmp_path / 'tornhdr.recordio')
+    with open(p_hdr, 'wb') as f:
+        f.write(data + b'\x04\x03\x02\x01\x07')
+    with pytest.raises(IOError, match='torn'):
+        recordio.read_recordio(p_hdr)
+    with pytest.raises(IOError, match='torn'):
+        recordio.chunk_index(p_hdr)
+
+    # a clean file still ends with StopIteration, not an error
+    assert recordio.read_recordio(p) == recs
+
+
 def test_multislot_parse_native_matches_python():
     from paddle_tpu.async_executor import parse_multislot_lines
     slots = [{'name': 's0', 'type': 'uint64', 'is_dense': False,
