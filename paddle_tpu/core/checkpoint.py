@@ -43,9 +43,11 @@ import json
 import os
 import queue
 import shutil
+import signal as _signal
 import threading
 import time
 import warnings
+import weakref
 
 import numpy as np
 
@@ -56,10 +58,24 @@ except ImportError:          # non-POSIX: no advisory locking available
 
 _MANIFEST = 'MANIFEST.json'
 _COMMIT = 'COMMIT.json'
+_POD_COMMIT = 'POD_COMMIT.json'
 _JOURNAL = 'COMMITS.jsonl'
 _PREFIX = 'ckpt-'
+_HOST_PREFIX = 'host-'
 _TMP_PREFIX = '.tmp-'
+_HB_DIR = 'heartbeats'
+_BARRIER_DIR = 'barriers'
 _VERSION = 1
+
+
+def _program_uid(program):
+    """The step-counter key for a program. A CompiledProgram resolves to a
+    pass-optimized CLONE inside Executor.run (compiler._optimized_program)
+    whose fresh _uid would fork the rng step stream away from the one a
+    checkpoint recorded — the clone carries the RAW program's uid in
+    _ptpu_counter_uid so save/restore and the executor agree on one
+    counter."""
+    return getattr(program, '_ptpu_counter_uid', program._uid)
 
 # write-path indirection points: testing/faults.py wraps these to inject
 # ENOSPC/EIO without touching the filesystem layer for real
@@ -119,6 +135,43 @@ def _check_commit(path):
     if commit.get('manifest_sha256') != _sha256(manifest_raw):
         raise ValueError('COMMIT/MANIFEST digest mismatch')
     return manifest, commit
+
+
+def _stage_entries(tmp, entries, meta, commit_extra=None):
+    """Write `entries` — (fname, value, extra manifest fields) — into the
+    staging dir with per-file fsync + sha256-while-writing, then the
+    MANIFEST and COMMIT records. Shared by the single-host and pod
+    writers so the on-disk format cannot drift between them. Returns
+    (files, manifest_raw, commit)."""
+    from ..io import _serialize_tensor, _HashingFile
+    files = {}
+    for fname, value, extra in entries:
+        with _open_for_write(os.path.join(tmp, fname), 'wb') as f:
+            hf = _HashingFile(f)
+            _serialize_tensor(hf, value)
+            f.flush()
+            _fsync(f.fileno())
+        ent = {'sha256': hf.sha.hexdigest(), 'bytes': hf.nbytes}
+        if extra:
+            ent.update(extra)
+        files[fname] = ent
+    manifest_raw = json.dumps(
+        {'version': _VERSION, 'step': meta['step'], 'files': files,
+         'meta': meta}, indent=1, sort_keys=True).encode()
+    with _open_for_write(os.path.join(tmp, _MANIFEST), 'wb') as f:
+        f.write(manifest_raw)
+        f.flush()
+        _fsync(f.fileno())
+    commit = {'step': meta['step'],
+              'manifest_sha256': _sha256(manifest_raw),
+              'wall_time': meta['wall_time']}
+    if commit_extra:
+        commit.update(commit_extra)
+    with _open_for_write(os.path.join(tmp, _COMMIT), 'wb') as f:
+        f.write(json.dumps(commit).encode())
+        f.flush()
+        _fsync(f.fileno())
+    return files, manifest_raw, commit
 
 
 def _read_shard(path, name, ent):
@@ -306,7 +359,7 @@ class CheckpointManager(object):
             'version': _VERSION,
             'step': int(step),
             'executor_step': int(
-                executor._step_counters.get(program._uid, step))
+                executor._step_counters.get(_program_uid(program), step))
             if executor is not None else int(step),
             'wall_time': time.time(),
             'random_seed': getattr(program, 'random_seed', 0),
@@ -369,7 +422,8 @@ class CheckpointManager(object):
                     with self._stats_lock:
                         self.stats['last_error'] = '%s: %s' % (
                             type(e).__name__, e)
-                    if attempt < self.max_retries:
+                    if attempt < self.max_retries \
+                            and not getattr(e, 'no_retry', False):
                         with self._stats_lock:
                             self.stats['retries'] += 1
                         backoff = self.retry_backoff_s * (2 ** attempt)
@@ -386,9 +440,10 @@ class CheckpointManager(object):
                         warnings.warn(
                             'checkpoint step %d ABANDONED after %d retries '
                             '(%s: %s); training continues on the previous '
-                            'checkpoint' % (meta['step'], self.max_retries,
+                            'checkpoint' % (meta['step'], attempt,
                                             type(e).__name__, e),
                             RuntimeWarning)
+                        break
             with self._stats_lock:
                 self.stats['write_s'] += time.perf_counter() - t0
             self._idle.set()
@@ -397,7 +452,6 @@ class CheckpointManager(object):
         """One atomic checkpoint: stage dir -> shards (fsync each, sha256
         while writing) -> MANIFEST -> COMMIT -> one os.replace makes it
         live -> flock-journaled commit record -> retention."""
-        from ..io import _serialize_tensor, _HashingFile
         from .lod import LoDArray
         step = meta['step']
         final = os.path.join(self.dirname, '%s%d' % (_PREFIX, step))
@@ -408,30 +462,13 @@ class CheckpointManager(object):
             shutil.rmtree(tmp)
         os.makedirs(tmp)
         try:
-            files = {}
-            for name, (arr, lod) in sorted(state.items()):
-                value = LoDArray(arr, [np.asarray(l, np.int32)
-                                       for l in lod]) if lod else arr
-                with _open_for_write(os.path.join(tmp, name), 'wb') as f:
-                    hf = _HashingFile(f)
-                    _serialize_tensor(hf, value)
-                    f.flush()
-                    _fsync(f.fileno())
-                files[name] = {'sha256': hf.sha.hexdigest(),
-                               'bytes': hf.nbytes}
-            manifest_raw = json.dumps(
-                {'version': _VERSION, 'step': step, 'files': files,
-                 'meta': meta}, indent=1, sort_keys=True).encode()
-            with _open_for_write(os.path.join(tmp, _MANIFEST), 'wb') as f:
-                f.write(manifest_raw)
-                f.flush()
-                _fsync(f.fileno())
-            commit = {'step': step, 'manifest_sha256': _sha256(manifest_raw),
-                      'wall_time': meta['wall_time']}
-            with _open_for_write(os.path.join(tmp, _COMMIT), 'wb') as f:
-                f.write(json.dumps(commit).encode())
-                f.flush()
-                _fsync(f.fileno())
+            entries = [(name,
+                        LoDArray(arr, [np.asarray(l, np.int32)
+                                       for l in lod]) if lod else arr,
+                        None)
+                       for name, (arr, lod) in sorted(state.items())]
+            files, _manifest_raw, commit = _stage_entries(tmp, entries,
+                                                          meta)
             if os.path.isdir(final):        # re-checkpoint of a resumed step
                 shutil.rmtree(final)
             os.replace(tmp, final)          # THE commit point
@@ -448,6 +485,12 @@ class CheckpointManager(object):
             warnings.warn('checkpoint step %d committed but journal/'
                           'retention failed: %s' % (step, e), RuntimeWarning)
         return nbytes
+
+    def _retention_victims(self, live):
+        """Which (step, path) entries retention evicts: everything beyond
+        the newest keep_last_n. The pod manager overrides this — only
+        POD-COMMITTED checkpoints may count toward the keep budget."""
+        return live[:-self.keep_last_n]
 
     @staticmethod
     def _fsync_dir(path):
@@ -474,7 +517,7 @@ class CheckpointManager(object):
             evicted = []
             if self.keep_last_n is not None:
                 live = list_checkpoints(self.dirname)
-                for old_step, old_path in live[:-self.keep_last_n]:
+                for old_step, old_path in self._retention_victims(live):
                     shutil.rmtree(old_path, ignore_errors=True)
                     evicted.append(old_step)
                     jf.write(json.dumps({'event': 'evict',
@@ -512,7 +555,7 @@ class CheckpointManager(object):
                 continue
             meta = manifest.get('meta', {})
             if executor is not None and program is not None:
-                executor._step_counters[program._uid] = int(
+                executor._step_counters[_program_uid(program)] = int(
                     meta.get('executor_step', step))
             self._last_step = step
             self._last_time = time.monotonic()
@@ -556,3 +599,1016 @@ class CheckpointManager(object):
                     'since the checkpoint was written?)' % (path, missing),
                     RuntimeWarning)
         return {'loaded': loaded, 'missing': missing}
+
+
+# ===========================================================================
+# Graceful preemption (ISSUE 10 satellite)
+# ===========================================================================
+# A preemption notice (SIGTERM from the cluster scheduler) must not become
+# a SIGKILL-style crash: the trainer drains ONE final checkpoint at the
+# next step boundary — params, step counter, and the elastic data-journal
+# position all describing the same history — and exits 0 so the
+# supervisor restarts it into a clean resume with nothing to replay.
+_preempt = threading.Event()
+
+
+def request_preemption(signum=None, frame=None):
+    """Mark the process as preempted. Signal-handler-safe (only sets an
+    Event); the drain happens at the next step boundary on the training
+    thread, never inside the handler."""
+    _preempt.set()
+
+
+def preemption_requested():
+    return _preempt.is_set()
+
+
+def clear_preemption():
+    _preempt.clear()
+
+
+def install_preemption_handler(signum=None):
+    """Route SIGTERM (or another signal) to request_preemption. Returns
+    the previous handler. Main-thread only (signal module contract)."""
+    signum = _signal.SIGTERM if signum is None else signum
+    return _signal.signal(signum, request_preemption)
+
+
+def maybe_drain_preemption(manager, executor, program, scope, step):
+    """Called by Executor.run_steps/run at a step boundary after the
+    checkpoint policy ran. When a preemption was requested: write one
+    final BLOCKING checkpoint (unless this boundary just snapshotted this
+    exact step — then only wait the in-flight write out), close the
+    manager, and exit 0. No-op (returns False) otherwise."""
+    if manager is None or not _preempt.is_set():
+        return False
+    warnings.warn(
+        'preemption requested — draining a final checkpoint at step %d '
+        'and exiting 0 (resume continues bit-exactly from here)' % step,
+        RuntimeWarning)
+    if manager._last_step == step:
+        # this boundary already snapshotted step N; let the writer land it
+        manager.flush()
+        if manager.stats['failed'] == 0 and manager.stats['commits'] > 0:
+            manager.close()
+            raise SystemExit(0)
+        # the in-flight write was abandoned: fall through and force one
+    commits_before = manager.stats['commits']
+    manager.save(program, scope, step, executor=executor, blocking=True)
+    drained = manager.stats['commits'] > commits_before
+    manager.close()
+    if not drained:
+        # the forced final write was itself abandoned (persistent
+        # ENOSPC/EIO): exiting 0 would tell the supervisor the drain
+        # succeeded and silently lose every step since the last commit
+        warnings.warn(
+            'preemption drain FAILED: the final checkpoint at step %d '
+            'was abandoned (%s) — exiting 1 so the supervisor knows the '
+            'resume point is older than this boundary'
+            % (step, manager.stats['last_error']), RuntimeWarning)
+        raise SystemExit(1)
+    raise SystemExit(0)
+
+
+# ===========================================================================
+# Pod-scale fault tolerance (ISSUE 10 tentpole)
+# ===========================================================================
+# Multihost composed-mesh training adds three failure problems the
+# single-host manager above cannot see:
+#   * state is GLOBAL (one jax.Array spans every host) — no single process
+#     can snapshot it, so each host writes only its mesh-local shards and
+#     a checkpoint is the UNION of per-host shard sets;
+#   * a checkpoint is only usable when EVERY host's shards landed — the
+#     commit point must be pod-level, not per-host (two-phase: host
+#     manifests first, then ONE coordinator POD_COMMIT naming each
+#     manifest sha);
+#   * a dead host leaves survivors blocked inside a cross-host collective
+#     that no Python exception can interrupt — failure detection is
+#     filesystem heartbeats plus a watchdog whose only safe remedy is a
+#     bounded-time process exit (the pod supervisor restarts the whole
+#     pod, which resumes in seconds off the warm compile cache).
+class BarrierTimeout(RuntimeError):
+    """A cross-host barrier did not complete within its deadline; the
+    message names the missing ranks."""
+
+
+class PodCommitTimeout(RuntimeError):
+    """Phase 2 of a pod checkpoint did not complete: a host manifest
+    (coordinator side) or the POD_COMMIT record (every other rank) never
+    appeared — peer dead, writer busy (SKIP marker), or coordinator
+    abandon. The writer loop abandons immediately (no_retry): retrying
+    would hold the writer busy for multiples of commit_timeout_s, which
+    is exactly what desynchronizes the pod's checkpoint schedule."""
+    no_retry = True
+
+
+_BARRIER_GC_TTL_S = 600.0
+
+
+def _gc_barriers(bdir, ttl_s=_BARRIER_GC_TTL_S):
+    """Best-effort unlink of marker files older than ttl_s. Any barrier
+    is deadline-bounded (timeout_s), so a marker this old belongs to a
+    completed or abandoned synchronization point — without GC the dir
+    grows one inode per host per barrier forever, and a dead
+    incarnation's stale markers could instantly satisfy a reused name."""
+    now = time.time()
+    try:
+        names = os.listdir(bdir)
+    except OSError:
+        return
+    for fname in names:
+        path = os.path.join(bdir, fname)
+        try:
+            if now - os.path.getmtime(path) > ttl_s:
+                os.unlink(path)
+        except OSError:
+            pass
+
+
+def fs_barrier(dirname, name, rank, num_hosts, timeout_s=60.0,
+               poll_s=0.02):
+    """Filesystem barrier with a bounded wait: each rank touches a marker
+    file and waits for all num_hosts markers. Returns the seconds spent
+    waiting; raises BarrierTimeout naming the ranks that never arrived
+    (the survivors' bounded-time alternative to hanging forever where an
+    in-graph collective would block uninterruptibly). `name` must be
+    unique per synchronization point (include the step / run id —
+    PodCheckpointManager.barrier salts with the run_id for you); markers
+    older than _BARRIER_GC_TTL_S (10 min) are garbage-collected on
+    entry."""
+    bdir = os.path.join(dirname, _BARRIER_DIR)
+    os.makedirs(bdir, exist_ok=True)
+    # TTL scales with the deadline: markers of a barrier whose timeout
+    # exceeds the default TTL must not be GC'd out from under a rank
+    # that is still legitimately waiting
+    _gc_barriers(bdir, ttl_s=max(_BARRIER_GC_TTL_S, 2 * float(timeout_s)))
+    mark = os.path.join(bdir, '%s.%s%d' % (name, _HOST_PREFIX, rank))
+    with open(mark, 'w') as f:
+        f.write(str(os.getpid()))
+    t0 = time.monotonic()
+    deadline = t0 + float(timeout_s)
+    while True:
+        present = [r for r in range(num_hosts) if os.path.exists(
+            os.path.join(bdir, '%s.%s%d' % (name, _HOST_PREFIX, r)))]
+        if len(present) == num_hosts:
+            return time.monotonic() - t0
+        if time.monotonic() > deadline:
+            missing = sorted(set(range(num_hosts)) - set(present))
+            raise BarrierTimeout(
+                'barrier %r timed out after %.1fs: hosts %r never arrived '
+                '(dead or wedged — restart the pod)'
+                % (name, float(timeout_s), missing))
+        time.sleep(poll_s)
+
+
+def heartbeat_path(dirname, rank):
+    return os.path.join(dirname, _HB_DIR, '%s%d.json' % (_HOST_PREFIX,
+                                                         rank))
+
+
+def write_heartbeat(dirname, rank, payload=None):
+    """Refresh this host's heartbeat file (atomic replace; the mtime is
+    the liveness signal, the JSON payload carries pod-health stats for
+    profiler.training_report's pod table). flock-free by design: a hung
+    NFS lock must never be able to stall the writer thread."""
+    hb_dir = os.path.join(dirname, _HB_DIR)
+    os.makedirs(hb_dir, exist_ok=True)
+    path = heartbeat_path(dirname, rank)
+    tmp = '%s.%d.tmp' % (path, os.getpid())
+    rec = dict(payload or {})
+    rec.setdefault('rank', int(rank))
+    rec.setdefault('pid', os.getpid())
+    rec['time'] = time.time()
+    with open(tmp, 'w') as f:
+        f.write(json.dumps(rec))
+    os.replace(tmp, path)
+    return path
+
+
+def read_heartbeats(dirname, num_hosts=None):
+    """{rank: heartbeat payload + 'age_s'} for every heartbeat file (or
+    the first num_hosts ranks). Unparseable files (torn write race) come
+    back as {'age_s': age} only."""
+    hb_dir = os.path.join(dirname, _HB_DIR)
+    out = {}
+    if not os.path.isdir(hb_dir):
+        return out
+    now = time.time()
+    for fname in os.listdir(hb_dir):
+        if not (fname.startswith(_HOST_PREFIX) and fname.endswith('.json')):
+            continue
+        try:
+            rank = int(fname[len(_HOST_PREFIX):-len('.json')])
+        except ValueError:
+            continue
+        if num_hosts is not None and rank >= num_hosts:
+            continue
+        path = os.path.join(hb_dir, fname)
+        try:
+            age = now - os.path.getmtime(path)
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            try:
+                rec, age = {}, now - os.path.getmtime(path)
+            except OSError:
+                continue
+        rec['age_s'] = age
+        out[rank] = rec
+    return out
+
+
+def stale_hosts(dirname, num_hosts, timeout_s, run_id=None):
+    """Ranks considered dead: heartbeat file missing entirely, stale by
+    mtime, or (when run_id is given) still carrying a PREVIOUS
+    incarnation's run id — a restarted pod must not trust a corpse's
+    last heartbeat."""
+    beats = read_heartbeats(dirname, num_hosts)
+    dead = []
+    for r in range(int(num_hosts)):
+        rec = beats.get(r)
+        if rec is None or rec.get('age_s', 1e18) > float(timeout_s) \
+                or (run_id is not None
+                    and rec.get('run_id') not in (None, run_id)):
+            dead.append(r)
+    return dead
+
+
+class HostWatchdog(object):
+    """Bounded-time failure detection for pod members. A survivor whose
+    peer died mid-step is blocked inside a cross-host collective that no
+    Python exception can interrupt, so the default remedy is a hard
+    process exit (action='exit', os._exit) — the pod supervisor then
+    restarts the WHOLE pod, which resumes from the newest pod-committed
+    checkpoint in seconds via the warm compile cache.
+
+        wd = HostWatchdog(ckpt_dir, rank=r, num_hosts=n, timeout_s=10,
+                          run_id=run_id).start()
+
+    action: 'exit' (default) | 'warn' | a callable(dead_ranks). A peer is
+    only judged once it has heartbeat at least once under THIS run_id (or
+    after grace_s, covering a host that died before its first beat).
+    """
+
+    def __init__(self, dirname, rank, num_hosts, timeout_s=10.0,
+                 poll_s=0.25, grace_s=60.0, action='exit', exit_code=3,
+                 run_id=None):
+        self.dirname = dirname
+        self.rank = int(rank)
+        self.num_hosts = int(num_hosts)
+        self.timeout_s = float(timeout_s)
+        self.poll_s = float(poll_s)
+        self.grace_s = float(grace_s)
+        self.action = action
+        self.exit_code = int(exit_code)
+        self.run_id = run_id
+        self.dead = set()
+        self._seen = set()
+        self._departed = {}    # rank -> when its done tombstone was seen
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop,
+                                        name='ptpu-pod-watchdog',
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _loop(self):
+        t0 = time.monotonic()
+        while not self._stop.wait(self.poll_s):
+            beats = read_heartbeats(self.dirname, self.num_hosts)
+            dead = []
+            for r in range(self.num_hosts):
+                if r == self.rank:
+                    continue
+                rec = beats.get(r)
+                fresh = rec is not None and (
+                    self.run_id is None
+                    or rec.get('run_id') in (None, self.run_id))
+                if fresh and rec.get('done'):
+                    # clean-shutdown tombstone (manager.close()): the
+                    # peer FINISHED — never a death, but a pod missing a
+                    # member cannot complete another collective, so a
+                    # host still running timeout_s after a peer departed
+                    # is wedged (e.g. staggered preemption: the departed
+                    # host drained at a boundary this one never reached)
+                    # and exits through the same bounded path
+                    first = self._departed.setdefault(r, time.monotonic())
+                    if time.monotonic() - first > self.timeout_s:
+                        dead.append(r)
+                    continue
+                if fresh:
+                    self._seen.add(r)
+                    if rec.get('age_s', 0.0) > self.timeout_s:
+                        dead.append(r)
+                elif r in self._seen or (time.monotonic() - t0
+                                         > self.grace_s + self.timeout_s):
+                    dead.append(r)   # beat once then vanished, or never
+                    # produced a fresh beat within the whole grace window
+            new = [r for r in dead if r not in self.dead]
+            if not new:
+                continue
+            self.dead.update(new)
+            msg = ('pod host(s) %r stopped heartbeating (> %.1fs stale) — '
+                   'detected by host %d' % (sorted(self.dead),
+                                            self.timeout_s, self.rank))
+            if callable(self.action):
+                warnings.warn(msg, RuntimeWarning)
+                self.action(set(self.dead))
+            elif self.action == 'exit':
+                # stderr directly: os._exit skips atexit AND io flushing,
+                # and this line is the post-mortem breadcrumb
+                import sys
+                sys.stderr.write('FATAL: %s; exiting %d so the pod can '
+                                 'restart\n' % (msg, self.exit_code))
+                sys.stderr.flush()
+                os._exit(self.exit_code)
+            else:
+                warnings.warn(msg, RuntimeWarning)
+
+
+def _norm_index(idx, shape):
+    """A shard's index (tuple of slices, possibly open-ended) normalized
+    to a hashable ((start, stop), ...) per dim."""
+    out = []
+    for s, dim in zip(idx, shape):
+        start = 0 if s.start is None else int(s.start)
+        stop = int(dim) if s.stop is None else int(s.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+def pod_verify(path, num_hosts=None):
+    """Verify one pod checkpoint dir's two-phase commit: POD_COMMIT
+    present and parseable, the pod shape matching `num_hosts`, and every
+    named host manifest present with its COMMIT digest matching the sha
+    the POD_COMMIT recorded. Shard bytes are NOT read here — they verify
+    on the read that loads them. Returns (pod_commit, {rank: manifest});
+    raises ValueError with a precise reason."""
+    pc_path = os.path.join(path, _POD_COMMIT)
+    if not os.path.exists(pc_path):
+        raise ValueError('no POD_COMMIT record (partial pod checkpoint: a '
+                         'host died before the coordinator could commit)')
+    try:
+        with open(pc_path) as f:
+            pod = json.load(f)
+    except ValueError:
+        raise ValueError('POD_COMMIT is not valid JSON (torn write?)')
+    hosts = pod.get('hosts', {})
+    if num_hosts is not None and int(pod.get('num_hosts', -1)) \
+            != int(num_hosts):
+        raise ValueError('pod shape changed: checkpoint was written by %s '
+                         'hosts, this pod has %d (resharding a checkpoint '
+                         'is not supported)' % (pod.get('num_hosts'),
+                                                int(num_hosts)))
+    manifests = {}
+    for r_str, sha in sorted(hosts.items()):
+        host_dir = os.path.join(path, '%s%s' % (_HOST_PREFIX, r_str))
+        manifest, commit = _check_commit(host_dir)
+        if commit.get('manifest_sha256') != sha:
+            raise ValueError('host %s manifest does not match the '
+                             'POD_COMMIT record (mixed-incarnation '
+                             'checkpoint?)' % r_str)
+        manifests[int(r_str)] = manifest
+    return pod, manifests
+
+
+def _warn_skip(path, why):
+    warnings.warn(
+        'pod checkpoint %s is not restorable: %s — skipping it and '
+        'falling back to an older checkpoint' % (path, why),
+        RuntimeWarning)
+
+
+def _pod_candidates(dirname, num_hosts=None):
+    """Newest-first (step, path, pod_commit, {rank: manifest}) over every
+    pod checkpoint passing two-phase-commit verification. Partial pods
+    (missing POD_COMMIT, missing/mismatched host manifests) are skipped
+    with a LOUD warning, exactly like single-host corrupt entries."""
+    for step, path in reversed(list_checkpoints(dirname)):
+        try:
+            pod, manifests = pod_verify(path, num_hosts)
+        except (ValueError, OSError) as e:
+            _warn_skip(path, e)
+            continue
+        yield step, path, pod, manifests
+
+
+def pod_latest_committed(dirname, num_hosts=None):
+    """Newest pod checkpoint passing two-phase-commit verification, as
+    (step, path, pod_commit, {rank: manifest}) — or None."""
+    return next(_pod_candidates(dirname, num_hosts), None)
+
+
+class PodCheckpointManager(CheckpointManager):
+    """Sharded crash-consistent checkpointing for a multi-process pod.
+
+        mgr = PodCheckpointManager(dirname, rank=jax.process_index(),
+                                   num_hosts=jax.process_count(),
+                                   every_steps=100, run_id=run_id)
+        info = mgr.restore(executor=exe, program=prog)   # all ranks
+        ...
+        exe.run(prog, feed=feed, fetch_list=[loss], checkpoint=mgr)
+
+    Two-phase commit over the shared filesystem:
+
+    phase 1 (every host): snapshot only the mesh-local param/state shards
+    this process OWNS (for each distinct shard index of a global array,
+    the owner is the lowest process_index holding it — replicated state
+    is written once, by the coordinator), stage them with per-shard
+    sha256 manifests exactly like the single-host writer, and make the
+    host's shard set live with ONE atomic rename into
+    ckpt-<step>/host-<rank>/.
+
+    phase 2 (coordinator, rank 0): wait (bounded by commit_timeout_s) for
+    every host manifest of THIS run_id to land and verify, then write one
+    POD_COMMIT record naming each host manifest's sha — the pod-level
+    commit point. restore() only ever loads checkpoints whose POD_COMMIT
+    covers all hosts with matching digests; partial pods (a host died
+    mid-write, a stale dir from a previous incarnation) are skipped with
+    a loud warning, never loaded.
+
+    run_id distinguishes incarnations: after a kill-and-restart at the
+    same step, a stale host dir from the dead run must never be stitched
+    together with fresh shards into a Frankenstein checkpoint — the
+    coordinator only counts manifests carrying its own run_id.
+
+    The writer thread doubles as the liveness signal: a heartbeat file
+    per host (mtime-refreshed every heartbeat_interval_s, flock-free,
+    payload carrying ckpt-stall/barrier/commit stats for the profiler's
+    pod table). Pair with HostWatchdog for bounded-time failure
+    detection on the training side.
+
+    Policy note: only the step-deterministic every_steps policy is
+    supported (wall-clock policies desynchronize the snapshot step
+    across hosts). One host skipping a due boundary because its writer
+    is still busy (stats['skipped_busy']) costs the pod THAT checkpoint
+    — the coordinator abandons it loudly after commit_timeout_s and the
+    next boundary tries again; older committed pods stay restorable.
+    """
+
+    def __init__(self, dirname, rank, num_hosts, keep_last_n=3,
+                 every_steps=None, every_seconds=None, max_retries=3,
+                 retry_backoff_s=0.25, task_service=None,
+                 commit_timeout_s=60.0, heartbeat_interval_s=0.5,
+                 run_id=None):
+        self.rank = int(rank)
+        self.num_hosts = int(num_hosts)
+        if not (0 <= self.rank < self.num_hosts):
+            raise ValueError('rank %d outside pod of %d hosts'
+                             % (self.rank, self.num_hosts))
+        if every_seconds is not None:
+            # wall-clock policies fire at different steps on different
+            # hosts, and the two-phase commit needs every host at the
+            # SAME step — the coordinator would wait commit_timeout_s for
+            # a manifest that never comes and abandon every checkpoint
+            raise ValueError(
+                'PodCheckpointManager does not support every_seconds: '
+                'per-host clocks desynchronize the snapshot step across '
+                'the pod; use every_steps (deterministic on every host)')
+        self.commit_timeout_s = float(commit_timeout_s)
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        self.run_id = run_id if run_id is not None \
+            else os.environ.get('PTPU_POD_RUN_ID')
+        if self.run_id is None:
+            # without an incarnation token the phase-2 stale filter has
+            # nothing to compare — a restarted pod could stitch a dead
+            # incarnation's host dir into POD_COMMIT (or commit a sha the
+            # live host is about to overwrite)
+            raise ValueError(
+                'PodCheckpointManager needs a run_id shared by every '
+                'host of THIS incarnation: pass run_id='
+                'paddle_tpu.parallel.pod_run_id() or set PTPU_POD_RUN_ID')
+        self._executor_ref = None
+        super(PodCheckpointManager, self).__init__(
+            dirname, keep_last_n=keep_last_n, every_steps=every_steps,
+            every_seconds=every_seconds, max_retries=max_retries,
+            retry_backoff_s=retry_backoff_s, task_service=task_service)
+        self.stats.update({'pod_commits': 0, 'pod_abandoned': 0,
+                           'barrier_wait_s': 0.0})
+        self._hb_stop = threading.Event()
+        self._hb_thread = threading.Thread(target=self._hb_loop,
+                                           name='ptpu-pod-heartbeat',
+                                           daemon=True)
+        self._hb_thread.start()
+        self._register_pod_source()
+
+    # -- heartbeat / pod-health surface --------------------------------
+    def _hb_payload(self):
+        p = {'rank': self.rank, 'run_id': self.run_id,
+             'step': self._last_step if self._last_step is not None else 0}
+        with self._stats_lock:
+            p.update(commits=self.stats['commits'],
+                     failed=self.stats['failed'],
+                     pod_abandoned=self.stats.get('pod_abandoned', 0),
+                     ckpt_stall_ms=self.stats['stall_s'] * 1e3,
+                     barrier_ms=self.stats.get('barrier_wait_s', 0.0) * 1e3)
+        ex = self._executor_ref() if self._executor_ref is not None else None
+        if ex is not None:
+            st = ex._dispatch_stats
+            if st.get('run_s'):
+                p['ckpt_stall_pct'] = (100.0 * st['ckpt_stall_s']
+                                       / st['run_s'])
+                p['host_stall_pct'] = (100.0 * st['host_stall_s']
+                                       / st['run_s'])
+        return p
+
+    def _hb_loop(self):
+        while True:
+            try:
+                write_heartbeat(self.dirname, self.rank, self._hb_payload())
+            except OSError:
+                pass      # a full/unreachable fs must not kill liveness
+            if self._hb_stop.wait(self.heartbeat_interval_s):
+                return
+
+    def _register_pod_source(self):
+        try:
+            from .. import profiler as _profiler
+        except ImportError:
+            return            # standalone module load (tools/chaos.py)
+        ref = weakref.ref(self)
+        name = 'pod@%s' % os.path.basename(os.path.abspath(self.dirname))
+
+        def snap():
+            mgr = ref()
+            if mgr is None:
+                _profiler.unregister_pod_source(name)
+                raise ReferenceError('pod manager collected')
+            return {'num_hosts': mgr.num_hosts, 'rank': mgr.rank,
+                    'hosts': read_heartbeats(mgr.dirname, mgr.num_hosts)}
+        _profiler.register_pod_source(name, snap)
+        self._pod_source_name = name
+
+    def barrier(self, name, timeout_s=None):
+        """fs_barrier over this pod's checkpoint dir, salted with the
+        run_id (markers left by a dead incarnation can never satisfy a
+        restarted pod's barrier), accounted into stats['barrier_wait_s']
+        (the profiler pod table's barrier column)."""
+        waited = fs_barrier(self.dirname, '%s.%s' % (self.run_id, name),
+                            self.rank, self.num_hosts,
+                            timeout_s=timeout_s if timeout_s is not None
+                            else self.commit_timeout_s)
+        with self._stats_lock:
+            self.stats['barrier_wait_s'] += waited
+        return waited
+
+    def step_boundary(self, executor, program, scope, step):
+        """Pod boundaries are a PURE FUNCTION of the step (step %%
+        every_steps == 0), never of this host's last-snapshot drift: the
+        base class's `step - _last_step >= every` rule lets one busy
+        host slide onto a different schedule than its peers, after which
+        every checkpoint has a missing manifest and times out. A host
+        that IS busy at a due boundary declines loudly — a SKIP marker
+        in the pod dir — so the coordinator abandons that checkpoint
+        immediately instead of waiting commit_timeout_s for a manifest
+        that will never come."""
+        self._executor_ref = weakref.ref(executor)
+        if self.every_steps is None:
+            return 0.0
+        if step % self.every_steps != 0 or step == self._last_step:
+            return 0.0
+        if not self._idle.is_set() or not self._jobs.empty():
+            self._mark_skip(step)
+        return self.save(program, scope, step, executor=executor)
+
+    def _mark_skip(self, step):
+        """Tell the pod this host declines the checkpoint at `step`
+        (writer still busy): peers abandon it in bounded-short time."""
+        pod_dir = os.path.join(self.dirname, '%s%d' % (_PREFIX, step))
+        try:
+            os.makedirs(pod_dir, exist_ok=True)
+            with open(os.path.join(
+                    pod_dir, 'SKIP.%s%d' % (_HOST_PREFIX, self.rank)),
+                    'w') as f:
+                f.write(json.dumps({'rank': self.rank,
+                                    'run_id': self.run_id}))
+        except OSError:
+            pass     # peers fall back to the commit timeout
+
+    def _skip_marker(self, step, rank):
+        """True when `rank` declined the checkpoint at `step` under THIS
+        run_id (stale markers from a dead incarnation are ignored)."""
+        path = os.path.join(self.dirname, '%s%d' % (_PREFIX, step),
+                            'SKIP.%s%d' % (_HOST_PREFIX, rank))
+        try:
+            with open(path) as f:
+                return json.load(f).get('run_id') == self.run_id
+        except (OSError, ValueError):
+            return False
+
+    def close(self):
+        if self._closed:
+            return
+        # drain the writer FIRST, heartbeat still beating: the final
+        # blocking write (plus the phase-2 wait, up to commit_timeout_s)
+        # can outlast any watchdog timeout — going silent before it
+        # finishes would get still-training peers hard-exited
+        super(PodCheckpointManager, self).close()
+        self._hb_stop.set()
+        self._hb_thread.join(timeout=5)
+        try:
+            # clean-shutdown tombstone: peers' watchdogs must be able to
+            # tell a host that FINISHED from one that died — without it,
+            # the first host to close would stop heartbeating and get
+            # every survivor hard-exited mid final write
+            write_heartbeat(self.dirname, self.rank,
+                            dict(self._hb_payload(), done=True))
+        except OSError:
+            pass
+        if getattr(self, '_pod_source_name', None):
+            try:
+                from .. import profiler as _profiler
+                _profiler.unregister_pod_source(self._pod_source_name)
+            except ImportError:
+                pass
+
+    # -- sharded snapshot ----------------------------------------------
+    def _owned_shards(self, arr):
+        """{normalized index: device shard} for every distinct shard of a
+        global array that THIS process owns. Ownership: the lowest
+        process_index among the devices holding that exact index — so
+        each distinct piece of the array is written exactly once across
+        the pod, and fully-replicated state is written only by rank 0."""
+        shape = arr.shape
+        owner = {}
+        for d, idx in arr.sharding.devices_indices_map(shape).items():
+            key = _norm_index(idx, shape)
+            p = int(d.process_index)
+            if key not in owner or p < owner[key]:
+                owner[key] = p
+        mine = {}
+        for sh in arr.addressable_shards:
+            key = _norm_index(sh.index, shape)
+            if owner.get(key) == self.rank and key not in mine:
+                mine[key] = sh.data
+        return mine
+
+    def _snapshot_state(self, program, scope):
+        """Mesh-local snapshot: global arrays contribute only the shards
+        this process owns; host-local values (startup numpy, LoD state —
+        identical on every host by SPMD construction) are written by the
+        coordinator alone. Same stall discipline as the base class: D2H
+        started async for every owned shard first, then one blocking copy
+        each — the copy is mandatory, the next dispatch donates."""
+        from .lod import unwrap, lod_of
+        names = [v.name for v in program.list_vars() if v.persistable]
+        vals = [(n, scope.get(n)) for n in sorted(set(names))]
+        vals = [(n, v) for n, v in vals if v is not None]
+        plan = []
+        for n, v in vals:
+            data = unwrap(v)
+            if getattr(data, 'is_fully_addressable', True):
+                if self.rank == 0:
+                    plan.append((n, 'full', v, data))
+            else:
+                shards = self._owned_shards(data)
+                if shards:
+                    plan.append((n, 'shards', v, shards))
+        for _n, kind, _v, payload in plan:   # start every D2H first
+            targets = [payload] if kind == 'full' else payload.values()
+            for t in targets:
+                start = getattr(t, 'copy_to_host_async', None)
+                if start is not None:
+                    try:
+                        start()
+                    except Exception:
+                        pass            # best-effort prefetch only
+        out = {}
+        for n, kind, v, payload in plan:
+            if kind == 'full':
+                arr = np.array(unwrap(v), copy=True)
+                lod = [np.asarray(l).tolist() for l in lod_of(v)]
+                out[n] = ('full', arr, lod)
+            else:
+                shards = {key: np.array(data, copy=True)
+                          for key, data in sorted(payload.items())}
+                gshape = tuple(int(d) for d in unwrap(v).shape)
+                out[n] = ('shards', shards, gshape)
+        return out
+
+    # -- two-phase write ------------------------------------------------
+    def _write_checkpoint(self, state, meta):
+        """Phase 1 for this host (stage shards -> MANIFEST -> COMMIT ->
+        one atomic rename into ckpt-<step>/host-<rank>), then phase 2 on
+        the coordinator (wait for every host manifest of this run_id,
+        write POD_COMMIT, journal + retention)."""
+        from ..io import _serialize_tensor, _HashingFile
+        from .lod import LoDArray
+        step = meta['step']
+        meta = dict(meta, rank=self.rank, num_hosts=self.num_hosts,
+                    run_id=self.run_id, pod=True)
+        pod_dir = os.path.join(self.dirname, '%s%d' % (_PREFIX, step))
+        if os.path.exists(os.path.join(pod_dir, _POD_COMMIT)):
+            try:
+                pod_verify(pod_dir, self.num_hosts)
+                committed = True
+            except (ValueError, OSError):
+                committed = False
+            if committed:
+                # a FULLY pod-committed checkpoint at this step already
+                # exists (idempotent re-save after a no-train resume, or
+                # a restarted incarnation reaching the same boundary) —
+                # unlike the single-host writer's whole-dir replace,
+                # rewriting host dirs in place is NOT atomic across the
+                # pod: a peer mid-restore would see mixed incarnations,
+                # and an abandoned rewrite would destroy the newest good
+                # checkpoint. Keep the committed one; it describes the
+                # same training history.
+                return 0
+        host_dir = os.path.join(pod_dir, '%s%d' % (_HOST_PREFIX, self.rank))
+        tmp = os.path.join(self.dirname, '%spod-%d.h%d.%d' % (
+            _TMP_PREFIX, step, self.rank, os.getpid()))
+        os.makedirs(self.dirname, exist_ok=True)
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        try:
+            entries = []
+            for name, entry in sorted(state.items()):
+                if entry[0] == 'full':
+                    _, arr, lod = entry
+                    value = LoDArray(arr, [np.asarray(l, np.int32)
+                                           for l in lod]) if lod else arr
+                    entries.append((name, value, {'var': name}))
+                else:
+                    _, shards, gshape = entry
+                    for i, (key, arr) in enumerate(sorted(shards.items())):
+                        entries.append(('%s@%d' % (name, i), arr,
+                                        {'var': name,
+                                         'index': [[b, e] for b, e in key],
+                                         'global_shape': list(gshape)}))
+            files, manifest_raw, _commit = _stage_entries(
+                tmp, entries, meta,
+                commit_extra={'rank': self.rank, 'run_id': self.run_id})
+            os.makedirs(pod_dir, exist_ok=True)
+            if os.path.isdir(host_dir):   # re-checkpoint of a resumed step
+                shutil.rmtree(host_dir)
+            os.replace(tmp, host_dir)     # phase-1 commit for THIS host
+            try:
+                # a re-save of a step this host previously DECLINED must
+                # retract the decline, or the coordinator would abandon
+                # the fresh attempt off the stale marker
+                os.unlink(os.path.join(
+                    pod_dir, 'SKIP.%s%d' % (_HOST_PREFIX, self.rank)))
+            except OSError:
+                pass
+            self._fsync_dir(pod_dir)
+            self._fsync_dir(self.dirname)
+        except Exception:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        nbytes = sum(e['bytes'] for e in files.values())
+        # a checkpoint only COUNTS once it is pod-committed: both phases
+        # raise PodCommitTimeout (no_retry — holding the writer busy for
+        # more timeout rounds is what desynchronizes the pod schedule),
+        # the writer loop books the abandon in stats['failed'], and a
+        # preemption drain then exits 1 instead of reporting a drain
+        # that is not restorable
+        if self.rank == 0:
+            self._pod_commit(step, meta)
+            try:
+                self._journal_and_retain(step, {
+                    'manifest_sha256': _sha256(manifest_raw),
+                    'wall_time': meta['wall_time']})
+            except Exception as e:
+                warnings.warn('pod checkpoint step %d committed but '
+                              'journal/retention failed: %s' % (step, e),
+                              RuntimeWarning)
+        else:
+            self._await_pod_commit(step)
+        return nbytes
+
+    def _retention_victims(self, live):
+        """Pod-aware retention: only POD-COMMITTED checkpoints count
+        toward keep_last_n — abandoned partial dirs must never crowd a
+        restorable checkpoint out of the keep budget. Partials OLDER
+        than the newest committed checkpoint are dead weight and
+        evicted; newer ones are left alone (a peer may be mid-phase-1
+        in them right now)."""
+        committed = []
+        for step, path in live:
+            try:
+                pod_verify(path, self.num_hosts)
+                committed.append((step, path))
+            except (ValueError, OSError):
+                pass
+        keep = {path for _s, path in committed[-self.keep_last_n:]}
+        if not keep:
+            return []     # never evict while nothing verified survives
+        newest = committed[-1][0]
+        return [(s, p) for s, p in live if p not in keep and s <= newest]
+
+    def _abandon_pod(self, step):
+        """Publish the coordinator's abandon decision (and count it):
+        other ranks' _await_pod_commit exits immediately instead of
+        waiting out its own timeout."""
+        with self._stats_lock:
+            self.stats['pod_abandoned'] += 1
+        pod_dir = os.path.join(self.dirname, '%s%d' % (_PREFIX, step))
+        try:
+            with open(os.path.join(pod_dir, 'POD_ABANDONED'), 'w') as f:
+                f.write(json.dumps({'run_id': self.run_id}))
+        except OSError:
+            pass     # peers fall back to their commit timeout
+
+    def _abandoned_marker(self, step):
+        path = os.path.join(self.dirname, '%s%d' % (_PREFIX, step),
+                            'POD_ABANDONED')
+        try:
+            with open(path) as f:
+                return json.load(f).get('run_id') == self.run_id
+        except (OSError, ValueError):
+            return False
+
+    def _await_pod_commit(self, step):
+        """Non-coordinator half of phase 2: block (bounded) until the
+        coordinator's POD_COMMIT for this step and run_id appears, so
+        every host's commit accounting means the same thing — a
+        restorable pod checkpoint. Exits early when the coordinator
+        abandoned the step or declined it with a SKIP marker. The
+        deadline is anchored to the COORDINATOR's phase-1 end (its host
+        dir appearing), capped at 2x commit_timeout_s: rank 0 writes the
+        most data (every replicated host-local var), and a fast rank
+        timing out on its own clock would book a failure for a
+        checkpoint that actually commits."""
+        pod_dir = os.path.join(self.dirname, '%s%d' % (_PREFIX, step))
+        pod_path = os.path.join(pod_dir, _POD_COMMIT)
+        host0 = os.path.join(pod_dir, '%s0' % _HOST_PREFIX)
+        deadline = time.monotonic() + 2 * self.commit_timeout_s
+        host0_seen = False
+        while time.monotonic() <= deadline:
+            if not host0_seen and os.path.isdir(host0):
+                host0_seen = True
+                deadline = min(deadline,
+                               time.monotonic() + self.commit_timeout_s)
+            try:
+                with open(pod_path) as f:
+                    pod = json.load(f)
+                if int(pod.get('step', -1)) == int(step) \
+                        and pod.get('run_id') == self.run_id:
+                    return
+            except (OSError, ValueError):
+                pass
+            if self._abandoned_marker(step) or self._skip_marker(step, 0):
+                with self._stats_lock:
+                    self.stats['pod_abandoned'] += 1
+                raise PodCommitTimeout(
+                    'pod checkpoint step %d: the coordinator abandoned '
+                    'or declined this boundary — not restorable' % step)
+            time.sleep(0.05)
+        with self._stats_lock:
+            self.stats['pod_abandoned'] += 1
+        raise PodCommitTimeout(
+            'pod checkpoint step %d: the coordinator never wrote '
+            'POD_COMMIT within %.1fs (dead or slow host 0) — this '
+            'checkpoint is not restorable' % (step, self.commit_timeout_s))
+
+    def _pod_commit(self, step, meta):
+        """Phase 2 (coordinator only): wait for every host's phase-1
+        manifest of THIS run_id, then write the single pod-level commit
+        record. A host that never lands within commit_timeout_s raises
+        PodCommitTimeout — the writer loop retries, then abandons LOUDLY;
+        the partial dir is skipped by restore() and aged out by
+        retention; training continues."""
+        pod_dir = os.path.join(self.dirname, '%s%d' % (_PREFIX, step))
+        try:
+            # a fresh commit attempt retracts any previous abandon of
+            # this step (re-save after an earlier decline)
+            os.unlink(os.path.join(pod_dir, 'POD_ABANDONED'))
+        except OSError:
+            pass
+        deadline = time.monotonic() + self.commit_timeout_s
+        shas, pending = {}, set(range(self.num_hosts))
+        while True:
+            for r in sorted(pending):
+                host_dir = os.path.join(pod_dir,
+                                        '%s%d' % (_HOST_PREFIX, r))
+                try:
+                    manifest, commit = _check_commit(host_dir)
+                except (ValueError, OSError):
+                    continue
+                if manifest.get('meta', {}).get('run_id') != self.run_id:
+                    # stale dir from a dead incarnation (including one
+                    # launched WITHOUT a run id): wait for this host's
+                    # fresh rewrite, never stitch — counting a corpse's
+                    # manifest would commit a sha the live host is about
+                    # to overwrite, rotting the newest checkpoint slot
+                    continue
+                if int(manifest.get('step', -1)) != int(step):
+                    continue
+                shas[str(r)] = commit['manifest_sha256']
+                pending.discard(r)
+            if not pending:
+                break
+            declined = [r for r in sorted(pending)
+                        if self._skip_marker(step, r)]
+            if declined:
+                self._abandon_pod(step)
+                raise PodCommitTimeout(
+                    'pod checkpoint step %d: host(s) %r declined (writer '
+                    'still busy at the boundary) — abandoning without '
+                    'waiting out the timeout' % (step, declined))
+            if time.monotonic() > deadline:
+                self._abandon_pod(step)
+                raise PodCommitTimeout(
+                    'pod checkpoint step %d: host(s) %r never landed '
+                    'their shard manifests within %.1fs (dead or slow '
+                    'host) — the partial pod dir will be skipped by '
+                    'restore()' % (step, sorted(pending),
+                                   self.commit_timeout_s))
+            time.sleep(0.05)
+        pod = {'version': _VERSION, 'step': step,
+               'num_hosts': self.num_hosts, 'hosts': shas,
+               'run_id': self.run_id, 'wall_time': meta['wall_time']}
+        tmpf = os.path.join(pod_dir, '%s%s.%d' % (_TMP_PREFIX, _POD_COMMIT,
+                                                  os.getpid()))
+        with _open_for_write(tmpf, 'wb') as f:
+            f.write(json.dumps(pod).encode())
+            f.flush()
+            _fsync(f.fileno())
+        os.replace(tmpf, os.path.join(pod_dir, _POD_COMMIT))
+        self._fsync_dir(pod_dir)
+        with self._stats_lock:
+            self.stats['pod_commits'] += 1
+
+    # -- restore --------------------------------------------------------
+    def _load_pod(self, path, manifests):
+        """Decode every var of a verified pod checkpoint: single
+        full-coverage entries load as-is (lod preserved); sharded vars
+        assemble into one global numpy array, each shard verified against
+        its manifest entry on the same read. Raises ValueError on any
+        missing/corrupt shard or coverage hole."""
+        import io as _pyio
+        from ..io import _deserialize_tensor
+        groups = {}
+        for r, manifest in sorted(manifests.items()):
+            host_dir = os.path.join(path, '%s%d' % (_HOST_PREFIX, r))
+            for fname, ent in manifest.get('files', {}).items():
+                var = ent.get('var', fname)
+                groups.setdefault(var, []).append((host_dir, fname, ent))
+        out = {}
+        for var, entries in sorted(groups.items()):
+            if len(entries) == 1 and 'index' not in entries[0][2]:
+                host_dir, fname, ent = entries[0]
+                out[var] = _deserialize_tensor(
+                    _pyio.BytesIO(_read_shard(host_dir, fname, ent)))
+                continue
+            gshape = tuple(entries[0][2]['global_shape'])
+            buf, covered = None, 0
+            for host_dir, fname, ent in entries:
+                arr = np.asarray(_deserialize_tensor(
+                    _pyio.BytesIO(_read_shard(host_dir, fname, ent))))
+                if buf is None:
+                    buf = np.empty(gshape, arr.dtype)
+                idx = tuple(slice(b, e) for b, e in ent['index'])
+                buf[idx] = arr
+                covered += arr.size
+            if covered != int(np.prod(gshape, dtype=np.int64)):
+                # owner-deduped shards never overlap, so a size mismatch
+                # is a coverage hole (lost host manifest entry)
+                raise ValueError(
+                    'var %r shards cover %d of %d elements — coverage '
+                    'hole' % (var, covered,
+                              int(np.prod(gshape, dtype=np.int64))))
+            out[var] = buf
+        return out
+
+    def restore(self, executor=None, program=None, scope=None):
+        """Load the newest FULLY pod-committed checkpoint: POD_COMMIT
+        present, every host manifest matching its recorded sha, every
+        shard verifying on the read that loads it. Every rank assembles
+        the same global host values (the next mesh dispatch re-shards
+        them); partial pods — a host died between phase 1 and phase 2 —
+        are skipped with a loud warning, exactly like single-host corrupt
+        entries. Restores this rank's executor step counter and
+        task-journal position from its OWN host manifest."""
+        from .scope import global_scope
+        for step, path, _pod, manifests in _pod_candidates(self.dirname,
+                                                           self.num_hosts):
+            try:
+                values = self._load_pod(path, manifests)
+            except (ValueError, OSError) as e:
+                _warn_skip(path, e)
+                continue
+            sc = scope if scope is not None else global_scope()
+            for name, value in values.items():
+                sc.set(name, value)
+            my_meta = manifests.get(self.rank,
+                                    manifests.get(0, {})).get('meta', {})
+            if executor is not None and program is not None:
+                executor._step_counters[_program_uid(program)] = int(
+                    my_meta.get('executor_step', step))
+            self._last_step = step
+            self._last_time = time.monotonic()
+            return {'step': step, 'path': path, 'meta': my_meta,
+                    'task_journal': my_meta.get('task_journal'),
+                    'loaded': sorted(values), 'missing': []}
+        return None

@@ -207,7 +207,9 @@ class Executor(object):
     # ------------------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, feed_var_name='feed',
             fetch_var_name='fetch', scope=None, return_numpy=True,
-            use_program_cache=True):
+            use_program_cache=True, checkpoint=None):
+        import time as _time
+        t_run = _time.perf_counter() if checkpoint is not None else None
         program = program if program is not None else default_main_program()
         fetch_list = fetch_list or []
         if isinstance(fetch_list, (Variable, str)):
@@ -220,6 +222,14 @@ class Executor(object):
             # the pass-optimized clone for THIS fetch set (memoized);
             # falls back to the raw program if the pipeline declines
             program = compiled._optimized_program(fetch_names)
+            if program is not compiled._program:
+                # one rng/step stream per SOURCE program: the clone's own
+                # uid would fork the counter per fetch set, and a
+                # checkpoint restored against the raw program would never
+                # reach it (core/checkpoint._program_uid contract)
+                program._ptpu_counter_uid = getattr(
+                    compiled._program, '_ptpu_counter_uid',
+                    compiled._program._uid)
         scope = scope if scope is not None else global_scope()
         feed = feed or {}
 
@@ -257,8 +267,9 @@ class Executor(object):
             self._cache[key] = fn
             self._cache_index.setdefault(program._uid, set()).add(key)
 
-        step = self._step_counters.get(program._uid, 0)
-        self._step_counters[program._uid] = step + 1
+        counter_uid = getattr(program, '_ptpu_counter_uid', program._uid)
+        step = self._step_counters.get(counter_uid, 0)
+        self._step_counters[counter_uid] = step + 1
         from .core import config as _config
         # carried as RAW key data (uint32) so multi-host placement can
         # treat it like any other array; step() re-wraps it. Computed on
@@ -272,7 +283,24 @@ class Executor(object):
 
         fetches, new_state = self._dispatch(
             fn, state, feed_vals, rng, 'executor_run#%d' % program._uid)
-        return self._finish(scope, new_state, fetches, return_numpy)
+        out = self._finish(scope, new_state, fetches, return_numpy)
+        if checkpoint is not None:
+            # the mesh-path equivalent of run_steps' boundary: the scope
+            # now holds this step's state, so the policy sees a
+            # consistent cut; only the snapshot stalls, the (sharded)
+            # write happens on the manager's background thread
+            from .core import checkpoint as _ckpt_mod
+            st = self._dispatch_stats
+            st['dispatches'] += 1
+            st['steps'] += 1
+            st['ckpt_stall_s'] += checkpoint.step_boundary(
+                self, program, scope, self._step_counters[counter_uid])
+            st['run_s'] += _time.perf_counter() - t_run
+            self._register_profiler_source()
+            _ckpt_mod.maybe_drain_preemption(
+                checkpoint, self, program, scope,
+                self._step_counters[counter_uid])
+        return out
 
     # -- shared run()/run_steps() plumbing -----------------------------
     def _gather_state(self, program, scope):
@@ -452,6 +480,15 @@ class Executor(object):
             st['ckpt_stall_s'] += checkpoint.step_boundary(
                 self, program, scope, self._step_counters[program._uid])
         st['run_s'] += _time.perf_counter() - t_run
+        if checkpoint is not None:
+            # graceful preemption (SIGTERM): drain ONE final blocking
+            # checkpoint at this boundary — params, step counter, and the
+            # data-journal position describing the same history — then
+            # exit 0 so the supervisor restarts into a clean resume
+            from .core import checkpoint as _ckpt_mod
+            _ckpt_mod.maybe_drain_preemption(
+                checkpoint, self, program, scope,
+                self._step_counters[program._uid])
         return out
 
     def _register_profiler_source(self):
@@ -1176,14 +1213,33 @@ class Executor(object):
         rep = replicated(mesh)
         ndp = mesh.shape.get(DATA_AXIS, 1)
 
-        state_shardings = {}
+        prog_vars = {}
         for n in state_names:
-            spec = None
             for b in program.blocks:
                 v = b.vars.get(n)
-                if v is not None and getattr(v, 'sharding_spec', None):
-                    spec = v.sharding_spec
+                if v is not None:
+                    prog_vars[n] = v
                     break
+        annotated = {n: tuple(prog_vars[n].sharding_spec)
+                     for n in state_names
+                     if prog_vars.get(n) is not None
+                     and getattr(prog_vars[n], 'sharding_spec', None)}
+        state_shardings = {}
+        for n in state_names:
+            spec = annotated.get(n)
+            if spec is None:
+                # optimizer slots (<param>_velocity_0, <param>_moment_0,
+                # ...) inherit their param's annotation when shapes match:
+                # an unannotated same-shape slot replicated next to a
+                # sharded param would force a gather/scatter every update
+                v = prog_vars.get(n)
+                for pn, pspec in annotated.items():
+                    pv = prog_vars.get(pn)
+                    if v is not None and pv is not None \
+                            and n.startswith(pn + '_') \
+                            and tuple(v.shape) == tuple(pv.shape):
+                        spec = pspec
+                        break
             if spec is not None and all(a is None or a in mesh.shape
                                         for a in spec):
                 state_shardings[n] = NamedSharding(mesh, PartitionSpec(*spec))
@@ -1210,6 +1266,23 @@ class Executor(object):
             return rep
 
         feed_specs = {n: feed_spec(n) or rep for n in feed_names}
+
+        # pin the state FIXED POINT: without an output constraint GSPMD
+        # picks new_state shardings freely (e.g. shards an unannotated
+        # param it decided to split), so step 2's inputs no longer match
+        # the shardings step 1 compiled for — a recompile per step under
+        # plain jit, a hard mismatch error through the AOT warm path.
+        # Constraining every state output to its input sharding makes the
+        # step function a sharding-stable loop with ONE signature.
+        base_step = step
+
+        def step(state, feed, rng):
+            fetches, new_state = base_step(state, feed, rng)
+            new_state = {
+                n: jax.lax.with_sharding_constraint(
+                    v, state_shardings.get(n, rep))
+                for n, v in new_state.items()}
+            return fetches, new_state
         jitted = jax.jit(step, donate_argnums=(0,))
 
         def _place_feed(n, v):

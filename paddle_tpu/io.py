@@ -190,7 +190,10 @@ def _broadcast_bytes(blob, pid, error=None):
     size, ok = int(hdr[0]), int(hdr[1])
     buf = np.frombuffer(blob, np.uint8) if pid == 0 \
         else np.zeros(size, np.uint8)
-    buf = multihost_utils.broadcast_one_to_all(buf)
+    # some collective transports (gloo on XLA:CPU) widen small int dtypes
+    # through the psum — the VALUES survive, the dtype does not; cast back
+    # before reinterpreting as a byte stream
+    buf = np.asarray(multihost_utils.broadcast_one_to_all(buf), np.uint8)
     if not ok:
         raise RuntimeError("load failed on process 0: %s"
                            % buf.tobytes().decode(errors='replace'))

@@ -4,3 +4,5 @@ from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy  # noqa
 from .parallel_executor import ParallelExecutor  # noqa: F401
 from .api import shard_parameter, shard_embedding, MultiStepTrainer  # noqa: F401,E501
 from .ring_attention import ring_attention  # noqa: F401
+from .multihost import init_distributed, pod_run_id, \
+    PodCheckpointManager, HostWatchdog, fs_barrier, BarrierTimeout  # noqa: F401,E501

@@ -51,7 +51,7 @@ class MultiStepTrainer(object):
 
     def __init__(self, program, steps_per_dispatch=8, fetch_list=None,
                  fetch_policy='final', place=None, scope=None,
-                 executor=None, checkpoint=None):
+                 executor=None, checkpoint=None, preemptible=False):
         from ..executor import Executor
         from ..framework import TPUPlace
         if int(steps_per_dispatch) < 1:
@@ -68,6 +68,11 @@ class MultiStepTrainer(object):
         # dispatch boundary; startup() restores from the newest committed
         # checkpoint so a SIGKILLed trainer resumes where it stopped
         self.checkpoint = checkpoint
+        # preemptible=True routes SIGTERM (the scheduler's preemption
+        # notice) to a graceful drain: run_steps writes one final
+        # checkpoint at the next step boundary and exits 0 — a clean
+        # resume instead of a crash (requires checkpoint=)
+        self.preemptible = bool(preemptible)
         self.resume_info = None
 
     def startup(self, startup_program):
@@ -80,6 +85,9 @@ class MultiStepTrainer(object):
         happened."""
         self.executor.run(startup_program, scope=self.scope)
         if self.checkpoint is not None:
+            if self.preemptible:
+                from ..core import checkpoint as _ckpt
+                _ckpt.install_preemption_handler()
             self.resume_info = self.checkpoint.restore(
                 executor=self.executor, program=self.program,
                 scope=self.scope)

@@ -25,6 +25,18 @@ import jax
 _initialized = {'done': False}
 
 
+def _effective_platform(platform):
+    """The platform the backend will initialize with, best-effort: the
+    explicit argument wins, then the env pins tests use."""
+    if platform is not None:
+        return platform
+    for env in ('JAX_PLATFORMS', 'PTPU_PLATFORM'):
+        v = os.environ.get(env)
+        if v:
+            return v.split(',')[0]
+    return None
+
+
 def init_distributed(coordinator_address=None, num_trainers=None,
                      trainer_id=None, platform=None):
     """Join this process into the multi-host runtime. No-op for a single
@@ -50,6 +62,15 @@ def init_distributed(coordinator_address=None, num_trainers=None,
         # pin the platform BEFORE backend init (e.g. 'cpu' for the
         # simulated-pod tests; on a real pod the TPU platform is default)
         jax.config.update('jax_platforms', platform)
+    if _effective_platform(platform) == 'cpu':
+        # XLA:CPU alone cannot execute a computation spanning processes
+        # ("Multiprocess computations aren't implemented on the CPU
+        # backend"); gloo supplies the cross-process collective transport
+        # for the simulated pod. Must land BEFORE backend init.
+        try:
+            jax.config.update('jax_cpu_collectives_implementation', 'gloo')
+        except Exception:
+            pass    # jaxlib without gloo: single-host-per-program only
     if not _initialized['done']:
         jax.distributed.initialize(coordinator_address,
                                    num_processes=num_trainers,
@@ -75,6 +96,46 @@ def process_index():
 def mesh_spans_processes(mesh):
     devs = np.asarray(mesh.devices).reshape(-1)
     return len({d.process_index for d in devs}) > 1
+
+
+def pod_run_id():
+    """One id shared by every process of THIS pod incarnation — the token
+    PodCheckpointManager uses to keep a restarted pod from stitching a
+    dead incarnation's stale host shards into a fresh checkpoint.
+    Resolution order: PTPU_POD_RUN_ID (set by the pod supervisor /
+    tools/chaos.py --pod), else rank 0 mints a uuid and shares it through
+    the distributed KV store, else (single process) a local uuid."""
+    rid = os.environ.get('PTPU_POD_RUN_ID')
+    if rid:
+        return rid
+    import uuid
+    if process_count() <= 1:
+        return uuid.uuid4().hex
+    try:
+        client = jax._src.distributed.global_state.client
+        if process_index() == 0:
+            rid = uuid.uuid4().hex
+            client.key_value_set('ptpu_pod_run_id', rid)
+            return rid
+        return client.blocking_key_value_get('ptpu_pod_run_id', 60_000)
+    except Exception as e:
+        # no KV store (older jaxlib): there is NO way to mint a token
+        # that is both shared across hosts and unique per incarnation —
+        # a coordinator-address fallback would repeat across restarts
+        # and re-open the exact stale-shard stitching hole the run_id
+        # exists to close. Make the operator supply one.
+        raise RuntimeError(
+            'pod_run_id: no distributed KV store available (%s: %s) — '
+            'set PTPU_POD_RUN_ID to a fresh value for every pod launch'
+            % (type(e).__name__, e))
+
+
+# pod-scale failure-detection primitives live next to the checkpoint
+# machinery (stdlib-only, standalone-loadable by tools/chaos.py); re-export
+# the parallel-facing surface here
+from ..core.checkpoint import (     # noqa: E402,F401
+    BarrierTimeout, fs_barrier, write_heartbeat, read_heartbeats,
+    stale_hosts, HostWatchdog, PodCheckpointManager, pod_latest_committed)
 
 
 def place_local_shard(sharding, local_np, n_processes):

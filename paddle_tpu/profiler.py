@@ -63,9 +63,12 @@ def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
     if _serving_sources:
         serving_report()
     if _training_sources:
-        training_report()   # renders feeder sources too
-    elif _feeder_sources:
-        feeder_report()
+        training_report()   # renders feeder + pod sources too
+    else:
+        if _feeder_sources:
+            feeder_report()
+        if _pod_sources:
+            pod_report()
     if _infer_sources:
         infer_report()
     if _compile_sources:
@@ -234,6 +237,59 @@ def training_report():
                    s.get('ckpt_stall_pct', 0.0)))
     if _feeder_sources:
         out['feeders'] = feeder_report()
+    if _pod_sources:
+        out['pod'] = pod_report()
+    return out
+
+
+# -- pod health metrics ------------------------------------------------------
+# Pod checkpoint managers (core/checkpoint.PodCheckpointManager) register a
+# zero-arg snapshot callable here; pod_report() renders one row per pod
+# HOST — training step, heartbeat age, checkpoint stall, barrier wait,
+# commit/abandon counters — read from the shared heartbeat files, so ONE
+# process prints the health of the whole pod. training_report() appends the
+# same table so a stall reads straight across to the host causing it.
+_pod_sources = {}
+
+
+def register_pod_source(name, snapshot):
+    """Register a pod-health source: `snapshot()` -> dict with num_hosts,
+    rank, and hosts={rank: heartbeat payload + age_s} (the contract of
+    PodCheckpointManager's heartbeat files)."""
+    _pod_sources[name] = snapshot
+
+
+def unregister_pod_source(name):
+    _pod_sources.pop(name, None)
+
+
+def pod_report(stale_after_s=10.0):
+    """Print per-host pod health for every registered source and return
+    {source name: snapshot dict}. `alive` is heartbeat-age-based
+    (stale_after_s), the same bounded-time signal HostWatchdog acts on."""
+    out = {}
+    for name in sorted(_pod_sources):
+        try:
+            snap = _pod_sources[name]()
+        except Exception:
+            continue  # a closed manager must not break the report
+        out[name] = snap
+        hosts = snap.get('hosts', {})
+        if not hosts:
+            continue
+        print("%-24s %5s %6s %10s %10s %6s %12s %8s %10s %6s" %
+              ('Pod source', 'host', 'step', 'hb-age(s)', 'ckpt(ms)',
+               'ckpt%', 'barrier(ms)', 'commits', 'abandoned', 'alive'))
+        for rank in sorted(hosts):
+            h = hosts[rank]
+            age = h.get('age_s', float('inf'))
+            print("%-24s %5d %6d %10.2f %10.2f %6.2f %12.2f %8d %10d %6s" %
+                  (name[:24], rank, h.get('step', 0), age,
+                   h.get('ckpt_stall_ms', 0.0),
+                   h.get('ckpt_stall_pct', 0.0),
+                   h.get('barrier_ms', 0.0), h.get('commits', 0),
+                   h.get('pod_abandoned', 0),
+                   'yes' if age <= stale_after_s else 'NO'))
     return out
 
 

@@ -51,7 +51,8 @@ class TaskService(object):
     def __init__(self, tasks, journal_path=None, lease_timeout_s=60.0,
                  max_failures=3, retry_backoff_s=0.05,
                  retry_backoff_max_s=5.0, retry_jitter=0.25,
-                 journal_limit=None):
+                 journal_limit=None, lease_dir=None, holder_id=None,
+                 holder_timeout_s=30.0):
         self._all = {str(t): t for t in tasks}
         if len(self._all) != len(tasks):
             raise ValueError("task ids (str(task)) must be unique")
@@ -77,6 +78,32 @@ class TaskService(object):
         self._backoff_max = float(retry_backoff_max_s)
         self._backoff_jitter = float(retry_jitter)
         self._backoff_rng = random.Random()
+        # cross-process lease board (pod-scale, ISSUE 10): each holder
+        # heartbeats a file listing its live leases; a survivor reclaims a
+        # dead holder's chunk leases after holder_timeout_s instead of
+        # losing that shard of the epoch. The in-process lease_timeout
+        # machinery above cannot see a SIGKILLed peer — its leases live in
+        # the dead process's memory — so liveness is the file's mtime.
+        self._lease_dir = lease_dir
+        self._holder_id = holder_id or ('pid-%d' % os.getpid())
+        self._holder_timeout = float(holder_timeout_s)
+        self._last_reclaim_scan = 0.0
+        self.reclaimed = 0                    # tasks taken from dead peers
+        self._hb_stop = threading.Event()
+        self._hb_thread = None
+        self._publish_lock = threading.Lock()
+        if lease_dir:
+            os.makedirs(lease_dir, exist_ok=True)
+            # liveness must not depend on lease-API activity: a pod-wide
+            # pause (first-step XLA compile, a blocking final checkpoint)
+            # would otherwise age every LIVE holder past holder_timeout_s
+            # and let the first resumed peer "reclaim" leases from
+            # holders that are not dead — duplicate delivery. A daemon
+            # thread refreshes the board mtime on its own clock.
+            self._hb_thread = threading.Thread(
+                target=self._hb_loop, name='ptpu-lease-heartbeat',
+                daemon=True)
+            self._hb_thread.start()
         self._journal_path = journal_path
         self._journal_f = None
         if journal_path:
@@ -165,12 +192,151 @@ class TaskService(object):
             self._journal_f.write(json.dumps(rec) + '\n')
             self._journal_f.flush()
 
+    # -- cross-process lease board (pod-scale reclaim) ---------------------
+    def _holder_path(self, holder=None):
+        return os.path.join(self._lease_dir,
+                            '%s.leases.json' % (holder or self._holder_id))
+
+    def _write_holder_locked(self):
+        """Mark the board stale; the file IO happens OUTSIDE the service
+        lock (_publish_holder) — a slow shared filesystem must never
+        serialize the dispatch path behind a network write."""
+        self._holder_dirty = True
+
+    def _publish_holder(self, refresh=False):
+        """Publish this holder's live leases when membership changed
+        (atomic replace; the mtime is the heartbeat). With refresh=True
+        (the heartbeat thread) a clean board still gets its mtime
+        touched; API-path callers skip entirely when nothing changed —
+        no network round-trip on the sample-delivery hot path. Called
+        outside the lock; failure degrades silently — the board is an
+        extra safety net over the journal, never a correctness
+        dependency."""
+        if self._lease_dir is None:
+            return
+        path = self._holder_path()
+        # the dedicated publish lock (NOT self._lock) serializes
+        # snapshot+write: without it, a descheduled publisher could
+        # install an OLDER lease snapshot over a newer board, and every
+        # later heartbeat would merely utime the stale content — a
+        # survivor reclaiming from it would silently miss chunks.
+        # Dispatch threads never contend on this lock for service state.
+        with self._publish_lock:
+            with self._lock:
+                dirty = getattr(self, '_holder_dirty', True)
+                leases = sorted(self._pending) if dirty else None
+                self._holder_dirty = False
+            if leases is None and not refresh:
+                return
+            try:
+                if leases is None and os.path.exists(path):
+                    os.utime(path)
+                    return
+                tmp = '%s.%d.tmp' % (path, os.getpid())
+                with open(tmp, 'w') as f:
+                    f.write(json.dumps({'holder': self._holder_id,
+                                        'pid': os.getpid(),
+                                        'time': time.time(),
+                                        'leases': leases or []}))
+                os.replace(tmp, path)
+            except OSError:
+                pass
+
+    def _hb_loop(self):
+        # min(1s, timeout/4): fresh enough that reclaim_stale_leases can
+        # trust mtimes, cheap enough for NFS
+        interval = max(0.05, min(1.0, self._holder_timeout / 4))
+        while not self._hb_stop.wait(interval):
+            self._publish_holder(refresh=True)
+
+    def reclaim_stale_leases(self, now=None):
+        """Reclaim chunk leases from peers that stopped heartbeating: any
+        holder file on the shared lease board stale by more than
+        holder_timeout_s marks a dead process, and its leased tasks (that
+        this service knows and has not finished) re-enter THIS service's
+        queue with a loud warning naming the dead holder. First survivor
+        wins (atomic rename retires the stale board entry). The dead
+        holder's un-journaled in-flight samples replay — at-least-once,
+        the safe margin for SGD (see elastic_sample_stream's contract).
+        Returns the reclaimed task ids."""
+        if self._lease_dir is None:
+            return []
+        now = time.time() if now is None else now
+        reclaimed = []
+        # ALL filesystem IO happens outside the service lock (the same
+        # slow-shared-fs rule _write_holder_locked states): a stalled
+        # listdir/read must never wedge every consumer thread behind
+        # self._lock. The lock is taken only to mutate the queue.
+        try:
+            names = os.listdir(self._lease_dir)
+        except OSError:
+            return []
+        for fname in sorted(names):
+            if not fname.endswith('.leases.json'):
+                continue
+            holder = fname[:-len('.leases.json')]
+            if holder == self._holder_id:
+                continue
+            path = os.path.join(self._lease_dir, fname)
+            try:
+                age = now - os.path.getmtime(path)
+            except OSError:
+                continue
+            if age <= self._holder_timeout:
+                continue
+            try:
+                with open(path) as f:
+                    rec = json.load(f)
+            except (OSError, ValueError):
+                rec = {}
+            try:
+                # atomic retire: two survivors must never both import
+                os.replace(path, path + '.reclaimed')
+            except OSError:
+                continue
+            leases = rec.get('leases', [])
+            # self._all is immutable after __init__: safe to read unlocked
+            unknown = [t for t in leases if t not in self._all]
+            if unknown:
+                warnings.warn(
+                    "dead holder %r leased task(s) %r this service "
+                    "does not know (disjoint shard assignment) — "
+                    "they can only be recovered by restarting that "
+                    "host; use a 'covering' assignment if survivors "
+                    "must be able to take over its chunks"
+                    % (holder, unknown[:4]), RuntimeWarning)
+            with self._lock:
+                tasks = [t for t in leases
+                         if t in self._all and t not in self._done
+                         and t not in self._dropped
+                         and t not in self._pending]
+                if tasks:
+                    # dead host's in-flight work dispatches FIRST,
+                    # whether or not it was already queued here (shared
+                    # task sets)
+                    self._todo = tasks + [t for t in self._todo
+                                          if t not in tasks]
+                    self.reclaimed += len(tasks)
+            if not tasks:
+                continue
+            warnings.warn(
+                "lease holder %r is DEAD (heartbeat stale %.1fs > "
+                "%.1fs) — reclaiming its %d chunk lease(s) %r; its "
+                "un-journaled in-flight samples will replay "
+                "(at-least-once margin)"
+                % (holder, age, self._holder_timeout, len(tasks),
+                   tasks[:4]), RuntimeWarning)
+            reclaimed.extend(tasks)
+        return reclaimed
+
     # -- dispatch (ref service.go:89 taskQueues, :140 CheckTimeoutFunc) ----
     def _requeue_expired(self, now):
         expired = [t for t, dl in self._pending.items() if dl <= now]
         for t in expired:
             del self._pending[t]
             self._fail_locked(t, 'lease timeout')
+        if expired:
+            self._write_holder_locked()
 
     def _fail_locked(self, task_id, why):
         n = self._failures.get(task_id, 0) + 1
@@ -205,6 +371,16 @@ class TaskService(object):
         nothing is currently dispatchable (all done/leased/dropped).
         `skip` is the journaled progress — samples already consumed."""
         now = time.monotonic()
+        if self._lease_dir is not None and now - self._last_reclaim_scan \
+                > max(0.5, self._holder_timeout / 4):
+            self._last_reclaim_scan = now
+            self.reclaim_stale_leases()
+        leased = self._get_task_locked(now)
+        if self._lease_dir is not None:
+            self._publish_holder()
+        return leased
+
+    def _get_task_locked(self, now):
         with self._lock:
             self._requeue_expired(now)
             backing_off = []
@@ -224,6 +400,7 @@ class TaskService(object):
                     leased = Lease((task_id, self._all[task_id],
                                     self._progress.get(task_id, 0)))
                     leased.gen = gen
+                    self._write_holder_locked()
                     return leased
                 return None
             finally:
@@ -248,6 +425,8 @@ class TaskService(object):
                     + self._lease_timeout
             self._journal({'event': 'progress', 'task': task_id,
                            'count': count})
+        if self._lease_dir is not None:
+            self._publish_holder()   # board heartbeat, outside the lock
 
     def renew_lease(self, task_id, gen=None):
         """Heartbeat without journaling progress: a producer that is still
@@ -259,6 +438,8 @@ class TaskService(object):
             if task_id in self._pending:
                 self._pending[task_id] = time.monotonic() \
                     + self._lease_timeout
+        if self._lease_dir is not None:
+            self._publish_holder()   # board heartbeat, outside the lock
 
     def is_dropped(self, task_id):
         with self._lock:
@@ -299,6 +480,9 @@ class TaskService(object):
             self._done.add(task_id)
             self._progress.pop(task_id, None)
             self._journal({'event': 'done', 'task': task_id})
+            self._write_holder_locked()
+        if self._lease_dir is not None:
+            self._publish_holder()
 
     def release_task(self, task_id, gen=None):
         """Return a leased task to the queue WITHOUT a failure mark: a
@@ -316,6 +500,9 @@ class TaskService(object):
             if task_id not in self._todo and task_id not in self._done \
                     and task_id not in self._dropped:
                 self._todo.insert(0, task_id)  # resume-first: keep order
+            self._write_holder_locked()
+        if self._lease_dir is not None:
+            self._publish_holder()
 
     def task_failed(self, task_id, gen=None):
         """Report a failure. With `gen`, a late report from an expired
@@ -326,6 +513,9 @@ class TaskService(object):
                 return
             self._pending.pop(task_id, None)
             self._fail_locked(task_id, 'reported')
+            self._write_holder_locked()
+        if self._lease_dir is not None:
+            self._publish_holder()
 
     def new_epoch(self):
         """Barrier: all tasks re-dispatchable; journaled so recovery does
@@ -355,6 +545,11 @@ class TaskService(object):
                     'done': len(self._done), 'dropped': len(self._dropped)}
 
     def close(self):
+        if self._hb_thread is not None:
+            self._hb_stop.set()
+            self._hb_thread.join(timeout=5)
+            self._hb_thread = None
+            self._publish_holder()   # final board state for survivors
         if self._journal_f is not None:
             self._journal_f.close()
             self._journal_f = None

@@ -520,7 +520,8 @@ class ShardedFileReader(object):
     def __init__(self, files, shard_id=0, num_shards=1, journal_path=None,
                  chunk_granular=True, read_task_fn=None,
                  lease_timeout_s=3600.0, max_failures=3,
-                 progress_every=32, journal_limit=None):
+                 progress_every=32, journal_limit=None, lease_dir=None,
+                 holder_id=None, holder_timeout_s=30.0):
         from .. import recordio as _rio
         from .elastic import TaskService
         if isinstance(files, str):
@@ -554,10 +555,17 @@ class ShardedFileReader(object):
                     "non-recordio files in the set (%s, ...) need a "
                     "read_task_fn(task) that yields their records"
                     % missing[0].path)
+        # lease_dir (shared fs) opts into the pod-scale lease board: a
+        # host that stops heartbeating for holder_timeout_s has its chunk
+        # leases reclaimed by survivors (elastic.reclaim_stale_leases) —
+        # pair with a 'covering' assignment so survivors can read them
         self._service = TaskService(
             self.tasks, journal_path=journal_path,
             lease_timeout_s=lease_timeout_s, max_failures=max_failures,
-            journal_limit=journal_limit)
+            journal_limit=journal_limit, lease_dir=lease_dir,
+            holder_id=holder_id if holder_id is not None
+            else 'shard-%d' % int(shard_id),
+            holder_timeout_s=holder_timeout_s)
         self._held = {}       # live generator's leases (see _tagged/_ack)
         self._delivered = {}  # live generator's delivered positions
 

@@ -39,6 +39,9 @@ JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/donation_smoke.py
 echo "== crash-resume smoke (SIGKILL mid-epoch -> seconds-scale resume with bit/loss parity; chaos kill+corrupt rounds; checkpoint stall < 2%) =="
 JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/crash_resume_smoke.py
 
+echo "== pod fault-tolerance smoke (2-process composed-mesh kill-one-host + full-pod resume in seconds off the warm compile cache; sharded two-phase checkpoints, stall < 2%, chaos --pod round with corruption) =="
+JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/pod_ft_smoke.py
+
 echo "== data plane smoke (sharded streaming input: serial-vs-pooled feeder A/B >=3x with bit-identical epochs, exactly-once journal resume, host-stall < 2% on the smallnet loop) =="
 JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/data_plane_smoke.py
 

@@ -1,0 +1,135 @@
+"""Pod-scale fault-tolerance smoke (ISSUE 10, wired into ci.sh).
+
+1. An uninterrupted 2-process composed-mesh pod run (dp spans hosts x mp
+   within; sharded two-phase checkpoints every 4 steps): both hosts must
+   report IDENTICAL losses and a checkpoint stall < 2% of run time.
+2. The same pod on a fresh checkpoint dir with host 1 SIGKILLed
+   mid-training: the survivor must exit in bounded time (heartbeat
+   watchdog), never wedge.
+3. A full-pod restart on that dir: resumes from the newest POD-committed
+   checkpoint in seconds (warm compile cache), and every host's losses +
+   final params digest BIT-MATCH the uninterrupted run.
+4. tools/chaos.py --pod 2 with random corruption: kill-one-host rounds +
+   checkpoint rot, exit 0 required.
+"""
+import importlib.util
+import os
+import shutil
+import signal
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_spec = importlib.util.spec_from_file_location(
+    'ptpu_chaos', os.path.join(REPO, 'tools', 'chaos.py'))
+chaos = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(chaos)
+
+STALL_BUDGET_PCT = 2.0
+
+
+def read_stall(path):
+    for line in open(path):
+        if line.startswith('STALL'):
+            return float(line.split()[1])
+    return None
+
+
+def main():
+    work = tempfile.mkdtemp(prefix='ptpu-pod-smoke-')
+    cache = os.path.join(work, 'compile-cache')
+    ckpt = os.path.join(work, 'ckpts')
+    outs = lambda tag: [os.path.join(work, '%s-r%d.txt' % (tag, r))  # noqa: E731,E501
+                        for r in range(2)]
+
+    def fail(msg):
+        print('[pod-smoke] FAIL: %s (workdir kept at %s)' % (msg, work))
+        return 1
+
+    # 1) uninterrupted reference
+    t0 = time.time()
+    ref_outs = outs('ref')
+    res = chaos.run_pod(os.path.join(work, 'ref-ckpts'), ref_outs,
+                        total=12, every=4, cache_dir=cache)
+    if any(rc != 0 for rc, _ in res):
+        return fail('reference pod run failed:\n%s'
+                    % '\n'.join(e[-1200:] for _, e in res))
+    refs = [chaos.read_out(p) for p in ref_outs]
+    if refs[0][1] != refs[1][1]:
+        return fail('replicated losses differ between hosts')
+    stalls = [read_stall(p) for p in ref_outs]
+    if any(s is None or s >= STALL_BUDGET_PCT for s in stalls):
+        return fail('checkpoint stall %r over the %.1f%% budget'
+                    % (stalls, STALL_BUDGET_PCT))
+    print('[pod-smoke] reference: 12 steps, losses identical across '
+          'hosts, ckpt stall %s%%  %.1fs'
+          % (['%.3f' % s for s in stalls], time.time() - t0))
+
+    # 2) kill host 1 mid-training
+    t0 = time.time()
+    res = chaos.run_pod(ckpt, outs('kill'), total=12, every=4,
+                        kill_rank=1, kill_at=8, cache_dir=cache)
+    if res[1][0] != -signal.SIGKILL:
+        return fail('victim exited %s, expected SIGKILL' % res[1][0])
+    if any('WEDGED' in err for _, err in res):
+        return fail('survivor never detected the dead host')
+    print('[pod-smoke] kill round: victim SIGKILLed at step 8, survivor '
+          'exited %s in bounded time  %.1fs'
+          % (res[0][0], time.time() - t0))
+
+    # 3) full-pod resume: seconds-scale off the warm compile cache
+    t0 = time.time()
+    fin_outs = outs('final')
+    res = chaos.run_pod(ckpt, fin_outs, total=12, every=4,
+                        cache_dir=cache)
+    resume_s = time.time() - t0
+    if any(rc != 0 for rc, _ in res):
+        return fail('resume pod run failed:\n%s'
+                    % '\n'.join(e[-1200:] for _, e in res))
+    for r in range(2):
+        resume, losses, sha = chaos.read_out(fin_outs[r])
+        if resume < 4:
+            return fail('host %d resumed at step %d — no pod-committed '
+                        'checkpoint was restored' % (r, resume))
+        for idx, v in losses.items():
+            if v != refs[r][1].get(idx):
+                return fail('host %d: loss at step %d diverged after '
+                            'resume' % (r, idx))
+        if sha != refs[r][2]:
+            return fail('host %d: final params digest diverged' % r)
+    print('[pod-smoke] resume: full pod restarted from step %d with '
+          'bit/loss parity in %.1fs (warm compile cache)'
+          % (chaos.read_out(fin_outs[0])[0], resume_s))
+
+    # 3b) idempotent resume at the final step: re-launching a completed
+    # pod must neither retrain nor destroy the committed checkpoint
+    res = chaos.run_pod(ckpt, outs('again'), total=12, every=4,
+                        cache_dir=cache)
+    if any(rc != 0 for rc, _ in res):
+        return fail('resume-at-final-step pod run failed:\n%s'
+                    % '\n'.join(e[-1200:] for _, e in res))
+    for r in range(2):
+        resume, _losses, sha = chaos.read_out(
+            os.path.join(work, 'again-r%d.txt' % r))
+        if resume != 12 or sha != refs[r][2]:
+            return fail('idempotent re-launch diverged (resume=%s)'
+                        % resume)
+    print('[pod-smoke] idempotent re-launch: resumed at 12, committed '
+          'checkpoint preserved')
+
+    # 4) chaos pod rounds with corruption
+    rc = chaos.main(['--pod', '2', '--rounds', '1', '--total', '12',
+                     '--every', '4', '--corrupt', 'random', '--seed', '5'])
+    if rc != 0:
+        return fail('chaos --pod exited %d' % rc)
+
+    shutil.rmtree(work, ignore_errors=True)
+    print('[pod-smoke] OK')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

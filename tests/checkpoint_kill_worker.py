@@ -92,7 +92,12 @@ def main():
                                 retry_backoff_s=0.05)
     trainer = MultiStepTrainer(main_p, steps_per_dispatch=k,
                                fetch_list=[loss], fetch_policy='stack',
-                               place=fluid.CPUPlace(), checkpoint=mgr)
+                               place=fluid.CPUPlace(), checkpoint=mgr,
+                               # PTPU_PREEMPTIBLE=1: SIGTERM drains one
+                               # final checkpoint at the next step
+                               # boundary and exits 0 (test_pod_ft)
+                               preemptible=os.environ.get(
+                                   'PTPU_PREEMPTIBLE') == '1')
     import time
     t0 = time.perf_counter()
     trainer.startup(startup_p)

@@ -1,12 +1,24 @@
-"""Chaos harness for fault-tolerant training (ISSUE 6): repeatedly
-SIGKILL a trainer subprocess at random step boundaries — optionally
-corrupting the newest checkpoint between incarnations — and verify that
-every incarnation's losses and the final params BIT-MATCH an
-uninterrupted reference run.
+"""Chaos harness for fault-tolerant training (ISSUE 6 single-host,
+ISSUE 10 pod mode): repeatedly SIGKILL a trainer at random step
+boundaries — optionally corrupting the newest checkpoint between
+incarnations — and verify that every incarnation's losses and the final
+params BIT-MATCH an uninterrupted reference run.
 
     python tools/chaos.py                        # 3 kill rounds, no rot
     python tools/chaos.py --rounds 5 --corrupt random --seed 7
     python tools/chaos.py --total 48 --every 8 --keep
+    python tools/chaos.py --pod 2                # pod mode: N processes,
+                                                 # kill ONE random host
+                                                 # per round, restart the
+                                                 # WHOLE pod, assert
+                                                 # bit/loss parity
+
+Pod mode launches `--pod N` composed-mesh trainer processes
+(tests/pod_ft_worker.py: dp spans hosts x mp within, sharded two-phase
+pod checkpoints), SIGKILLs one random host mid-step, lets the survivors'
+heartbeat watchdog exit them in bounded time, then restarts the full pod
+on the same checkpoint dir — resume rides the shared warm compile cache
+and must continue the loss stream bit-exactly on every host.
 
 Per round: launch tests/checkpoint_kill_worker.py on a shared checkpoint
 dir (it resumes from the newest committed checkpoint), let it train to a
@@ -73,7 +85,7 @@ def read_out(path):
             resume = int(parts[1])
         elif parts[0] == 'DONE':
             sha = parts[1]
-        else:
+        elif parts[0].lstrip('-').isdigit():
             losses[int(parts[0])] = float(parts[1])
     return resume, losses, sha
 
@@ -119,6 +131,210 @@ def corrupt_newest(ckpt_mod, faults, ckpt_dir, mode, rng):
     return step, mode
 
 
+# ---------------------------------------------------------------------------
+# pod mode (ISSUE 10): kill ONE random host, restart the WHOLE pod
+# ---------------------------------------------------------------------------
+POD_WORKER = os.path.join(REPO, 'tests', 'pod_ft_worker.py')
+
+
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_pod(ckpt_dir, out_paths, total, every, kill_rank=None, kill_at=0,
+            cache_dir=None, timeout=600):
+    """One pod incarnation: len(out_paths) worker processes joined through
+    a fresh coordinator + run id. Returns [(returncode, stderr)] per
+    rank; a process that outlives `timeout` (wedged survivor whose
+    watchdog failed) is SIGKILLed — that is itself a detection failure
+    the caller flags."""
+    import uuid
+    n = len(out_paths)
+    port, run_id = _free_port(), uuid.uuid4().hex
+    procs = []
+    for rank in range(n):
+        env = dict(os.environ)
+        env.pop('JAX_PLATFORMS', None)
+        env.update({
+            'PADDLE_TRAINERS': str(n),
+            'PADDLE_TRAINER_ID': str(rank),
+            'PADDLE_COORDINATOR': '127.0.0.1:%d' % port,
+            'XLA_FLAGS': '--xla_force_host_platform_device_count=2',
+            'PTPU_POD_RUN_ID': run_id,
+            'PTPU_POD_HB_TIMEOUT': env_hb_timeout(),
+        })
+        if cache_dir:
+            env['PTPU_COMPILE_CACHE'] = '1'
+            env['PTPU_COMPILE_CACHE_DIR'] = cache_dir
+        argv = [sys.executable, POD_WORKER, ckpt_dir, out_paths[rank],
+                str(total), str(every)]
+        if kill_rank == rank:
+            argv += [str(kill_at), '1']
+        procs.append(subprocess.Popen(argv, env=env, cwd=REPO,
+                                      stdout=subprocess.DEVNULL,
+                                      stderr=subprocess.PIPE, text=True))
+    results = []
+    deadline = time.time() + timeout
+    for p in procs:
+        try:
+            _out, err = p.communicate(timeout=max(5.0,
+                                                  deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            _out, err = p.communicate()
+            err += '\n[chaos] WEDGED: survivor never detected the dead ' \
+                   'host within %ds' % timeout
+        results.append((p.returncode, err))
+    return results
+
+
+def env_hb_timeout():
+    # 8s default: tight enough for bounded detection, loose enough that
+    # a loaded 2-core CI host compiling several pods at once cannot
+    # starve a live worker's heartbeat thread into a false positive
+    return os.environ.get('PTPU_POD_HB_TIMEOUT', '8')
+
+
+def corrupt_newest_pod(ckpt_mod, faults, ckpt_dir, mode, rng):
+    """Damage the newest POD checkpoint the way a crash/bit-rot would:
+    'commit' removes the pod-level POD_COMMIT record, 'manifest'
+    truncates a random host's manifest, 'shard' flips a byte in a random
+    host's shard file."""
+    live = ckpt_mod.list_checkpoints(ckpt_dir)
+    if not live:
+        return None
+    step, path = live[-1]
+    if mode == 'random':
+        mode = rng.choice(['shard', 'manifest', 'commit'])
+    if mode == 'commit':
+        try:
+            os.remove(os.path.join(path, ckpt_mod._POD_COMMIT))
+        except FileNotFoundError:
+            pass
+        return step, 'commit'
+    hosts = sorted(n for n in os.listdir(path)
+                   if n.startswith(ckpt_mod._HOST_PREFIX)
+                   and os.path.isdir(os.path.join(path, n)))
+    if not hosts:
+        return step, 'already-empty'
+    host_dir = os.path.join(path, rng.choice(hosts))
+    if mode == 'manifest':
+        faults.corrupt_file(os.path.join(host_dir, ckpt_mod._MANIFEST),
+                            mode='truncate')
+        return step, 'manifest@%s' % os.path.basename(host_dir)
+    import json
+    try:
+        with open(os.path.join(host_dir, ckpt_mod._MANIFEST)) as f:
+            names = sorted(json.load(f)['files'])
+    except (OSError, ValueError, KeyError):
+        names = []
+    names = names or sorted(n for n in os.listdir(host_dir)
+                            if n not in (ckpt_mod._MANIFEST,
+                                         ckpt_mod._COMMIT))
+    if not names:
+        return step, 'already-empty'
+    faults.corrupt_file(os.path.join(host_dir, names[0]), mode='flip')
+    return step, 'shard@%s' % os.path.basename(host_dir)
+
+
+def pod_main(args, rng, ckpt_mod, faults, work, fail):
+    n = args.pod
+    ckpt_dir = os.path.join(work, 'pod-ckpts')
+    cache_dir = os.path.join(work, 'compile-cache')
+    outs = lambda tag: [os.path.join(work, '%s-r%d.txt' % (tag, r))  # noqa: E731,E501
+                        for r in range(n)]
+
+    ref_outs = outs('ref')
+    t0 = time.time()
+    res = run_pod(os.path.join(work, 'pod-ref-ckpts'), ref_outs,
+                  args.total, args.every, cache_dir=cache_dir)
+    if any(rc != 0 for rc, _ in res):
+        return fail('pod reference run failed:\n%s'
+                    % '\n'.join(err[-1500:] for _, err in res))
+    refs = [read_out(p) for p in ref_outs]
+    for r in range(1, n):
+        if refs[r][1] != refs[0][1]:
+            return fail('reference pod: replicated losses differ '
+                        'between hosts 0 and %d' % r)
+    print('[chaos] pod reference: %d hosts, %d steps, params %s  %.1fs'
+          % (n, len(refs[0][1]), refs[0][2][:12], time.time() - t0))
+
+    all_seen = {}
+    for rnd in range(1, args.rounds + 1):
+        victim = rng.randrange(n)
+        kill_at = rng.randrange(args.every, args.total + args.every,
+                                args.every)
+        round_outs = outs('round-%d' % rnd)
+        t0 = time.time()
+        res = run_pod(ckpt_dir, round_outs, args.total, args.every,
+                      kill_rank=victim, kill_at=kill_at,
+                      cache_dir=cache_dir)
+        if any('WEDGED' in err for _, err in res):
+            return fail('round %d: a survivor never detected the dead '
+                        'host (watchdog failure)' % rnd)
+        outcome = []
+        for r, (rc, err) in enumerate(res):
+            if rc == 0:
+                outcome.append('h%d:done' % r)
+            elif r == victim and rc == -signal.SIGKILL:
+                outcome.append('h%d:killed' % r)
+            else:
+                outcome.append('h%d:exit%s' % (r, rc))
+        resume = read_out(round_outs[0])[0]
+        for r in range(n):
+            _resume, losses, _sha = read_out(round_outs[r])
+            for idx, v in losses.items():
+                if v != refs[r][1].get(idx):
+                    return fail('round %d host %d: loss at step %d '
+                                'diverged (%r vs %r)'
+                                % (rnd, r, idx, v, refs[r][1].get(idx)))
+                key = (r, idx)
+                if key in all_seen and all_seen[key] != v:
+                    return fail('round %d host %d: step %d not '
+                                'reproducible across incarnations'
+                                % (rnd, r, idx))
+                all_seen[key] = v
+        note = ''
+        hit = None
+        if args.corrupt != 'none':
+            hit = corrupt_newest_pod(ckpt_mod, faults, ckpt_dir,
+                                     args.corrupt, rng)
+            if hit:
+                note = ' corrupt[%s@ckpt-%d]' % (hit[1], hit[0])
+        print('[chaos] pod round %d: resume=%s victim=h%d kill_at=%d %s '
+              '%.1fs%s' % (rnd, resume, victim, kill_at,
+                           ' '.join(outcome), time.time() - t0, note))
+
+    fin_outs = outs('final')
+    t0 = time.time()
+    res = run_pod(ckpt_dir, fin_outs, args.total, args.every,
+                  cache_dir=cache_dir)
+    if any(rc != 0 for rc, _ in res):
+        return fail('pod final run failed:\n%s'
+                    % '\n'.join(err[-1500:] for _, err in res))
+    for r in range(n):
+        resume, losses, sha = read_out(fin_outs[r])
+        for idx, v in losses.items():
+            if v != refs[r][1].get(idx):
+                return fail('pod final host %d: loss at step %d diverged'
+                            % (r, idx))
+        if sha != refs[r][2]:
+            return fail('pod final host %d: params digest %s != '
+                        'reference %s' % (r, sha, refs[r][2]))
+    print('[chaos] pod final: resume=%s -> %d steps, params match the '
+          'reference on every host  %.1fs'
+          % (read_out(fin_outs[0])[0], args.total, time.time() - t0))
+    print('[chaos] OK: pod of %d hosts survived %d kill-one-host rounds '
+          '+ %s corruption, bit parity held on every host'
+          % (n, args.rounds, args.corrupt))
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description='kill/corrupt/restart chaos loop over the checkpoint '
@@ -139,6 +355,12 @@ def main(argv=None):
     ap.add_argument('--workdir', default=None)
     ap.add_argument('--keep', action='store_true',
                     help='keep the workdir for inspection')
+    ap.add_argument('--pod', type=int, default=0, metavar='N',
+                    help='pod mode: N >= 2 composed-mesh processes; each '
+                         'round SIGKILLs ONE random host mid-step and '
+                         'restarts the whole pod (sharded two-phase '
+                         'checkpoints, heartbeat watchdog, warm compile '
+                         'cache)')
     args = ap.parse_args(argv)
 
     seed = args.seed if args.seed is not None else int(time.time())
@@ -156,6 +378,14 @@ def main(argv=None):
         print('[chaos] FAIL: %s' % msg)
         print('[chaos] workdir kept at %s' % work)
         return 1
+
+    if args.pod:
+        if args.pod < 2:
+            ap.error('--pod needs at least 2 hosts')
+        rc = pod_main(args, rng, ckpt_mod, faults, work, fail)
+        if rc == 0 and not args.keep and args.workdir is None:
+            shutil.rmtree(work, ignore_errors=True)
+        return rc
 
     ref_out = os.path.join(work, 'ref.txt')
     r = run_worker('-', ref_out, args.total, args.k, args.every)
