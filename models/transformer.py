@@ -209,7 +209,7 @@ def _pe_table(max_len, d_model):
 
 def build_decode_spec(vocab=67, d_model=32, n_head=4, n_layer=2, d_ff=64,
                       max_slots=8, max_cache_len=48, prompt_buckets=(8, 16),
-                      eos_id=1):
+                      eos_id=1, kv_cache_dtype='float32'):
     """Build the decode-serving program set for a decoder-only transformer
     LM. Returns the spec dict `inference.export_decode` consumes:
 
@@ -222,9 +222,20 @@ def build_decode_spec(vocab=67, d_model=32, n_head=4, n_layer=2, d_ff=64,
     The KV cache is per-layer persistable state shared by name between the
     programs; export_decode threads it as donated input->output state
     while baking every other parameter as constants.
+
+    kv_cache_dtype='int8' (ISSUE 11): the paged cache stores int8 rows
+    with one f32 scale per slot-page (kv_ks_<i>/kv_vs_<i> [S, T] ride
+    the cache_vars state next to the int8 [S, T, D] pages) and the
+    programs use the quantized write/prefill/attention kernels
+    (ops/decode_ops.py) — ~(1+4/D)/2 the cache bytes of the f32 form,
+    so the same cache-HBM budget holds ~2x the slots.
     """
     import numpy as np
     PA = fluid.ParamAttr
+    if kv_cache_dtype not in ('float32', 'int8'):
+        raise ValueError("kv_cache_dtype must be 'float32' or 'int8', "
+                         "got %r" % (kv_cache_dtype,))
+    kv_int8 = kv_cache_dtype == 'int8'
     S, T, D = int(max_slots), int(max_cache_len), int(d_model)
     if D % n_head or D % 2:
         raise ValueError("d_model must be even and divisible by n_head")
@@ -237,16 +248,26 @@ def build_decode_spec(vocab=67, d_model=32, n_head=4, n_layer=2, d_ff=64,
     cache_vars = []
     for i in range(n_layer):
         cache_vars += ['kv_k_%d' % i, 'kv_v_%d' % i]
+        if kv_int8:
+            cache_vars += ['kv_ks_%d' % i, 'kv_vs_%d' % i]
 
-    def const_param(name, shape, init):
+    def const_param(name, shape, init, dtype='float32'):
         return fluid.layers.create_parameter(
-            shape, 'float32', attr=PA(name=name, trainable=False),
+            shape, dtype, attr=PA(name=name, trainable=False),
             default_initializer=init)
 
     def caches(i):
         zero = fluid.initializer.ConstantInitializer(0.0)
-        return (const_param('kv_k_%d' % i, [S, T, D], zero),
-                const_param('kv_v_%d' % i, [S, T, D], zero))
+        dt = 'int8' if kv_int8 else 'float32'
+        k = const_param('kv_k_%d' % i, [S, T, D], zero, dt)
+        v = const_param('kv_v_%d' % i, [S, T, D], zero, dt)
+        if not kv_int8:
+            return k, v
+        # per-slot-page dequant scales; 1.0 keeps never-written pages
+        # dequantizing to exact zero rows without a 0-divide
+        one = fluid.initializer.ConstantInitializer(1.0)
+        return (k, v, const_param('kv_ks_%d' % i, [S, T], one),
+                const_param('kv_vs_%d' % i, [S, T], one))
 
     def pe_param():
         return const_param(
@@ -301,12 +322,25 @@ def build_decode_spec(vocab=67, d_model=32, n_head=4, n_layer=2, d_ff=64,
         x = fluid.layers.elementwise_add(x,
                                          fluid.layers.gather(table, pos))
         for i in range(n_layer):
-            kcache, vcache = caches(i)
-            q, k, v = qkv(x, i, 1)
-            kcache = fluid.layers.kv_cache_write(kcache, k, pos)
-            vcache = fluid.layers.kv_cache_write(vcache, v, pos)
-            a = fluid.layers.kv_cache_attention(q, kcache, vcache, pos,
-                                                n_head)
+            # cache params FIRST, then qkv — the op-creation order seeds
+            # the per-op rng streams, and the fp path must draw the same
+            # weights it always did (bit-compat with pre-int8 artifacts)
+            if kv_int8:
+                kcache, vcache, kscale, vscale = caches(i)
+                q, k, v = qkv(x, i, 1)
+                kcache, kscale = fluid.layers.kv_cache_write_quant(
+                    kcache, kscale, k, pos)
+                vcache, vscale = fluid.layers.kv_cache_write_quant(
+                    vcache, vscale, v, pos)
+                a = fluid.layers.kv_cache_attention_quant(
+                    q, kcache, kscale, vcache, vscale, pos, n_head)
+            else:
+                kcache, vcache = caches(i)
+                q, k, v = qkv(x, i, 1)
+                kcache = fluid.layers.kv_cache_write(kcache, k, pos)
+                vcache = fluid.layers.kv_cache_write(vcache, v, pos)
+                a = fluid.layers.kv_cache_attention(q, kcache, vcache,
+                                                    pos, n_head)
             x = block_tail(x, a, i, 1)
         step_logits = out_logits(x)                             # [S, V]
 
@@ -339,12 +373,22 @@ def build_decode_spec(vocab=67, d_model=32, n_head=4, n_layer=2, d_ff=64,
                     fluid.layers.reshape(z, shape=[1, L, n_head, dh]),
                     perm=[0, 2, 1, 3])
             for i in range(n_layer):
-                kcache, vcache = caches(i)
-                q, k, v = qkv(x, i, 2)
-                kcache = fluid.layers.kv_cache_prefill_write(kcache, k,
-                                                             slot)
-                vcache = fluid.layers.kv_cache_prefill_write(vcache, v,
-                                                             slot)
+                if kv_int8:
+                    kcache, vcache, kscale, vscale = caches(i)
+                    q, k, v = qkv(x, i, 2)
+                    kcache, kscale = \
+                        fluid.layers.kv_cache_prefill_write_quant(
+                            kcache, kscale, k, slot)
+                    vcache, vscale = \
+                        fluid.layers.kv_cache_prefill_write_quant(
+                            vcache, vscale, v, slot)
+                else:
+                    kcache, vcache = caches(i)
+                    q, k, v = qkv(x, i, 2)
+                    kcache = fluid.layers.kv_cache_prefill_write(
+                        kcache, k, slot)
+                    vcache = fluid.layers.kv_cache_prefill_write(
+                        vcache, v, slot)
                 scores = fluid.layers.matmul(heads(q), heads(k),
                                              transpose_y=True,
                                              alpha=dh ** -0.5)
@@ -379,4 +423,5 @@ def build_decode_spec(vocab=67, d_model=32, n_head=4, n_layer=2, d_ff=64,
             'prefill': prefills,
             'cache_vars': list(cache_vars),
             'max_slots': S, 'max_cache_len': T,
-            'eos_id': int(eos_id), 'vocab': int(vocab)}
+            'eos_id': int(eos_id), 'vocab': int(vocab),
+            'kv_cache_dtype': kv_cache_dtype}

@@ -265,6 +265,23 @@ def _canon(obj):
     return repr(obj)
 
 
+def quant_tag(tag, program):
+    """Entry tag for `program`: '<tag>-int8' when it carries quantized
+    ops (passes/quantize.py output), else `tag` unchanged. The int8 ops
+    already distinguish the FINGERPRINT (they are part of the serialized
+    desc); the tag split makes the quantized tier VISIBLE in the
+    `cache_ctl.py stats` per-tag breakdown so a replica owner can audit
+    that warm int8 programs are cached alongside the bf16 ones."""
+    try:
+        for b in program.blocks:
+            for op in b.ops:
+                if op.type.endswith('_int8'):
+                    return tag + '-int8'
+    except Exception:
+        pass
+    return tag
+
+
 def program_fingerprint(program):
     """Stable content hash of the serialized program desc: blocks, ops
     (type, slots, attrs — including the per-op uid that seeds op-local

@@ -718,7 +718,7 @@ class Executor(object):
             key_parts=self._aot_key_parts(program, fetch_names,
                                           out_state_names,
                                           extra=('multi', k, fetch_policy)),
-            tag='executor_steps', fun=step_k,
+            tag=self._cache_tag('executor_steps', program), fun=step_k,
             donate_state=self._donation_safe(program, feed_names,
                                              fetch_names,
                                              out_state_names))
@@ -738,6 +738,12 @@ class Executor(object):
                 int(getattr(program, '_grad_accum_k', 1) or 1),
                 _config.rng_impl(),
                 int(_config.get_flag('dropout_bits') or 0)) + tuple(extra)
+
+    def _cache_tag(self, base, program):
+        """Compile-cache entry tag: '-int8' suffix for quantized programs
+        so `cache_ctl stats` shows the quantized tier per tag."""
+        from .core import compile_cache as _cc
+        return _cc.quant_tag(base, program)
 
     def _donation_safe(self, program, feed_names, fetch_names,
                        out_state_names):
@@ -1199,7 +1205,7 @@ class Executor(object):
                 jax.jit(step, donate_argnums=(0,)),
                 key_parts=self._aot_key_parts(program, fetch_names,
                                               out_state_names),
-                tag='executor_run', fun=step,
+                tag=self._cache_tag('executor_run', program), fun=step,
                 donate_state=self._donation_safe(program, feed_names,
                                                  fetch_names,
                                                  out_state_names))

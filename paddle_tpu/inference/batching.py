@@ -159,6 +159,7 @@ class ServingStats(object):
     def __init__(self, window=8192):
         self._lock = threading.Lock()
         self._lat = deque(maxlen=window)
+        self.tier = 'bf16'   # serving tier of the source (bf16/int8)
         self.queue_depth = 0
         self.requests = 0
         self.batches = 0
@@ -193,7 +194,8 @@ class ServingStats(object):
         (filled/bucket rows), p50/p95/p99_ms over the latency window."""
         with self._lock:
             lat = np.asarray(self._lat, np.float64) * 1e3
-            snap = {'queue_depth': int(self.queue_depth),
+            snap = {'tier': self.tier,
+                    'queue_depth': int(self.queue_depth),
                     'requests': int(self.requests),
                     'batches': int(self.batches),
                     'shed': int(self.shed),
@@ -228,9 +230,17 @@ class BatchingPredictor(object):
 
     def __init__(self, artifact_dir, platform=None, max_batch_size=None,
                  batch_timeout_ms=5.0, inflight=2, stats_window=8192,
-                 max_queue=None):
+                 max_queue=None, tier=None):
+        # tier resolution happens ONCE at the top (`tier='int8'` serves
+        # the quantized tree); the per-bucket predictors below load from
+        # inside the resolved tier, where no further subdir exists. The
+        # profiler source keeps the ARTIFACT's name — the tier is its
+        # own report column, not part of the identity
+        display_dir = artifact_dir
+        artifact_dir = _serve.resolve_tier(artifact_dir, tier)
         with open(os.path.join(artifact_dir, _serve._SIGNATURE)) as f:
             top_sig = json.load(f)
+        self.tier = top_sig.get('tier', 'bf16')
         # lod rejection first: feeds are the same in every bucket, and
         # _batch_rows on an all-lod artifact would raise a misleading
         # "feeds disagree on the batch dimension" error
@@ -280,6 +290,7 @@ class BatchingPredictor(object):
         self._queue = queue.Queue()
         self._inflight = queue.Queue(maxsize=max(1, int(inflight)))
         self.stats = ServingStats(stats_window)
+        self.stats.tier = self.tier
         self._closed = False
         # orders submit()'s closed-check+enqueue against close()'s
         # closed-set+_STOP: no request can land behind the sentinel
@@ -296,7 +307,7 @@ class BatchingPredictor(object):
         prof = _maybe_profiler()
         if prof is not None and hasattr(prof, 'register_serving_source'):
             name = 'serving:%s#%d' % (
-                os.path.basename(os.path.normpath(artifact_dir)),
+                os.path.basename(os.path.normpath(display_dir)),
                 next(_SOURCE_SEQ))
             prof.register_serving_source(name, self.stats.snapshot)
             self._profiler_name = name

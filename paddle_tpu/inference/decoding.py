@@ -98,6 +98,7 @@ class DecodeStats(object):
         self._lock = threading.Lock()
         self._ttft = deque(maxlen=window)
         self._itl = deque(maxlen=window)
+        self.tier = 'bf16'   # KV-cache tier (bf16, or int8 paged cache)
         self.queue_depth = 0
         self.requests = 0        # completed requests
         self.tokens = 0          # tokens decoded (all beams)
@@ -134,6 +135,7 @@ class DecodeStats(object):
             occ = (self.active_slot_steps / self.slot_steps
                    if self.slot_steps else 0.0)
             return {'kind': 'decode',
+                    'tier': self.tier,
                     'queue_depth': int(self.queue_depth),
                     'requests': int(self.requests),
                     'tokens': int(self.tokens),
@@ -383,6 +385,10 @@ class DecodingPredictor(object):
         self._lifecycle = threading.Lock()
         self._queue = queue.Queue()
         self.stats = DecodeStats(stats_window)
+        # int8 paged-KV artifacts serve through the same scheduler; the
+        # tier rides the stats into serving_report's tier column
+        self.stats.tier = ('int8' if self._sig.get('kv_cache_dtype')
+                           == 'int8' else 'bf16')
         self._reset_state()
         self._sched_t = threading.Thread(
             target=self._sched_loop, name='ptpu-decode-sched', daemon=True)

@@ -33,9 +33,9 @@ import numpy as np
 
 # the artifact layout contract lives in serve.py (the loader); export
 # writes exactly what serve reads
-from .serve import (_SIGNATURE, _MODULE, _BUCKET_DIR, _TRAIN_SIGNATURE,
-                    _TRAIN_MODULE, _TRAIN_STATE0, _AOT_SIDECAR,
-                    _aot_platform, _precompile_infer_dir,
+from .serve import (_SIGNATURE, _MODULE, _BUCKET_DIR, _TIER_INT8,
+                    _TRAIN_SIGNATURE, _TRAIN_MODULE, _TRAIN_STATE0,
+                    _AOT_SIDECAR, _aot_platform, _precompile_infer_dir,
                     _precompile_train_dir)
 
 
@@ -94,7 +94,8 @@ def _normalize_lod_sample(name, value, lod_level):
 
 
 def export_compiled(predictor, sample_inputs, out_dir, batch_sizes=None,
-                    precompile=None):
+                    precompile=None, quantize=None, calibration=None,
+                    quantize_mode='abs_max', calibration_q=99.9):
     """Export `predictor`'s program as a tracer-free compiled artifact.
 
     sample_inputs: list (feed order) or dict of arrays fixing shapes and
@@ -118,9 +119,23 @@ def export_compiled(predictor, sample_inputs, out_dir, batch_sizes=None,
     first-request XLA compile. Default: on (PTPU_EXPORT_PRECOMPILE=0
     opts out); other platforms prewarm with `tools/cache_ctl.py prewarm`.
 
+    quantize='int8' (ISSUE 11): ALSO write a post-training-quantized
+    bucket tier under out_dir/int8/ — a complete artifact tree (same
+    buckets, own AOT sidecars) whose program went through
+    passes/quantize.py: calibrated per-tensor activation quant +
+    per-channel int8 weights, dequant fused into consumers. `calibration`
+    is required: a list of representative feed batches (dicts, or lists
+    in feed order) swept through the executor to observe activation
+    ranges; `quantize_mode` picks the observer ('abs_max'|'percentile',
+    percentile at `calibration_q`). The tier signature carries the full
+    calibration metadata INCLUDING every op left in float with its
+    machine-checkable reason code; the top-level signature records
+    'tiers' so loaders can pick per artifact
+    (CompiledPredictor/BatchingPredictor `tier='int8'`). The bf16 tier
+    is byte-identical to a quantize=None export.
+
     Returns out_dir. Load with inference/serve.py (no framework imports).
     """
-    program = predictor._program
     feed_names = list(predictor._feed_names)
     if isinstance(sample_inputs, (list, tuple)):
         sample = dict(zip(feed_names, sample_inputs))
@@ -130,21 +145,70 @@ def export_compiled(predictor, sample_inputs, out_dir, batch_sizes=None,
     if missing:
         raise ValueError("sample_inputs missing feeds: %r" % missing)
     program = _optimize_for_export(predictor)
-    if batch_sizes is None:
-        return _export_single(predictor, sample, out_dir, program=program,
-                              precompile=precompile)
+    sizes = None
+    if batch_sizes is not None:
+        sizes = sorted({int(b) for b in batch_sizes})
+        if not sizes or sizes[0] < 1:
+            raise ValueError("batch_sizes must be positive ints, got %r"
+                             % (batch_sizes,))
+        for name in feed_names:
+            v = program.global_block().var(name)
+            if int(getattr(v, 'lod_level', 0) or 0):
+                raise ValueError(
+                    "multi-bucket export serves dense feeds only; feed %r "
+                    "carries lod — export one artifact per lod bucket "
+                    "instead (the Executor's bucket_rows discipline)"
+                    % name)
+    quant_meta = None
+    if quantize is not None:
+        if quantize != 'int8':
+            raise ValueError("quantize must be None or 'int8', got %r"
+                             % (quantize,))
+        qprogram, quant_meta = _quantize_for_export(
+            predictor, calibration, quantize_mode, calibration_q)
+    _export_tier(predictor, program, sample, out_dir, sizes, precompile)
+    if quantize is None:
+        # a re-export WITHOUT quantize must not leave a previous export's
+        # int8 tier behind: resolve_tier would serve the STALE quantized
+        # weights against the fresh bf16 artifact with no error. A
+        # signature-less partial tier (interrupted export) is dead
+        # weight either way — remove it too.
+        stale = os.path.join(out_dir, _TIER_INT8)
+        if os.path.isdir(stale):
+            import warnings
+            warnings.warn(
+                'export_compiled: removing stale int8 tier %s (this '
+                "export did not request quantize='int8')" % stale,
+                RuntimeWarning)
+            shutil.rmtree(stale)
+        return out_dir
+    tier_sig = {'tier': 'int8', 'quantization': quant_meta}
+    _export_tier(predictor, qprogram, sample,
+                 os.path.join(out_dir, _TIER_INT8), sizes, precompile,
+                 extra_sig=tier_sig)
+    # record the tier inventory + calibration audit at the top level so
+    # a loader (or a fleet operator) discovers the quantized tier without
+    # probing subdirectories
+    sig_path = os.path.join(out_dir, _SIGNATURE)
+    with open(sig_path) as f:
+        sig = json.load(f)
+    sig['tiers'] = ['bf16', 'int8']
+    sig['quantization'] = quant_meta
+    with open(sig_path, 'w') as f:
+        json.dump(sig, f, indent=1)
+    return out_dir
 
-    sizes = sorted({int(b) for b in batch_sizes})
-    if not sizes or sizes[0] < 1:
-        raise ValueError("batch_sizes must be positive ints, got %r"
-                         % (batch_sizes,))
-    for name in feed_names:
-        v = program.global_block().var(name)
-        if int(getattr(v, 'lod_level', 0) or 0):
-            raise ValueError(
-                "multi-bucket export serves dense feeds only; feed %r "
-                "carries lod — export one artifact per lod bucket "
-                "instead (the Executor's bucket_rows discipline)" % name)
+
+def _export_tier(predictor, program, sample, out_dir, sizes,
+                 precompile, extra_sig=None):
+    """Write one complete artifact tree for `program`: single artifact
+    when `sizes` is None, else the multi-bucket tree (bucket_<n>/ per
+    size, top level mirroring the LARGEST bucket, top signature carrying
+    the bucket list)."""
+    feed_names = list(predictor._feed_names)
+    if sizes is None:
+        return _export_single(predictor, sample, out_dir, program=program,
+                              precompile=precompile, extra_sig=extra_sig)
     arrs = {n: np.asarray(sample[n]) for n in feed_names}
     flat = [n for n, a in arrs.items() if a.ndim < 1]
     if flat:
@@ -163,7 +227,8 @@ def export_compiled(predictor, sample_inputs, out_dir, batch_sizes=None,
                    for n, a in arrs.items()}
         _export_single(predictor, resized,
                        os.path.join(out_dir, _BUCKET_DIR % b),
-                       program=program, precompile=precompile)
+                       program=program, precompile=precompile,
+                       extra_sig=extra_sig)
     # top level mirrors the LARGEST bucket so CompiledPredictor(out_dir)
     # keeps working unchanged on a multi-bucket dir
     top = os.path.join(out_dir, _BUCKET_DIR % sizes[-1])
@@ -193,7 +258,57 @@ def export_compiled(predictor, sample_inputs, out_dir, batch_sizes=None,
     return out_dir
 
 
-def export_decode(spec, out_dir, scope=None, precompile=None):
+def _quantize_for_export(predictor, calibration, mode, q):
+    """Calibrate + quantize the predictor's program for the int8 tier.
+    Returns (optimized quantized program, signature metadata). The sweep
+    runs through the predictor's OWN executor and scope (the 'existing
+    executor' calibration path, PAPER.md §6); the quantized program then
+    goes through the standard inference pass pipeline, so constant
+    folding/DCE/act-fusion apply to the int8 form exactly as to the
+    float one."""
+    from .. import passes
+    if not calibration:
+        raise ValueError(
+            "quantize='int8' requires calibration=[feed batches...]: a "
+            "representative sweep is what defines the activation scales "
+            "(passes/quantize.calibrate_program)")
+    feed_names = list(predictor._feed_names)
+    fetch_names = [v.name for v in predictor._fetch_vars if v is not None]
+    batches = []
+    for b in calibration:
+        batches.append(dict(zip(feed_names, b))
+                       if isinstance(b, (list, tuple)) else dict(b))
+    calib = passes.calibrate_program(
+        predictor._program, batches, predictor._exe,
+        scope=predictor._scope, q=q)
+    qprog, report = passes.quantize_program(
+        predictor._program, calib, predictor._scope, mode=mode,
+        fetch_names=fetch_names, feed_names=feed_names)
+    try:
+        qprog, _ = passes.apply_inference_pipeline(
+            qprog, fetch_names=fetch_names, feed_names=feed_names)
+    except passes.ProgramVerifyError:
+        raise
+    except Exception as e:
+        import warnings
+        warnings.warn(
+            "int8 tier optimization pipeline failed (%s: %s); exporting "
+            "the unoptimized quantized program"
+            % (type(e).__name__, e), RuntimeWarning)
+    d = report.details
+    meta = {'method': 'post_training_int8', 'mode': d['mode'],
+            'percentile_q': float(q), 'calibration_batches': len(batches),
+            'quantized_ops': d['quantized_ops'],
+            'float_ops': d['float_ops'],
+            'float_op_reasons': d['float_op_reasons'],
+            'act_scales': d['act_scales'],
+            'weight_bytes_before': d['weight_bytes_before'],
+            'weight_bytes_after': d['weight_bytes_after']}
+    return qprog, meta
+
+
+def export_decode(spec, out_dir, scope=None, precompile=None,
+                  kv_cache_dtype=None):
     """Export a TWO-PROGRAM continuous-decode serving artifact (ISSUE 8).
 
     `spec` is the dict a decode model builder produces (e.g.
@@ -232,6 +347,13 @@ def export_decode(spec, out_dir, scope=None, precompile=None):
       prefill_<bucket>/       one per prompt bucket
       decode_reorder/         slot-gather program (undonated)
 
+    kv_cache_dtype='int8' (ISSUE 11): assert-and-record that the spec
+    was built with the quantized paged cache (build_decode_spec's
+    kv_cache_dtype) — the int8 pages + per-slot-page f32 scales thread
+    through as state like any other cache var, halving cache HBM so the
+    same budget serves ~2x max_slots. The signature records the dtype
+    and the per-state byte accounting for capacity planning.
+
     Load with inference/decoding.py DecodingPredictor (framework-free).
     Returns out_dir.
     """
@@ -239,6 +361,13 @@ def export_decode(spec, out_dir, scope=None, precompile=None):
     from .. import global_scope
     from . import decoding as _decoding
 
+    spec_kv = spec.get('kv_cache_dtype', 'float32')
+    if kv_cache_dtype is not None and kv_cache_dtype != spec_kv:
+        raise ValueError(
+            "export_decode(kv_cache_dtype=%r) but the spec was built "
+            "with kv_cache_dtype=%r — rebuild the decode spec with the "
+            "requested cache dtype (build_decode_spec(kv_cache_dtype=...))"
+            % (kv_cache_dtype, spec_kv))
     scope = scope if scope is not None else global_scope()
     state_names = list(spec['cache_vars'])
     state0 = []
@@ -281,6 +410,10 @@ def export_decode(spec, out_dir, scope=None, precompile=None):
            'max_cache_len': int(spec['max_cache_len']),
            'eos_id': int(spec['eos_id']), 'vocab': int(spec['vocab']),
            'prompt_buckets': buckets,
+           'kv_cache_dtype': spec_kv,
+           # fixed-HBM capacity planning: what the paged cache state
+           # costs per replica (int8 tier: int8 pages + f32 page scales)
+           'cache_bytes': int(sum(a.nbytes for a in state0)),
            'state': [{'name': n, 'shape': list(a.shape),
                       'dtype': a.dtype.name}
                      for n, a in zip(state_names, state0)],
@@ -434,9 +567,11 @@ def _peak_bytes_est(program, feed_names, fetch_names, feed_sig):
 
 
 def _export_single(predictor, sample, out_dir, program=None,
-                   precompile=None):
+                   precompile=None, extra_sig=None):
     """One fixed-shape export (the original export_compiled body);
-    `sample` is a {feed name: value} dict covering every feed."""
+    `sample` is a {feed name: value} dict covering every feed;
+    `extra_sig` entries merge into signature.json (the quantized tier's
+    tier/calibration metadata)."""
     import jax
     from jax import export as jexport
     from ..core.lowering import Tracer
@@ -536,6 +671,8 @@ def _export_single(predictor, sample, out_dir, program=None,
         # capacity planning reads it per bucket_<n>/signature.json before
         # ever loading the module
         sig['peak_bytes_est'] = est
+    if extra_sig:
+        sig.update(extra_sig)
     with open(os.path.join(out_dir, _SIGNATURE), 'w') as f:
         json.dump(sig, f, indent=1)
     if _should_precompile(precompile):

@@ -73,6 +73,11 @@ def _np_threefry_fold(seed, step):
 _SIGNATURE = 'signature.json'
 _MODULE = 'module.jaxexport'
 _BUCKET_DIR = 'bucket_%05d'  # per-bucket subdir of a multi-bucket artifact
+# quantized artifact tier (ISSUE 11): export_compiled(quantize='int8')
+# writes a COMPLETE second artifact tree under <artifact>/int8/ — same
+# buckets, own AOT sidecars, calibration metadata in its signature —
+# next to the default ('bf16') tier at the top level
+_TIER_INT8 = 'int8'
 _TRAIN_SIGNATURE = 'train_signature.json'
 _TRAIN_MODULE = 'train_module.jaxexport'
 _TRAIN_STATE0 = 'train_state0.npz'
@@ -89,6 +94,39 @@ _TRAIN_AOT_SIDECAR = 'aot_train_%s.jaxexec'  # % platform
 def _module_sha(module_bytes):
     import hashlib
     return hashlib.sha256(module_bytes).hexdigest()
+
+
+def resolve_tier(artifact_dir, tier=None):
+    """Resolve a serving-tier request to the artifact directory to load.
+
+    `tier` (or env PTPU_SERVE_TIER): 'bf16' (default) serves the top
+    level; 'int8' serves the quantized tier subdir. An EXPLICIT tier
+    argument on an artifact without that tier raises; the env preference
+    degrades silently to the default tier so one fleet-wide setting can
+    cover mixed artifact generations (and per-bucket loads inside an
+    already-resolved tier)."""
+    req = tier or os.environ.get('PTPU_SERVE_TIER')
+    if not req or req == 'bf16':
+        return artifact_dir
+    sub = os.path.join(artifact_dir, req)
+    # a tier dir counts only with its signature: a partial/interrupted
+    # export must surface the designed "has no tier" error, not a raw
+    # FileNotFoundError from deep inside the loader
+    if os.path.isdir(sub) and os.path.exists(os.path.join(sub,
+                                                          _SIGNATURE)):
+        return sub
+    if tier:
+        tiers = ['bf16']
+        try:
+            with open(os.path.join(artifact_dir, _SIGNATURE)) as f:
+                tiers = json.load(f).get('tiers', ['bf16'])
+        except Exception:
+            pass
+        raise ValueError(
+            "artifact %s has no %r tier (tiers: %s) — export with "
+            "export_compiled(..., quantize='int8') to add one"
+            % (artifact_dir, req, tiers))
+    return artifact_dir
 
 
 def _aot_platform(device=None):
@@ -267,6 +305,13 @@ def precompile_artifact(artifact_dir, platform=None):
                                                  platform=plat))
     if os.path.exists(os.path.join(artifact_dir, _TRAIN_MODULE)):
         written.append(_precompile_train_dir(artifact_dir, platform=plat))
+    # quantized artifact tier (ISSUE 11): a complete bucket tree under
+    # int8/ prewarms exactly like the top level, so warm int8 replicas
+    # answer with zero compiles too
+    tier_dir = os.path.join(artifact_dir, _TIER_INT8)
+    if os.path.isdir(tier_dir) and os.path.exists(
+            os.path.join(tier_dir, _SIGNATURE)):
+        written.extend(precompile_artifact(tier_dir, platform=plat))
     return written
 
 
@@ -409,10 +454,14 @@ class CompiledPredictor(object):
     `platform` (or env PTPU_PLATFORM) pins execution, e.g. 'cpu' or 'tpu';
     default is the process's default jax backend."""
 
-    def __init__(self, artifact_dir, platform=None):
+    def __init__(self, artifact_dir, platform=None, tier=None):
         import jax
+        artifact_dir = resolve_tier(artifact_dir, tier)
         with open(os.path.join(artifact_dir, _SIGNATURE)) as f:
             self._sig = json.load(f)
+        # the tier actually LOADED, from the artifact's own signature
+        # (the request may have resolved through env/default)
+        self.tier = self._sig.get('tier', 'bf16')
         with open(os.path.join(artifact_dir, _MODULE), 'rb') as f:
             module_bytes = f.read()
         # the StableHLO module deserializes LAZILY: a warm replica that
@@ -682,8 +731,8 @@ class CompiledPredictor(object):
         return results
 
 
-def load_compiled(artifact_dir):
-    return CompiledPredictor(artifact_dir)
+def load_compiled(artifact_dir, tier=None):
+    return CompiledPredictor(artifact_dir, tier=tier)
 
 
 class CompiledTrainer(object):

@@ -1617,6 +1617,52 @@ def kv_cache_attention(query, k_cache, v_cache, pos, n_head, scale=None):
     return out
 
 
+def kv_cache_write_quant(cache, cache_scale, kv, pos):
+    """kv_cache_write over the INT8 paged cache (ISSUE 11): `cache` is
+    int8 [max_slots, max_cache_len, d] with one f32 scale per slot-page
+    in `cache_scale` [max_slots, max_cache_len]. Each slot's f32 row
+    quantizes at its own abs-max page scale at write time. In-place on
+    the (cache, cache_scale) pair; returns both post-write bindings."""
+    helper = LayerHelper('kv_cache_write_quant')
+    helper.append_op(type='kv_cache_write_quant',
+                     inputs={'Cache': cache, 'Scale': cache_scale,
+                             'KV': kv, 'Pos': pos},
+                     outputs={'Out': cache, 'OutScale': cache_scale},
+                     attrs={})
+    return cache, cache_scale
+
+
+def kv_cache_prefill_write_quant(cache, cache_scale, kv, slot):
+    """kv_cache_prefill_write over the INT8 paged cache: a whole
+    prompt's [1, bucket_len, d] f32 rows quantize per position and blit
+    into ONE slot. In-place, like kv_cache_write_quant."""
+    helper = LayerHelper('kv_cache_prefill_write_quant')
+    helper.append_op(type='kv_cache_prefill_write_quant',
+                     inputs={'Cache': cache, 'Scale': cache_scale,
+                             'KV': kv, 'Slot': slot},
+                     outputs={'Out': cache, 'OutScale': cache_scale},
+                     attrs={})
+    return cache, cache_scale
+
+
+def kv_cache_attention_quant(query, k_cache, k_scale, v_cache, v_scale,
+                             pos, n_head, scale=None):
+    """kv_cache_attention over the INT8 paged cache: K/V rows dequantize
+    (int8 x per-page scale) INSIDE the attention body — no f32 cache
+    copy materializes. Same masked-window semantics as the fp op."""
+    helper = LayerHelper('kv_cache_attention_quant')
+    out = helper.create_variable_for_type_inference(query.dtype)
+    helper.append_op(type='kv_cache_attention_quant',
+                     inputs={'Q': query, 'KCache': k_cache,
+                             'KScale': k_scale, 'VCache': v_cache,
+                             'VScale': v_scale, 'Pos': pos},
+                     outputs={'Out': out},
+                     attrs={'n_head': int(n_head),
+                            'scale': float(scale or 0.0)})
+    out.stop_gradient = True
+    return out
+
+
 def fused_multihead_attention(q, k, v, causal=False, scale=1.0,
                               sequence_parallel=False, name=None):
     """Fused [B, H, S, D] attention: Pallas flash attention on TPU where

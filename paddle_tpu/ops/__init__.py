@@ -12,6 +12,7 @@ from . import metric_ops      # noqa: F401
 from . import control_ops     # noqa: F401
 from . import array_ops       # noqa: F401
 from . import decode_ops      # noqa: F401
+from . import quant_ops       # noqa: F401
 from . import sequence_ops    # noqa: F401
 from . import rnn_ops         # noqa: F401
 from . import sparse_ops      # noqa: F401

@@ -462,18 +462,12 @@ def _kv_cache_prefill_write(ctx, ins):
         cache, kv.astype(cache.dtype), (slot, 0, 0))]}
 
 
-@register('kv_cache_attention', no_grad=True, lod='none')
-def _kv_cache_attention(ctx, ins):
-    """One-token-per-slot attention over the paged cache: Q [S, D],
-    KCache/VCache [S, T, D], Pos [S] int32. Each slot attends its own
-    cache rows j <= pos (already written this step), heads split
-    inside the op (attr n_head); masked rows get exactly-zero weight
-    (-inf before softmax), so stale finite cache garbage in masked or
-    foreign rows can never perturb an active slot's output."""
-    q = ins['Q'][0]
-    kc = ins['KCache'][0]
-    vc = ins['VCache'][0]
-    pos = ins['Pos'][0].reshape(-1).astype(jnp.int32)
+def _paged_attention_body(ctx, q, kc, vc, pos):
+    """The shared heads-inside masked attention body: Q [S, D] attends
+    its own slot's cache rows j <= pos. Used by the fp and the int8-
+    dequantizing attention ops — ONE expression, so the fp path's
+    bit-identity contract is untouched and the quantized path differs
+    only by the dequant of its operands."""
     n_head = int(ctx.attr('n_head', 1))
     s, t, d = kc.shape
     dh = d // n_head
@@ -486,7 +480,104 @@ def _kv_cache_attention(ctx, ins):
     scores = jnp.where(valid[:, None, :], scores, -jnp.inf)
     w = jax.nn.softmax(scores, axis=-1)
     ctxv = jnp.einsum('sht,sthd->shd', w, vh)
-    return {'Out': [ctxv.reshape(s, d).astype(q.dtype)]}
+    return ctxv.reshape(s, d).astype(q.dtype)
+
+
+@register('kv_cache_attention', no_grad=True, lod='none')
+def _kv_cache_attention(ctx, ins):
+    """One-token-per-slot attention over the paged cache: Q [S, D],
+    KCache/VCache [S, T, D], Pos [S] int32. Each slot attends its own
+    cache rows j <= pos (already written this step), heads split
+    inside the op (attr n_head); masked rows get exactly-zero weight
+    (-inf before softmax), so stale finite cache garbage in masked or
+    foreign rows can never perturb an active slot's output."""
+    q = ins['Q'][0]
+    kc = ins['KCache'][0]
+    vc = ins['VCache'][0]
+    pos = ins['Pos'][0].reshape(-1).astype(jnp.int32)
+    return {'Out': [_paged_attention_body(ctx, q, kc, vc, pos)]}
+
+
+# ---------------------------------------------------------------------------
+# int8-quantized paged KV cache (ISSUE 11): the cache stores int8 rows
+# plus ONE f32 scale per slot-page (cache position) — [S, T] scales next
+# to the [S, T, D] int8 cache, ~(1 + 4/D)/2 the bytes of a bf16 cache —
+# so a fixed cache-HBM budget holds 2x the slots, the direct
+# occupancy -> throughput win for DecodingPredictor. Quantization
+# happens at WRITE time (each K/V row is seen exactly once); attention
+# dequantizes inside its own body, so no f32 copy of the cache ever
+# materializes in HBM.
+# ---------------------------------------------------------------------------
+
+_KV_QMAX = 127.0
+# an all-zero row quantizes to scale 0; the epsilon keeps q = x/s finite
+# (0 / eps = 0) without perturbing any real row's scale
+_KV_SCALE_EPS = 1e-30
+
+
+def _quantize_kv_rows(kv):
+    """[..., D] f32 -> (int8 [..., D], f32 scale [...]) with one
+    symmetric abs-max scale per row (= per slot-page once written)."""
+    s = jnp.max(jnp.abs(kv), axis=-1) / _KV_QMAX
+    s = jnp.maximum(s, _KV_SCALE_EPS)
+    q = jnp.clip(jnp.round(kv / s[..., None]), -_KV_QMAX, _KV_QMAX)
+    return q.astype(jnp.int8), s.astype(jnp.float32)
+
+
+@register('kv_cache_write_quant', no_grad=True, lod='none')
+def _kv_cache_write_quant(ctx, ins):
+    """kv_cache_write over the int8 cache: Cache int8 [S, T, D], Scale
+    f32 [S, T], KV f32 [S, D], Pos [S] int32. Each slot's row quantizes
+    at its own abs-max page scale; Out/OutScale alias Cache/Scale
+    (in-place on the persistable pair)."""
+    cache = ins['Cache'][0]
+    cscale = ins['Scale'][0]
+    kv = ins['KV'][0]
+    pos = ins['Pos'][0].reshape(-1).astype(jnp.int32)
+    q, s = _quantize_kv_rows(kv.astype(jnp.float32))
+
+    def upd(c, sc, qrow, srow, p):
+        c = jax.lax.dynamic_update_slice(c, qrow[None, :], (p, 0))
+        sc = jax.lax.dynamic_update_slice(sc, srow[None], (p,))
+        return c, sc
+
+    cache, cscale = jax.vmap(upd)(cache, cscale, q, s, pos)
+    return {'Out': [cache], 'OutScale': [cscale]}
+
+
+@register('kv_cache_prefill_write_quant', no_grad=True, lod='none')
+def _kv_cache_prefill_write_quant(ctx, ins):
+    """kv_cache_prefill_write over the int8 cache: KV [1, L, D] f32
+    quantizes per position (per slot-page) and blits into ONE slot of
+    Cache int8 [S, T, D] / Scale f32 [S, T]. Rows beyond the true
+    prompt length carry pad garbage the decode step overwrites before
+    any step attends them (the fp op's contract)."""
+    cache = ins['Cache'][0]
+    cscale = ins['Scale'][0]
+    kv = ins['KV'][0]
+    slot = ins['Slot'][0].reshape(-1).astype(jnp.int32)[0]
+    q, s = _quantize_kv_rows(kv.astype(jnp.float32))    # [1,L,D], [1,L]
+    cache = jax.lax.dynamic_update_slice(cache, q, (slot, 0, 0))
+    cscale = jax.lax.dynamic_update_slice(cscale, s, (slot, 0))
+    return {'Out': [cache], 'OutScale': [cscale]}
+
+
+@register('kv_cache_attention_quant', no_grad=True, lod='none')
+def _kv_cache_attention_quant(ctx, ins):
+    """kv_cache_attention over the int8 cache: dequantizes K/V INSIDE
+    the attention body (int8 row x its page scale), then runs the exact
+    fp masked-attention expression. Q stays f32; only cache STORAGE is
+    quantized, so transcripts track the fp-KV reference within the
+    per-page quantization step (~1/254 relative per row)."""
+    q = ins['Q'][0]
+    kc = ins['KCache'][0]
+    ks = ins['KScale'][0]
+    vc = ins['VCache'][0]
+    vs = ins['VScale'][0]
+    pos = ins['Pos'][0].reshape(-1).astype(jnp.int32)
+    kf = kc.astype(jnp.float32) * ks[:, :, None]
+    vf = vc.astype(jnp.float32) * vs[:, :, None]
+    return {'Out': [_paged_attention_body(ctx, q, kf, vf, pos)]}
 
 
 # ---------------------------------------------------------------------------

@@ -50,6 +50,9 @@ from .dataflow import (DataflowAnalysis, DonationCertificate, Hazard,
                        MemoryEstimate, MemoryOptimizeReport,
                        analyze_program, certify_donation, donation_plan,
                        var_bytes)
+from .quantize import (CalibrationResult, QuantizeProgramPass,
+                       calibrate_program, calibration_targets,
+                       quantize_program, quantize_weight)
 
 # constant_fold runs first so dead_op_elimination sweeps the literal
 # producers whose consumers folded; fuse_activation last, on the final

@@ -26,7 +26,9 @@ FUSABLE_ACTS = frozenset((
     'swish',
 ))
 
-# producer op type -> its primary output slot
+# producer op type -> its primary output slot (int8 producers output
+# DEQUANTIZED f32, so an activation fuses into their epilogue exactly as
+# into the float form — passes/quantize.py runs before this pass)
 FUSABLE_PRODUCERS = {
     'conv2d': 'Output',
     'depthwise_conv2d': 'Output',
@@ -34,6 +36,9 @@ FUSABLE_PRODUCERS = {
     'mul': 'Out',
     'matmul': 'Out',
     'elementwise_add': 'Out',
+    'conv2d_int8': 'Output',
+    'depthwise_conv2d_int8': 'Output',
+    'mul_int8': 'Out',
 }
 
 

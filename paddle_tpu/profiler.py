@@ -158,25 +158,32 @@ def serving_report():
         else:
             rows.append((name, snap))
     if rows:
-        print("%-32s %6s %8s %8s %5s %7s %7s %9s %9s %9s" %
-              ('Serving source', 'queue', 'requests', 'batches', 'occ',
-               'shed', 'expired', 'p50(ms)', 'p95(ms)', 'p99(ms)'))
+        # tier column (ISSUE 11): bf16/int8 per source, so a fleet
+        # serving mixed artifact tiers is auditable in one table
+        print("%-32s %5s %6s %8s %8s %5s %7s %7s %9s %9s %9s" %
+              ('Serving source', 'tier', 'queue', 'requests', 'batches',
+               'occ', 'shed', 'expired', 'p50(ms)', 'p95(ms)',
+               'p99(ms)'))
         for name, s in rows:
-            print("%-32s %6d %8d %8d %5.2f %7d %7d %9.2f %9.2f %9.2f" %
-                  (name[:32], s.get('queue_depth', 0),
+            print("%-32s %5s %6d %8d %8d %5.2f %7d %7d %9.2f %9.2f "
+                  "%9.2f" %
+                  (name[:32], s.get('tier', 'bf16'),
+                   s.get('queue_depth', 0),
                    s.get('requests', 0), s.get('batches', 0),
                    s.get('occupancy', 0.0), s.get('shed', 0),
                    s.get('expired', 0), s.get('p50_ms', 0.0),
                    s.get('p95_ms', 0.0), s.get('p99_ms', 0.0)))
     if decode_rows:
-        print("%-26s %5s %6s %7s %8s %8s %6s %5s %5s %10s %10s %9s %9s" %
-              ('Decode source', 'queue', 'reqs', 'tokens', 'tok/s',
-               'prefills', 'steps', 'occ', 'shed',
+        print("%-26s %5s %5s %6s %7s %8s %8s %6s %5s %5s %10s %10s %9s "
+              "%9s" %
+              ('Decode source', 'tier', 'queue', 'reqs', 'tokens',
+               'tok/s', 'prefills', 'steps', 'occ', 'shed',
                'ttftp50(ms)', 'ttftp99(ms)', 'itlp50(ms)', 'itlp99(ms)'))
         for name, s in decode_rows:
-            print("%-26s %5d %6d %7d %8.1f %8d %6d %5.2f %5d %10.2f "
+            print("%-26s %5s %5d %6d %7d %8.1f %8d %6d %5.2f %5d %10.2f "
                   "%10.2f %9.2f %9.2f" %
-                  (name[:26], s.get('queue_depth', 0),
+                  (name[:26], s.get('tier', 'bf16'),
+                   s.get('queue_depth', 0),
                    s.get('requests', 0), s.get('tokens', 0),
                    s.get('tokens_s', 0.0), s.get('prefills', 0),
                    s.get('steps', 0), s.get('occupancy', 0.0),

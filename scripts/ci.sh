@@ -59,6 +59,9 @@ python scripts/serve_bench_smoke.py
 echo "== decode serving smoke (continuous in-flight batching: Poisson A/B >=3x tokens/s vs sequential decode, bit-identical transcripts, 0-compile warm replica) =="
 JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/decode_serve_smoke.py
 
+echo "== quantized serving smoke (int8 tier: calibrate -> export both tiers, top-1 parity, 0-compile warm int8 replica, >=1.3x fixed-cache-HBM decode throughput via 2x max_slots) =="
+JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/quant_smoke.py
+
 echo "== tpu smoke tier (when a real chip is visible) =="
 if env -u JAX_PLATFORMS -u PTPU_PLATFORM -u XLA_FLAGS python - <<'EOF'
 import sys

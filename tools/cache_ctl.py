@@ -19,13 +19,23 @@ tiers: every prompt-length prefill bucket plus the decode-step and
 reorder programs, so DecodingPredictor replicas answer their first token
 with zero compiles.
 
-Exit codes (all subcommands, including the decode prewarm path):
+Quantized artifact tiers (ISSUE 11, export_compiled(quantize='int8')):
+an artifact carrying an int8/ tier subdir (its own bucket tree +
+signature) prewarms BOTH tiers automatically — every bf16 bucket, every
+int8 bucket, and the int8 top mirror — so a replica serving either tier
+(CompiledPredictor/BatchingPredictor tier='int8') starts with zero
+compiles. Int8-KV decode artifacts (export_decode of a
+kv_cache_dtype='int8' spec) prewarm through the standard decode layout:
+the quantized cache is ordinary program state.
+
+Exit codes (all subcommands, including the decode and quantized-tier
+prewarm paths):
   0  success (prewarm: at least one sidecar written)
   1  operation failed (compile error, unreadable module, no sidecar
      written)
   2  usage error (unknown subcommand, missing/non-artifact directory —
      a dir carrying none of decode_signature.json / signature.json /
-     train_module.jaxexport)
+     train_module.jaxexport; a bare int8/ tier dir IS an artifact dir)
 """
 from __future__ import annotations
 
@@ -110,8 +120,13 @@ def _cmd_prewarm(args):
 
 
 def main(argv=None):
-    ap = argparse.ArgumentParser(prog='cache_ctl.py',
-                                 description=__doc__.split('\n')[0])
+    # --help carries the full contract: the artifact layouts prewarm
+    # understands (multi-bucket, decode two-program, quantized int8/
+    # tier) and the exit codes automation keys on
+    ap = argparse.ArgumentParser(
+        prog='cache_ctl.py', description=__doc__.split('\n')[0],
+        epilog=__doc__[__doc__.index('Quantized artifact tiers'):],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
     sub = ap.add_subparsers(dest='cmd')
     p = sub.add_parser('stats', help='print on-disk cache statistics')
     p.add_argument('--dir', help='cache dir (default: configured)')

@@ -26,6 +26,33 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # name -> zero-arg builder returning the fetch vars worth rooting at; each
 # runs inside fresh default programs. Transformer/BERT build with shrunken
 # dims — the lint walks op STRUCTURE, layer count adds nothing but time.
+def _quantized_infer(build_logits, feed_shape, batch=2):
+    """Zoo builder body for a QUANTIZED inference variant (ISSUE 11):
+    build the inference net in the current main program, init + run one
+    synthetic calibration batch through the executor, then apply
+    passes/quantize.py IN PLACE — the doctor/linter then examines the
+    program the int8 artifact tier actually serves."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import passes
+    main = fluid.default_main_program()
+    startup = fluid.default_startup_program()
+    img = fluid.layers.data(name='data', shape=list(feed_shape),
+                            dtype='float32')
+    logits = build_logits(img)
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        feed = {'data': np.random.RandomState(0).randn(
+            batch, *feed_shape).astype(np.float32)}
+        calib = passes.calibrate_program(main, [feed], exe, scope=scope)
+        passes.quantize_program(main, calib, scope,
+                                fetch_names=[logits.name],
+                                feed_names=['data'], inplace=True)
+    return logits
+
+
 def _model_builders():
     import models.alexnet
     import models.bert
@@ -39,6 +66,17 @@ def _model_builders():
     import models.transformer
     import models.vgg
     return {
+        # quantized inference variants: the programs the int8 artifact
+        # tier serves; the doctor baseline gates their reason codes and
+        # hazards like any other zoo member
+        'smallnet_int8': lambda: _quantized_infer(
+            lambda x: models.smallnet.smallnet(x), (3, 32, 32)),
+        'resnet_cifar_int8': lambda: _quantized_infer(
+            lambda x: models.resnet.resnet_cifar10(x, is_train=False),
+            (3, 32, 32)),
+        'alexnet_int8': lambda: _quantized_infer(
+            lambda x: models.alexnet.alexnet(x, is_train=False),
+            (3, 224, 224), batch=1),
         'smallnet': lambda: models.smallnet.build_train_net()[2:],
         'alexnet': lambda: models.alexnet.build_train_net()[2:],
         'vgg': lambda: models.vgg.build_train_net(depth=16)[2:],
