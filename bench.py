@@ -1298,6 +1298,109 @@ def bench_data_plane():
                  bit_identical=bool(d_serial == d_pooled))
 
 
+def bench_fleet_serving():
+    """Serving-fleet control plane (ISSUE 12): the SAME 5x Poisson load
+    swing (low -> 5x surge -> low, rates calibrated to one replica's
+    measured capacity) offered to (a) a pinned single decode replica
+    and (b) a pinned N-replica fleet of subprocess replicas.
+    value = the fleet's p99 TTFT over the swing (ms, lower is
+    better); vs_baseline = single-replica p99 TTFT / fleet p99 TTFT —
+    the tail-latency cut the fleet buys at the same offered load (the
+    single replica queues the surge; the fleet absorbs it). Fleet and
+    single tokens/s ride along as fields, with the caveat that on a
+    core-starved CI host the arrival generator itself slows under the
+    fleet's worker processes, so wall-clock token rates under-report
+    the fleet (PERF_NOTES round 15). The fleet arm runs N pre-warmed replicas (the
+    steady-state the autoscaler converges to; REACTIVE scale-out under
+    the same swing is exercised end-to-end by scripts/fleet_smoke.py —
+    on a CPU-starved host a mid-surge spin-up steals cycles from
+    serving, so the bench pins the arms instead of racing them). Decode
+    steps are dispatch-floor-bound, so replica processes scale even on
+    a small CI host (compute-bound fleets need cores >= replicas).
+
+    Env knobs: PTPU_BENCH_FLEET_{REQS,MAX_NEW,REPLICAS}."""
+    import tempfile
+    import paddle_tpu as fluid
+    from models.transformer import build_decode_spec
+    from paddle_tpu.inference import FleetRouter, export_decode
+
+    max_replicas = int(os.environ.get('PTPU_BENCH_FLEET_REPLICAS', '3'))
+    surge_n = int(os.environ.get('PTPU_BENCH_FLEET_REQS', '120'))
+    max_new = int(os.environ.get('PTPU_BENCH_FLEET_MAX_NEW', '96'))
+
+    tmp = tempfile.mkdtemp(prefix='ptpu_bench_fleet_')
+    art = os.path.join(tmp, 'decode_art')
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope), fluid.unique_name.guard():
+        spec = build_decode_spec(vocab=211, d_model=48, n_head=4,
+                                 n_layer=2, d_ff=96, max_slots=4,
+                                 max_cache_len=max_new + 10,
+                                 prompt_buckets=(4, 8), eos_id=1)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(spec['startup'])
+        export_decode(spec, art, scope=scope)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(2, 211, rng.randint(2, 9))
+               for _ in range(200)]
+
+    def offer_swing(router, base_hz):
+        futs = []
+        arr = np.random.RandomState(1)
+        for n, hz in ((surge_n // 4, base_hz), (surge_n, base_hz * 5),
+                      (surge_n // 4, base_hz)):
+            for k in range(n):
+                futs.append(router.submit(prompts[k % len(prompts)],
+                                          max_new_tokens=max_new))
+                time.sleep(arr.exponential(1.0 / hz))
+        return futs
+
+    def run_arm(n_replicas, base_hz=None):
+        router = FleetRouter(art, replicas=n_replicas, platform='cpu')
+        try:
+            if base_hz is None:
+                # capacity calibration, SINGLE arm only: both arms offer
+                # the same swing, derived from one replica's capacity
+                t0 = time.perf_counter()
+                cal = [router.submit(prompts[k], max_new_tokens=max_new)
+                       for k in range(16)]
+                for f in cal:
+                    f.result(300)
+                cap_hz = 16.0 / (time.perf_counter() - t0)
+                base_hz = min(0.4 * cap_hz, 30.0)
+                # the closed-loop burst queues hard on a 4-slot
+                # replica: drop its high-TTFT samples so the reported
+                # percentiles cover ONLY the swing both arms share
+                router.stats.reset()
+            t0 = time.perf_counter()
+            futs = offer_swing(router, base_hz)
+            toks = [f.result(600) for f in futs]
+            wall = time.perf_counter() - t0
+            snap = router.fleet_snapshot()
+            n_tok = sum(len(t) for t in toks)
+            return {'tok_s': n_tok / wall, 'base_hz': base_hz,
+                    'ttft_p50_ms': snap['ttft_p50_ms'],
+                    'ttft_p99_ms': snap['ttft_p99_ms'],
+                    'p99_ms': snap['p99_ms'],
+                    'failed': snap['failed']}
+        finally:
+            router.close()
+
+    single = run_arm(1)
+    fleet = run_arm(max_replicas, base_hz=single['base_hz'])
+    return _line('fleet_serving_ttft_p99_ms', fleet['ttft_p99_ms'],
+                 'ms', (single['ttft_p99_ms'] / fleet['ttft_p99_ms'])
+                 if fleet['ttft_p99_ms'] else 1.0,
+                 max_replicas=max_replicas,
+                 single_ttft_p99_ms=single['ttft_p99_ms'],
+                 ttft_p50_ms=fleet['ttft_p50_ms'],
+                 single_ttft_p50_ms=single['ttft_p50_ms'],
+                 tok_s=round(fleet['tok_s'], 1),
+                 single_tok_s=round(single['tok_s'], 1),
+                 offered_req_s=round(single['base_hz'] * 5, 1),
+                 dropped=fleet['failed'] + single['failed'],
+                 baseline_ref='self_1replica_same_swing')
+
+
 def bench_ctr():
     import paddle_tpu as fluid
     from models.deepfm import build_deepfm_train
@@ -1381,6 +1484,11 @@ BENCHES = [
     # data-plane feeder saturation (ISSUE 9): host-side serial-vs-pooled
     # A/B; vs_baseline is the pooled/serial ratio (>=3x acceptance)
     ('data_plane_samples_s', bench_data_plane),
+    # serving-fleet control plane (ISSUE 12): 1-replica vs N-replica
+    # FleetRouter under the SAME Poisson swing; value = fleet p99 TTFT
+    # (ms, lower better), vs_baseline = single p99 / fleet p99 (the
+    # tail-latency cut)
+    ('fleet_serving_ttft_p99_ms', bench_fleet_serving),
 ]
 
 # PTPU_BENCH_ONLY token -> metric-name prefix; indices derive from BENCHES
@@ -1398,6 +1506,7 @@ _SHORT_PREFIX = {
     'smallnet_k': 'smallnet_cifar_multistep',
     'lstm_k': 'stacked_lstm_multistep', 'ocr_k': 'ocr_crnn_multistep',
     'data_plane': 'data_plane',
+    'fleet': 'fleet_serving',
 }
 _SHORT = {tok: next(i for i, (n, _) in enumerate(BENCHES)
                     if n.startswith(pref))

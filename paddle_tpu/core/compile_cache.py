@@ -50,6 +50,7 @@ per process, never mixed mid-stream.)
 """
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import json
 import os
@@ -758,13 +759,17 @@ def aot_or_jit(jitted, args, key_parts, tag='program', fun=None,
     exported_bytes = None
     compiled = None
     donated = False
+    # fresh_compile: the executable below goes to store()'s tier 1 via
+    # serialize_executable — a tier-3-satisfied compile would serialize
+    # into a blob no other process can load
     if use_export:
         try:
             from jax import export as jexport
             exp = jexport.export(cache_jit)(*args)
             exported_bytes = exp.serialize()
-            compiled, donated = _compile_maybe_donated(jax, exp.call,
-                                                       donate, args)
+            with fresh_compile():
+                compiled, donated = _compile_maybe_donated(
+                    jax, exp.call, donate, args)
         except Exception:
             exported_bytes = None
             compiled = None
@@ -772,11 +777,12 @@ def aot_or_jit(jitted, args, key_parts, tag='program', fun=None,
         # programs jax.export cannot carry (host callbacks, exotic
         # shardings): direct AOT compile — tier 1 only
         try:
-            if donate and fun is not None:
-                compiled, donated = _compile_maybe_donated(jax, fun,
-                                                           donate, args)
-            else:
-                compiled = cache_jit.lower(*args).compile()
+            with fresh_compile():
+                if donate and fun is not None:
+                    compiled, donated = _compile_maybe_donated(
+                        jax, fun, donate, args)
+                else:
+                    compiled = cache_jit.lower(*args).compile()
         except TypeError:
             # a backend/jit wrapper without .lower: give up on caching
             return jitted
@@ -789,6 +795,43 @@ def aot_or_jit(jitted, args, key_parts, tag='program', fun=None,
     store(key, compiled=compiled, exported_bytes=exported_bytes, tag=tag,
           donated=donated)
     return compiled
+
+
+@contextlib.contextmanager
+def fresh_compile():
+    """Compile with jax's persistent compilation cache (tier 3)
+    DISABLED. An executable that tier 3 satisfied re-serializes into a
+    blob other processes CANNOT deserialize ('Symbols not found: ...'
+    at load — measured on XLA:CPU, ISSUE 12 round): anything destined
+    for serialize_executable (tier-1 entries, AOT warm-start sidecars)
+    must come from a genuinely fresh XLA compile. Scoped and
+    exception-safe; a no-op on jax versions without the flag.
+
+    jax latches cache-enablement ONCE per process
+    (compilation_cache.is_cache_used caches its verdict), so toggling
+    the flag alone is ignored after the first compile — the latch is
+    reset around the scope (and re-reset after, so the surrounding
+    run's tier-3 behavior is unchanged)."""
+    import jax
+
+    def _unlatch():
+        try:
+            from jax._src import compilation_cache as _jcc
+            _jcc.reset_cache()
+        except Exception:
+            pass
+    try:
+        old = bool(jax.config.jax_enable_compilation_cache)
+    except AttributeError:
+        yield
+        return
+    try:
+        jax.config.update('jax_enable_compilation_cache', False)
+        _unlatch()
+        yield
+    finally:
+        jax.config.update('jax_enable_compilation_cache', old)
+        _unlatch()
 
 
 def _compile_maybe_donated(jax, fn, donate, args):

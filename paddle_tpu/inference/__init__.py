@@ -18,6 +18,11 @@
   autoregressive decode over export_decode's two-program artifact
   (prompt-bucketed prefill + fixed-slot decode step over a paged,
   donated KV cache; token-streaming futures).
+- fleet: FleetRouter — the replica-fleet control plane over any of the
+  predictors above (subprocess workers via fleet_worker.py,
+  least-outstanding-work routing with deadline propagation,
+  heartbeat-watchdog failover, Autoscaler, RollingRollout canary/
+  promote/rollback).
 The reference's analysis/TensorRT/MKLDNN pass zoo is subsumed by XLA:
 clone(for_test) freezes BN/dropout, XLA does the fusion.
 """
@@ -32,6 +37,9 @@ from .batching import (BatchingPredictor, ServingStats, load_batching,
                        ServerOverloaded, DeadlineExceeded)
 from .decoding import (DecodingPredictor, DecodeStats, TokenStream,
                        load_decoding)
+from .fleet import (FleetRouter, FleetStats, Autoscaler, RollingRollout,
+                    ReplicaFailed, FleetUnavailable, RolloutRolledBack,
+                    load_fleet)
 
 __all__ = ['Config', 'Predictor', 'create_predictor',
            'load_reference_inference_model',
@@ -42,4 +50,7 @@ __all__ = ['Config', 'Predictor', 'create_predictor',
            'export_decode', 'DecodingPredictor', 'DecodeStats',
            'TokenStream', 'load_decoding',
            'BatchingPredictor', 'ServingStats', 'load_batching',
-           'ServerOverloaded', 'DeadlineExceeded']
+           'ServerOverloaded', 'DeadlineExceeded',
+           'FleetRouter', 'FleetStats', 'Autoscaler', 'RollingRollout',
+           'ReplicaFailed', 'FleetUnavailable', 'RolloutRolledBack',
+           'load_fleet']

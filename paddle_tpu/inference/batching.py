@@ -167,6 +167,7 @@ class ServingStats(object):
         self.bucket_rows = 0
         self.shed = 0      # fast-failed at submit: queue beyond max_queue
         self.expired = 0   # deadline_ms elapsed while queued
+        self.drained = 0   # shed by drain(): queued when scale-in began
 
     def reset(self):
         """Zero the counters and latency window (queue_depth is a live
@@ -180,6 +181,7 @@ class ServingStats(object):
             self.bucket_rows = 0
             self.shed = 0
             self.expired = 0
+            self.drained = 0
 
     def record_batch(self, filled, bucket, latencies_s):
         with self._lock:
@@ -200,6 +202,7 @@ class ServingStats(object):
                     'batches': int(self.batches),
                     'shed': int(self.shed),
                     'expired': int(self.expired),
+                    'drained': int(self.drained),
                     'occupancy': round(self.filled_rows / self.bucket_rows, 4)
                     if self.bucket_rows else 0.0}
         if lat.size:
@@ -292,6 +295,7 @@ class BatchingPredictor(object):
         self.stats = ServingStats(stats_window)
         self.stats.tier = self.tier
         self._closed = False
+        self._draining = False
         # orders submit()'s closed-check+enqueue against close()'s
         # closed-set+_STOP: no request can land behind the sentinel
         self._lifecycle = threading.Lock()
@@ -371,6 +375,18 @@ class BatchingPredictor(object):
             for o in self._preds[b]._call_flat(args):
                 np.asarray(o)
         return self
+
+    def drain(self):
+        """Draining stop for scale-in (the fleet router's hook): stop
+        admitting (submit() raises), SHED the queued backlog loudly —
+        each queued request resolves ServerOverloaded and is counted in
+        both `shed` and `drained` (it was never dispatched, so a router
+        can safely re-route it) — then wait for the in-flight dispatches
+        to deliver and stop the worker threads. Contrast close(), which
+        serves the backlog before stopping. Idempotent."""
+        with self._lifecycle:
+            self._draining = True
+        self.close()
 
     def close(self):
         """Drain queued requests, stop worker threads, unregister metrics.
@@ -461,6 +477,18 @@ class BatchingPredictor(object):
                (req.deadline - req.t_submit) * 1e3)))
         return True
 
+    def _shed_drained(self, req):
+        """drain() in progress: a still-queued request sheds loudly
+        (ServerOverloaded; shed+drained counters) instead of joining a
+        batch — it never cost device work, so a fleet router can
+        re-route it to another replica."""
+        with self.stats._lock:
+            self.stats.queue_depth -= 1
+            self.stats.shed += 1
+            self.stats.drained += 1
+        _resolve(req.future, exc=ServerOverloaded(
+            'request shed: predictor draining for scale-in'))
+
     def _coalesce_loop(self):
         carry = None
         while True:
@@ -468,6 +496,9 @@ class BatchingPredictor(object):
             carry = None
             if req is _STOP:
                 return
+            if self._draining:
+                self._shed_drained(req)
+                continue
             if self._reap_expired(req):
                 continue
             batch, rows = [req], req.rows
@@ -483,6 +514,9 @@ class BatchingPredictor(object):
                 if nxt is _STOP:
                     carry = _STOP  # dispatch this batch, then stop
                     break
+                if self._draining:
+                    self._shed_drained(nxt)
+                    continue
                 if self._reap_expired(nxt):
                     continue
                 if rows + nxt.rows > self._max_rows:

@@ -59,6 +59,7 @@ except ImportError:  # imported by file path: siblings sit alongside
     import batching as _batching
 
 _STOP = object()
+_WAKE = object()   # no-op queue item: rouse an idle scheduler (drain)
 _SOURCE_SEQ = _serve._SOURCE_SEQ
 _maybe_profiler = _serve._maybe_profiler
 select_bucket = _batching.select_bucket
@@ -109,6 +110,7 @@ class DecodeStats(object):
         self.slot_steps = 0
         self.shed = 0
         self.expired = 0
+        self.drained = 0         # shed by drain(): queued at scale-in
         self.busy_s = 0.0        # wall time with >= 1 active slot
 
     def reset(self):
@@ -126,6 +128,7 @@ class DecodeStats(object):
             self.slot_steps = 0
             self.shed = 0
             self.expired = 0
+            self.drained = 0
             self.busy_s = 0.0
 
     def snapshot(self):
@@ -147,6 +150,7 @@ class DecodeStats(object):
                     if self.busy_s else 0.0,
                     'shed': int(self.shed),
                     'expired': int(self.expired),
+                    'drained': int(self.drained),
                     'ttft_p50_ms': ttft50, 'ttft_p99_ms': ttft99,
                     'itl_p50_ms': itl50, 'itl_p99_ms': itl99}
 
@@ -288,7 +292,7 @@ def _precompile_decode_dir(d, state_specs, arg_specs, donate, platform=None):
     dev = jax.devices(plat)[0]
     exp = jexport.deserialize(module_bytes)
     kw = {'donate_argnums': (0,)} if donate else {}
-    with jax.default_device(dev):
+    with jax.default_device(dev), _serve._fresh_compile():
         compiled = jax.jit(exp.call, **kw).lower(
             state_specs, *arg_specs).compile()
     return _serve._save_aot(os.path.join(d, _serve._AOT_SIDECAR % plat),
@@ -350,8 +354,16 @@ class DecodingPredictor(object):
     """
 
     def __init__(self, artifact_dir, platform=None, max_queue=None,
-                 default_max_new_tokens=32, stats_window=8192):
+                 default_max_new_tokens=32, stats_window=8192,
+                 tier=None):
         import jax
+        # tier resolution (ISSUE 12 satellite): `tier='int8'` serves a
+        # quantized decode tier exported under <artifact>/int8/ — the
+        # BatchingPredictor(tier=) contract: an EXPLICIT missing tier
+        # raises, the env preference (PTPU_SERVE_TIER) degrades to the
+        # top level silently
+        artifact_dir = _serve.resolve_tier(artifact_dir, tier,
+                                           signature=_DECODE_SIGNATURE)
         with open(os.path.join(artifact_dir, _DECODE_SIGNATURE)) as f:
             self._sig = json.load(f)
         self._S = int(self._sig['max_slots'])
@@ -382,6 +394,8 @@ class DecodingPredictor(object):
         self._state = None
         self._slots = [None] * self._S    # slot -> (request, beam index)
         self._closed = False
+        self._draining = False
+        self._idle_evt = threading.Event()
         self._lifecycle = threading.Lock()
         self._queue = queue.Queue()
         self.stats = DecodeStats(stats_window)
@@ -423,6 +437,15 @@ class DecodingPredictor(object):
             raise RuntimeError('DecodingPredictor is closed')
         beam = int(beam) if beam else None
         stream = TokenStream(beam=beam)
+        if self._draining:
+            # draining for scale-in: stop admitting; shed loudly (the
+            # request never cost device work — a fleet router re-routes)
+            with self.stats._lock:
+                self.stats.shed += 1
+                self.stats.drained += 1
+            stream._fail(ServerOverloaded(
+                'request shed: endpoint draining for scale-in'))
+            return stream
 
         def _shed_locked():
             return _batching.shed_if_overloaded(
@@ -455,6 +478,13 @@ class DecodingPredictor(object):
         with self._lifecycle:
             if self._closed:
                 raise RuntimeError('DecodingPredictor is closed')
+            if self._draining:
+                with self.stats._lock:
+                    self.stats.shed += 1
+                    self.stats.drained += 1
+                stream._fail(ServerOverloaded(
+                    'request shed: endpoint draining for scale-in'))
+                return stream
             with self.stats._lock:
                 if _shed_locked():      # re-check atomically with enqueue
                     return stream
@@ -489,6 +519,23 @@ class DecodingPredictor(object):
         self._reset_state()
         return self
 
+    def drain(self, timeout=None):
+        """Draining stop for scale-in (the fleet router's hook): stop
+        admitting — new submissions shed ServerOverloaded (counted in
+        shed+drained; never dispatched, so a router can re-route them)
+        and WAITING queued requests shed the same way — while every
+        ACTIVE stream finishes decoding to completion (zero dropped
+        in-flight streams). Blocks until the last active slot frees (or
+        `timeout`); returns True when fully drained. The endpoint stays
+        open for stats/close(); it admits nothing afterwards."""
+        with self._lifecycle:
+            if self._closed:
+                return True
+            self._draining = True
+            self._idle_evt.clear()
+            self._queue.put(_WAKE)  # rouse an idle scheduler
+        return self._idle_evt.wait(timeout)
+
     def close(self):
         """Stop the scheduler thread. Waiting and in-flight requests
         resolve with RuntimeError. Idempotent; submit() afterwards
@@ -499,6 +546,7 @@ class DecodingPredictor(object):
             if not self._closed:
                 self._closed = True
                 self._queue.put(_STOP)
+        self._idle_evt.set()   # never strand a drain() waiter
         if threading.current_thread() is not self._sched_t:
             self._sched_t.join()
         name, self._profiler_name = self._profiler_name, None
@@ -591,12 +639,20 @@ class DecodingPredictor(object):
             if item is _STOP:
                 self._drain_on_close(waiting)
                 return
+            if item is _WAKE:
+                item = None
             if item is not None:
                 waiting.append(item)
                 continue  # keep draining submissions before dispatching
             t0 = time.perf_counter()
+            if self._draining:
+                # scale-in drain: shed the waiting queue loudly (safe to
+                # re-route — never dispatched); active streams keep
+                # stepping to completion below
+                self._shed_waiting(waiting)
             self._expire(waiting)
-            self._admit(waiting)
+            if not self._draining:
+                self._admit(waiting)
             if any(s is not None for s in self._slots):
                 try:
                     self._step()
@@ -604,6 +660,22 @@ class DecodingPredictor(object):
                     self._fail_all(e)
                 with self.stats._lock:
                     self.stats.busy_s += time.perf_counter() - t0
+            if self._draining and not waiting \
+                    and not any(s is not None for s in self._slots):
+                self._idle_evt.set()
+
+    def _shed_waiting(self, waiting):
+        """drain() in progress: fail every WAITING request with
+        ServerOverloaded (shed+drained counters) — they never reached a
+        slot, so a fleet router can re-route them."""
+        while waiting:
+            req = waiting.popleft()
+            with self.stats._lock:
+                self.stats.queue_depth -= 1
+                self.stats.shed += 1
+                self.stats.drained += 1
+            req.stream._fail(ServerOverloaded(
+                'request shed: endpoint draining for scale-in'))
 
     def _drain_on_close(self, waiting):
         err = RuntimeError('DecodingPredictor closed')

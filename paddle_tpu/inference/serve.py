@@ -96,7 +96,7 @@ def _module_sha(module_bytes):
     return hashlib.sha256(module_bytes).hexdigest()
 
 
-def resolve_tier(artifact_dir, tier=None):
+def resolve_tier(artifact_dir, tier=None, signature=_SIGNATURE):
     """Resolve a serving-tier request to the artifact directory to load.
 
     `tier` (or env PTPU_SERVE_TIER): 'bf16' (default) serves the top
@@ -104,7 +104,9 @@ def resolve_tier(artifact_dir, tier=None):
     argument on an artifact without that tier raises; the env preference
     degrades silently to the default tier so one fleet-wide setting can
     cover mixed artifact generations (and per-bucket loads inside an
-    already-resolved tier)."""
+    already-resolved tier). `signature` names the file a valid tier dir
+    must carry — continuous-decode artifacts resolve against
+    decode_signature.json (DecodingPredictor(tier=), same contract)."""
     req = tier or os.environ.get('PTPU_SERVE_TIER')
     if not req or req == 'bf16':
         return artifact_dir
@@ -113,19 +115,20 @@ def resolve_tier(artifact_dir, tier=None):
     # export must surface the designed "has no tier" error, not a raw
     # FileNotFoundError from deep inside the loader
     if os.path.isdir(sub) and os.path.exists(os.path.join(sub,
-                                                          _SIGNATURE)):
+                                                          signature)):
         return sub
     if tier:
         tiers = ['bf16']
         try:
-            with open(os.path.join(artifact_dir, _SIGNATURE)) as f:
+            with open(os.path.join(artifact_dir, signature)) as f:
                 tiers = json.load(f).get('tiers', ['bf16'])
         except Exception:
             pass
         raise ValueError(
             "artifact %s has no %r tier (tiers: %s) — export with "
-            "export_compiled(..., quantize='int8') to add one"
-            % (artifact_dir, req, tiers))
+            "export_compiled(..., quantize='int8') (or export_decode "
+            "the quantized spec into <artifact>/%s) to add one"
+            % (artifact_dir, req, tiers, req))
     return artifact_dir
 
 
@@ -139,6 +142,43 @@ def _aot_platform(device=None):
         return env
     import jax
     return jax.default_backend()
+
+
+def _fresh_compile():
+    """Context: compile with jax's persistent compilation cache
+    DISABLED. An executable the persistent cache satisfied re-serializes
+    into a blob other processes cannot deserialize ('Symbols not found'
+    at load) — every AOT warm-start sidecar must come from a genuinely
+    fresh XLA compile (framework-free copy of
+    core.compile_cache.fresh_compile; this module imports only
+    json/numpy/jax). jax latches cache-enablement once per process
+    (is_cache_used caches its verdict), so the latch is reset around
+    the scope too."""
+    import contextlib
+    import jax
+
+    def _unlatch():
+        try:
+            from jax._src import compilation_cache as _jcc
+            _jcc.reset_cache()
+        except Exception:
+            pass
+
+    @contextlib.contextmanager
+    def ctx():
+        try:
+            old = bool(jax.config.jax_enable_compilation_cache)
+        except AttributeError:
+            yield
+            return
+        try:
+            jax.config.update('jax_enable_compilation_cache', False)
+            _unlatch()
+            yield
+        finally:
+            jax.config.update('jax_enable_compilation_cache', old)
+            _unlatch()
+    return ctx()
 
 
 def _save_aot(path, compiled, module_sha):
@@ -218,7 +258,7 @@ def _precompile_infer_dir(d, platform=None):
     plat = platform or _aot_platform()
     dev = jax.devices(plat)[0]
     exp = jexport.deserialize(module_bytes)
-    with jax.default_device(dev):
+    with jax.default_device(dev), _fresh_compile():
         compiled = jax.jit(exp.call).lower(*_infer_flat_specs(sig)).compile()
     return _save_aot(os.path.join(d, _AOT_SIDECAR % plat), compiled,
                      _module_sha(module_bytes))
@@ -244,7 +284,7 @@ def _precompile_train_dir(d, platform=None):
     rng_spec = jax.ShapeDtypeStruct(tuple(sig['rng']['key_shape']),
                                     np.dtype(sig['rng']['key_dtype']))
     exp = jexport.deserialize(module_bytes)
-    with jax.default_device(dev):
+    with jax.default_device(dev), _fresh_compile():
         compiled = jax.jit(exp.call).lower(state_specs, feed_specs,
                                            rng_spec).compile()
     return _save_aot(os.path.join(d, _TRAIN_AOT_SIDECAR % plat), compiled,
@@ -501,6 +541,13 @@ class CompiledPredictor(object):
 
     def get_output_names(self):
         return [e['name'] for e in _fetch_entries(self._sig)]
+
+    def drain(self):
+        """Fleet scale-in hook (inference/fleet.py): CompiledPredictor
+        is synchronous — it holds no queue and no in-flight work beyond
+        the caller's own run(), so draining is a no-op. BatchingPredictor
+        and DecodingPredictor override this with real drains."""
+        return self
 
     def _call_flat(self, args):
         """Dispatch the exported module on the pinned device; returns the
@@ -966,16 +1013,39 @@ def _loop_cli(argv):
     return 0
 
 
+def _pop_flag(argv, name):
+    """Extract `--NAME VALUE` (or `--NAME=VALUE`) from argv anywhere;
+    returns (value or None, argv without the flag) — the positional CLIs
+    here stay positional, flags ride on top."""
+    out, value, it = [], None, iter(argv)
+    for a in it:
+        if a == '--%s' % name:
+            value = next(it, None)
+            if value is None:
+                raise SystemExit('--%s needs a value' % name)
+        elif a.startswith('--%s=' % name):
+            value = a.split('=', 1)[1]
+        else:
+            out.append(a)
+    return value, out
+
+
 def _decode_cli(argv):
     # serve.py decode ARTIFACT_DIR PROMPTS.npz OUT.npz [MAX_NEW [BEAM]]
+    #          [--tier T]
     # PROMPTS.npz: 'prompts' [N, L] int64 (0-padded) + optional 'lens'
     # [N]. Greedy (default) writes OUT.npz 'tokens' [N, max_new] padded
     # with -1 after each transcript plus 'n_tokens' [N]; with BEAM, the
     # best hypothesis per request plus 'scores' [N]. Every request runs
     # through the continuous-batching scheduler — submit all, then wait.
+    # --tier serves an explicit artifact tier (e.g. the quantized-KV
+    # decode tier under <artifact>/int8/) with the same
+    # explicit-missing-tier-raises contract as BatchingPredictor(tier=);
+    # without it, PTPU_SERVE_TIER applies as a silent preference.
+    tier, argv = _pop_flag(argv, 'tier')
     if len(argv) not in (5, 6, 7):
         print("usage: serve.py decode ARTIFACT_DIR PROMPTS.npz OUT.npz "
-              "[MAX_NEW [BEAM]]", file=sys.stderr)
+              "[MAX_NEW [BEAM]] [--tier T]", file=sys.stderr)
         return 2
     artifact_dir, in_path, out_path = argv[2:5]
     max_new = int(argv[5]) if len(argv) >= 6 else 32
@@ -985,7 +1055,7 @@ def _decode_cli(argv):
         prompts = np.asarray(z['prompts'], np.int64)
         lens = (np.asarray(z['lens'], np.int64) if 'lens' in z.files
                 else np.full(prompts.shape[0], prompts.shape[1], np.int64))
-    with decoding.DecodingPredictor(artifact_dir) as pred:
+    with decoding.DecodingPredictor(artifact_dir, tier=tier) as pred:
         streams = [pred.submit(prompts[i, :lens[i]], max_new_tokens=max_new,
                                beam=beam) for i in range(prompts.shape[0])]
         results = [s.result() for s in streams]
@@ -1004,11 +1074,70 @@ def _decode_cli(argv):
         save['scores'] = scores
     np.savez(out_path, **save)
     print(json.dumps({'requests': len(results),
+                      'tier': snap.get('tier', 'bf16'),
                       'tokens': int(snap['tokens']),
                       'tokens_s': snap['tokens_s'],
                       'occupancy': snap['occupancy'],
                       'ttft_p50_ms': snap['ttft_p50_ms'],
                       'ttft_p99_ms': snap['ttft_p99_ms']}))
+    return 0
+
+
+def _fleet_cli(argv):
+    # serve.py fleet ARTIFACT_DIR IN.npz N_REQUESTS [REPLICAS]
+    #          [--tier T] [--kind K]
+    # Spin up a replica fleet (subprocess workers over the fleet.py
+    # frame protocol), replay IN.npz N times through FleetRouter.submit
+    # with least-outstanding-work routing, and print fleet throughput,
+    # latency percentiles and the per-replica table as JSON — serving-
+    # fleet perf measurable without the full bench.py harness.
+    # Batching/compiled artifacts: IN.npz holds one request's feed
+    # arrays. Decode artifacts: the decode-CLI convention — 'prompts'
+    # [N, L] int64 (0-padded) + optional 'lens' [N]; requests cycle
+    # through the prompt rows.
+    tier, argv = _pop_flag(argv, 'tier')
+    kind, argv = _pop_flag(argv, 'kind')
+    if len(argv) not in (5, 6):
+        print("usage: serve.py fleet ARTIFACT_DIR IN.npz N_REQUESTS "
+              "[REPLICAS] [--tier T] [--kind K]", file=sys.stderr)
+        return 2
+    artifact_dir, in_path, n = argv[2], argv[3], int(argv[4])
+    replicas = int(argv[5]) if len(argv) == 6 else 2
+    try:
+        from . import fleet as _fleet
+    except ImportError:  # run by file path: fleet.py sits alongside
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import fleet as _fleet
+    with np.load(in_path) as z:
+        raw = {k: z[k] for k in z.files}
+    with _fleet.FleetRouter(artifact_dir, replicas=replicas,
+                            kind=kind or 'auto', tier=tier) as router:
+        if router.kind == 'decoding':
+            prompts = np.asarray(raw['prompts'], np.int64)
+            lens = (np.asarray(raw['lens'], np.int64)
+                    if 'lens' in raw else np.full(
+                        prompts.shape[0], prompts.shape[1], np.int64))
+            requests = [prompts[i % prompts.shape[0],
+                                :lens[i % prompts.shape[0]]]
+                        for i in range(n)]
+        else:
+            requests = [raw] * n
+        t0 = time.perf_counter()
+        futs = [router.submit(r) for r in requests]
+        for f in futs:
+            f.result(600)
+        wall = time.perf_counter() - t0
+        snap = router.fleet_snapshot()
+    out = {'requests': n, 'replicas': replicas,
+           'req_s': round(n / wall, 2), 'tier': snap['tier'],
+           'p50_ms': snap['p50_ms'], 'p99_ms': snap['p99_ms'],
+           'rerouted': snap['rerouted'], 'failed': snap['failed'],
+           'per_replica': {rid: {'requests': s['requests'],
+                                 'occupancy': s['occupancy'],
+                                 'spinup_s': s['spinup_s'],
+                                 'compiles': s['compiles']}
+                           for rid, s in snap['replicas'].items()}}
+    print(json.dumps(out))
     return 0
 
 
@@ -1019,6 +1148,8 @@ def main(argv):
         return _loop_cli(argv)
     if len(argv) >= 2 and argv[1] == 'decode':
         return _decode_cli(argv)
+    if len(argv) >= 2 and argv[1] == 'fleet':
+        return _fleet_cli(argv)
     if len(argv) >= 2 and argv[1] == 'train':
         # serve.py train ARTIFACT_DIR FEEDS.npz OUT.npz STEPS [CKPT.npz]
         # runs STEPS train steps on the (fixed) feeds; OUT.npz holds each
@@ -1046,7 +1177,9 @@ def main(argv):
               "       serve.py bench ARTIFACT_DIR IN.npz N_REQUESTS "
               "[TIMEOUT_MS]\n"
               "       serve.py decode ARTIFACT_DIR PROMPTS.npz OUT.npz "
-              "[MAX_NEW [BEAM]]", file=sys.stderr)
+              "[MAX_NEW [BEAM]] [--tier T]\n"
+              "       serve.py fleet ARTIFACT_DIR IN.npz N_REQUESTS "
+              "[REPLICAS] [--tier T] [--kind K]", file=sys.stderr)
         return 2
     artifact_dir, in_path, out_path = argv[1:]
     pred = CompiledPredictor(artifact_dir)

@@ -62,6 +62,8 @@ def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
     _print_report(sorted_key)
     if _serving_sources:
         serving_report()
+    if _fleet_sources:
+        fleet_report()
     if _training_sources:
         training_report()   # renders feeder + pod sources too
     else:
@@ -190,6 +192,80 @@ def serving_report():
                    s.get('shed', 0) + s.get('expired', 0),
                    s.get('ttft_p50_ms', 0.0), s.get('ttft_p99_ms', 0.0),
                    s.get('itl_p50_ms', 0.0), s.get('itl_p99_ms', 0.0)))
+    return out
+
+
+# -- serving-fleet metrics ---------------------------------------------------
+# Fleet routers (inference/fleet.FleetRouter) register a zero-arg snapshot
+# callable here; fleet_report() renders one summary row per fleet (requests,
+# reroutes, sheds, latency/TTFT percentiles, scale events, rollout state)
+# plus a per-replica table (state, tier, outstanding+queued work, replica
+# occupancy, heartbeat age), alongside the serving tables at stop_profiler.
+_fleet_sources = {}
+
+
+def register_fleet_source(name, snapshot):
+    """Register a fleet-metrics source: `snapshot()` -> dict with
+    serving, replicas={rid: replica snapshot}, completed, failed,
+    rerouted, shed, expired, p50/p99_ms, ttft_p50/p99_ms, scale_out,
+    scale_in, replica_deaths, rollout (the contract of
+    fleet.FleetRouter.fleet_snapshot)."""
+    _fleet_sources[name] = snapshot
+
+
+def unregister_fleet_source(name):
+    _fleet_sources.pop(name, None)
+
+
+def fleet_report():
+    """Print fleet metrics for every registered source and return them
+    as {source name: snapshot dict}."""
+    out = {}
+    rows = []
+    for name in sorted(_fleet_sources):
+        try:
+            snap = _fleet_sources[name]()
+        except Exception:
+            continue  # a closing router must not break the report
+        out[name] = snap
+        rows.append((name, snap))
+    if rows:
+        print("%-28s %5s %7s %8s %6s %7s %5s %9s %9s %11s %7s %8s" %
+              ('Fleet source', 'tier', 'serving', 'requests', 'fail',
+               'reroute', 'shed', 'p50(ms)', 'p99(ms)', 'ttft99(ms)',
+               'scale', 'rollout'))
+    for name, snap in rows:
+        print("%-28s %5s %7d %8d %6d %7d %5d %9.2f %9.2f %11.2f %3d/%-3d "
+              "%8s" %
+              (name[:28], snap.get('tier', 'bf16'),
+               snap.get('serving', 0), snap.get('completed', 0),
+               snap.get('failed', 0), snap.get('rerouted', 0),
+               snap.get('shed', 0) + snap.get('expired', 0),
+               snap.get('p50_ms', 0.0), snap.get('p99_ms', 0.0),
+               snap.get('ttft_p99_ms', 0.0), snap.get('scale_out', 0),
+               snap.get('scale_in', 0),
+               snap.get('rollout', {}).get('state', 'idle')[:8]))
+        replicas = snap.get('replicas', {})
+        if replicas:
+            print("  %-8s %-9s %5s %8s %8s %5s %9s %8s %8s" %
+                  ('replica', 'state', 'tier', 'backlog', 'requests',
+                   'occ', 'hb-age(s)', 'spinup(s)', 'compiles'))
+            for rid in sorted(replicas, key=lambda r: int(r)):
+                s = replicas[rid]
+                age = s.get('hb_age_s')
+                # backlog = router pending + worker queue (a dispatched
+                # frame is already in the worker's queue_depth; adding
+                # outstanding would double-count it)
+                print("  %-8s %-9s %5s %8d %8d %5.2f %9s %8s %8s" %
+                      (rid, s.get('state', '?')[:9],
+                       s.get('tier', 'bf16'),
+                       s.get('pending', 0) + s.get('queue_depth', 0),
+                       s.get('requests', 0), s.get('occupancy', 0.0),
+                       ('%.2f' % age) if age is not None else '-',
+                       ('%.2f' % s['spinup_s'])
+                       if s.get('spinup_s') is not None else '-',
+                       s.get('compiles') if s.get('compiles')
+                       is not None else '-'))
     return out
 
 
