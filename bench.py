@@ -738,7 +738,10 @@ def bench_decode_serving():
     Poisson load.
 
     Env knobs (PTPU_BENCH_DECODE_*): REQS, MAX_NEW, SLOTS, RATE_X
-    (offered load as a multiple of sequential capacity), DMODEL, LAYERS.
+    (offered load as a multiple of sequential capacity), DMODEL, LAYERS,
+    BLOCK (ISSUE 13: block-paged layout with this block_size — chunked
+    prefill + prefix sharing; 0/unset = slot layout; the metric line
+    then carries the block-cache gauges).
     """
     import tempfile
     import paddle_tpu as fluid
@@ -751,6 +754,7 @@ def bench_decode_serving():
     rate_x = float(os.environ.get('PTPU_BENCH_DECODE_RATE_X', '8'))
     d_model = int(os.environ.get('PTPU_BENCH_DECODE_DMODEL', '64'))
     n_layer = int(os.environ.get('PTPU_BENCH_DECODE_LAYERS', '2'))
+    block = int(os.environ.get('PTPU_BENCH_DECODE_BLOCK', '0'))
     vocab, buckets, cache = 512, (8, 16), 64
 
     scope = fluid.core.Scope()
@@ -759,7 +763,8 @@ def bench_decode_serving():
         spec = build_decode_spec(vocab=vocab, d_model=d_model, n_head=4,
                                  n_layer=n_layer, d_ff=4 * d_model,
                                  max_slots=slots, max_cache_len=cache,
-                                 prompt_buckets=buckets, eos_id=1)
+                                 prompt_buckets=buckets, eos_id=1,
+                                 block_size=block or None)
         exe, _ = _device()
         exe.run(spec['startup'], scope=scope)
         export_decode(spec, art, scope=scope)
@@ -775,6 +780,13 @@ def bench_decode_serving():
             seq_s = time.perf_counter() - t0
             seq_tok_s = sum(len(t) for t in seq) / seq_s
             pred.stats.reset()
+            if block:
+                # the sequential arm registered every prompt's prefix;
+                # without this the Poisson arm re-serves the SAME
+                # prompts against a warm prefix cache and vs_baseline
+                # conflates batching with reuse the baseline never got
+                pred.block_manager.evict_all_prefixes()
+                pred.block_manager.reset_counters()
             # offered rate derives from the MEASURED request rate, not
             # tokens/max_new: early-eos requests are cheaper than
             # max_new tokens, and a token-derived rate under-offers and
@@ -798,6 +810,13 @@ def bench_decode_serving():
         raise RuntimeError('continuous decode transcripts diverged from '
                            'sequential (bit-identity contract)')
     tok_s = sum(len(t) for t in con) / wall
+    extra = {}
+    if block:
+        extra = {'block_size': block,
+                 'blocks_peak': snap['blocks_peak'],
+                 'prefix_hit_rate': round(snap['prefix_hit_rate'], 3),
+                 'cow_blocks': snap['cow_blocks'],
+                 'chunk_slices': snap['chunk_slices']}
     return _line('decode_serving_tok_s_per_chip', tok_s, 'tok/s',
                  tok_s / seq_tok_s, seq_tok_s=round(seq_tok_s, 1),
                  slots=slots, max_new=max_new,
@@ -807,7 +826,7 @@ def bench_decode_serving():
                  ttft_p99_ms=snap['ttft_p99_ms'],
                  itl_p50_ms=snap['itl_p50_ms'],
                  itl_p99_ms=snap['itl_p99_ms'],
-                 baseline_ref='sequential_decode_self')
+                 baseline_ref='sequential_decode_self', **extra)
 
 
 def bench_resnet_serving_int8():

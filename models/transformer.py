@@ -209,7 +209,8 @@ def _pe_table(max_len, d_model):
 
 def build_decode_spec(vocab=67, d_model=32, n_head=4, n_layer=2, d_ff=64,
                       max_slots=8, max_cache_len=48, prompt_buckets=(8, 16),
-                      eos_id=1, kv_cache_dtype='float32'):
+                      eos_id=1, kv_cache_dtype='float32', block_size=None,
+                      num_blocks=None, chunk_sizes=None, mp_shard=0):
     """Build the decode-serving program set for a decoder-only transformer
     LM. Returns the spec dict `inference.export_decode` consumes:
 
@@ -229,12 +230,45 @@ def build_decode_spec(vocab=67, d_model=32, n_head=4, n_layer=2, d_ff=64,
     programs use the quantized write/prefill/attention kernels
     (ops/decode_ops.py) — ~(1+4/D)/2 the cache bytes of the f32 form,
     so the same cache-HBM budget holds ~2x the slots.
+
+    block_size=N (ISSUE 13): BLOCK-PAGED layout. The cache becomes a
+    pool [num_blocks, block_size, D] addressed through per-slot block
+    tables the serving tier feeds each dispatch (inference/kv_blocks.py
+    owns refcounts/CoW/prefix sharing), and prefill becomes CHUNKED:
+    one chunk program per size in `chunk_sizes` (default: the
+    prompt_buckets) admits a prompt in fixed slices interleaved with
+    decode steps. num_blocks defaults to full capacity
+    (max_slots * ceil(max_cache_len / block_size) + 1 trash block);
+    size it SMALLER to oversubscribe on prefix sharing. Composes with
+    kv_cache_dtype='int8' (int8 block pages + [num_blocks, block_size]
+    page scales).
+
+    mp_shard=k (ISSUE 13, block layout only): annotate every weight
+    (and the D axis of the KV block pool) for k-way tensor-model
+    sharding over the 'mp' mesh axis (parallel/api.shard_parameter) and
+    insert sharding_hint replicate points at contraction boundaries so
+    every reduction stays full-width — export_decode traces the
+    programs over the mesh and the sharded artifact's transcripts are
+    BIT-IDENTICAL to the single-chip one. Requires k | n_head, k | d_ff.
     """
     import numpy as np
     PA = fluid.ParamAttr
     if kv_cache_dtype not in ('float32', 'int8'):
         raise ValueError("kv_cache_dtype must be 'float32' or 'int8', "
                          "got %r" % (kv_cache_dtype,))
+    if block_size is not None:
+        return _build_block_decode_spec(
+            vocab=vocab, d_model=d_model, n_head=n_head, n_layer=n_layer,
+            d_ff=d_ff, max_slots=max_slots, max_cache_len=max_cache_len,
+            chunk_sizes=tuple(chunk_sizes or prompt_buckets),
+            eos_id=eos_id, kv_cache_dtype=kv_cache_dtype,
+            block_size=int(block_size), num_blocks=num_blocks,
+            mp_shard=int(mp_shard or 0))
+    if mp_shard:
+        raise ValueError(
+            'mp_shard requires the block-paged layout — pass '
+            'block_size= as well (the sharded decode tier addresses '
+            'the cache through block tables)')
     kv_int8 = kv_cache_dtype == 'int8'
     S, T, D = int(max_slots), int(max_cache_len), int(d_model)
     if D % n_head or D % 2:
@@ -425,3 +459,285 @@ def build_decode_spec(vocab=67, d_model=32, n_head=4, n_layer=2, d_ff=64,
             'max_slots': S, 'max_cache_len': T,
             'eos_id': int(eos_id), 'vocab': int(vocab),
             'kv_cache_dtype': kv_cache_dtype}
+
+
+def _build_block_decode_spec(vocab, d_model, n_head, n_layer, d_ff,
+                             max_slots, max_cache_len, chunk_sizes,
+                             eos_id, kv_cache_dtype, block_size,
+                             num_blocks, mp_shard):
+    """Block-paged decode spec (ISSUE 13; see build_decode_spec): the
+    KV cache is a pool [num_blocks, block_size, D] addressed through
+    block tables fed at dispatch time, prefill is CHUNKED (one program
+    per chunk size, attending earlier chunks / shared prefix blocks
+    through the table), and with mp_shard=k every weight + the cache's
+    D axis annotate for k-way 'mp' tensor sharding with replicate
+    hints at contraction boundaries (bit-identity with the single-chip
+    trace — ops/decode_ops.py sharding_hint)."""
+    import numpy as np
+    from paddle_tpu.parallel import shard_parameter
+    PA = fluid.ParamAttr
+    kv_int8 = kv_cache_dtype == 'int8'
+    S, T, D = int(max_slots), int(max_cache_len), int(d_model)
+    BS = int(block_size)
+    if D % n_head or D % 2:
+        raise ValueError("d_model must be even and divisible by n_head")
+    if not 1 <= BS <= T:
+        raise ValueError("block_size must be in [1, max_cache_len]")
+    MAXB = -(-T // BS)                     # logical blocks per slot
+    NB = int(num_blocks) if num_blocks is not None else S * MAXB + 1
+    if NB < 2:
+        raise ValueError("num_blocks must be >= 2 (block 0 is the "
+                         "reserved trash block)")
+    chunks = sorted({int(c) for c in chunk_sizes})
+    if not chunks or chunks[0] < 1 or chunks[-1] > T:
+        raise ValueError("chunk_sizes must be in [1, max_cache_len]")
+    mp = int(mp_shard or 0)
+    if mp:
+        if n_head % mp or d_ff % mp:
+            raise ValueError(
+                'mp_shard=%d must divide n_head=%d and d_ff=%d (the D '
+                'axis shards by whole head groups)' % (mp, n_head, d_ff))
+    startup = fluid.Program()
+    pe = _pe_table(T, D)
+    cache_vars = []
+    for i in range(n_layer):
+        cache_vars += ['kv_k_%d' % i, 'kv_v_%d' % i]
+        if kv_int8:
+            cache_vars += ['kv_ks_%d' % i, 'kv_vs_%d' % i]
+
+    # name -> partition spec for export_decode (collected from the
+    # shard_parameter annotations as each program is built)
+    param_shardings = {}
+    state_shardings = {}
+
+    def _shard(var, spec):
+        if mp:
+            shard_parameter(var, spec)
+            param_shardings[var.name] = tuple(spec)
+        return var
+
+    def _hint(x, spec=()):
+        """Replicate (or re-shard) an activation at a contraction
+        boundary; identity when unsharded."""
+        return fluid.layers.sharding_hint(x, spec) if mp else x
+
+    def const_param(name, shape, init, dtype='float32', spec=None):
+        p = fluid.layers.create_parameter(
+            shape, dtype, attr=PA(name=name, trainable=False),
+            default_initializer=init)
+        if spec is not None:
+            _shard(p, spec)
+        return p
+
+    def caches(i):
+        zero = fluid.initializer.ConstantInitializer(0.0)
+        dt = 'int8' if kv_int8 else 'float32'
+        cspec = (None, None, 'mp') if mp else None
+        k = const_param('kv_k_%d' % i, [NB, BS, D], zero, dt, spec=cspec)
+        v = const_param('kv_v_%d' % i, [NB, BS, D], zero, dt, spec=cspec)
+        if mp:
+            state_shardings['kv_k_%d' % i] = (None, None, 'mp')
+            state_shardings['kv_v_%d' % i] = (None, None, 'mp')
+        if not kv_int8:
+            return k, v
+        one = fluid.initializer.ConstantInitializer(1.0)
+        return (k, v, const_param('kv_ks_%d' % i, [NB, BS], one),
+                const_param('kv_vs_%d' % i, [NB, BS], one))
+
+    def pe_param():
+        return const_param(
+            'pos_enc_w', [T, D], fluid.initializer.NumpyArrayInitializer(pe))
+
+    def qkv(x, i, nfd):
+        def proj(tag):
+            w_attr = PA(name='l%d_%s_w' % (i, tag))
+            out = fluid.layers.fc(x, D, num_flatten_dims=nfd,
+                                  param_attr=w_attr, bias_attr=False)
+            return out
+        q, k, v = proj('q'), proj('k'), proj('v')
+        if mp:
+            gb = x.block.program.global_block()
+            for tag in ('q', 'k', 'v'):
+                _shard(gb.var('l%d_%s_w' % (i, tag)), (None, 'mp'))
+        return q, k, v
+
+    def block_tail(x, a, i, nfd):
+        """Residual+LN+FFN tail (the slot-paged builder's, plus the mp
+        replicate hints: attention context gathers before the o
+        projection, h before f2, and each projection output before its
+        LN — every contraction stays full-width)."""
+        a = _hint(a)
+        o = fluid.layers.fc(a, D, num_flatten_dims=nfd,
+                            param_attr=PA(name='l%d_o_w' % i),
+                            bias_attr=False)
+        if mp:
+            _shard(a.block.program.global_block().var('l%d_o_w' % i),
+                   (None, 'mp'))
+        o = _hint(o)
+        x = fluid.layers.layer_norm(
+            x + o, begin_norm_axis=nfd, param_attr=PA(name='l%d_ln1_s' % i),
+            bias_attr=PA(name='l%d_ln1_b' % i))
+        # pin the LN output replicated too: left unconstrained, GSPMD may
+        # shard it over 'mp' and the next projection's contraction turns
+        # into a partial-sum all-reduce — reordered accumulation, bit
+        # drift vs the single-chip artifact
+        x = _hint(x)
+        h = fluid.layers.fc(x, d_ff, num_flatten_dims=nfd, act='relu',
+                            param_attr=PA(name='l%d_f1_w' % i),
+                            bias_attr=PA(name='l%d_f1_b' % i))
+        if mp:
+            gb = x.block.program.global_block()
+            _shard(gb.var('l%d_f1_w' % i), (None, 'mp'))
+            _shard(gb.var('l%d_f1_b' % i), ('mp',))
+        h = _hint(h)
+        f = fluid.layers.fc(h, D, num_flatten_dims=nfd,
+                            param_attr=PA(name='l%d_f2_w' % i),
+                            bias_attr=PA(name='l%d_f2_b' % i))
+        if mp:
+            gb = h.block.program.global_block()
+            _shard(gb.var('l%d_f2_w' % i), (None, 'mp'))
+        f = _hint(f)
+        return _hint(fluid.layers.layer_norm(
+            x + f, begin_norm_axis=nfd, param_attr=PA(name='l%d_ln2_s' % i),
+            bias_attr=PA(name='l%d_ln2_b' % i)))
+
+    def embed(ids):
+        x = fluid.layers.embedding(ids, size=[vocab, D],
+                                   param_attr=PA(name='dec_emb_w'))
+        if mp:
+            _shard(x.block.program.global_block().var('dec_emb_w'),
+                   (None, 'mp'))
+        return fluid.layers.scale(x, scale=float(D ** 0.5))
+
+    def out_logits(x, nfd=1):
+        lg = fluid.layers.fc(x, vocab, num_flatten_dims=nfd,
+                             param_attr=PA(name='out_w'), bias_attr=False)
+        if mp:
+            _shard(x.block.program.global_block().var('out_w'),
+                   (None, 'mp'))
+        return _hint(lg)
+
+    # ---- decode-step program: [S] slots advance one token through the
+    # block pool (tables fed from the host scheduler) ----------------------
+    step_p = fluid.Program()
+    with fluid.program_guard(step_p, startup):
+        tokens = fluid.layers.data(name='tokens', shape=[S, 1],
+                                   append_batch_size=False, dtype='int64')
+        pos = fluid.layers.data(name='pos', shape=[S, 1],
+                                append_batch_size=False, dtype='int32')
+        tables = fluid.layers.data(name='block_tables', shape=[S, MAXB],
+                                   append_batch_size=False, dtype='int32')
+        table = pe_param()
+        x = embed(tokens)                                       # [S, D]
+        x = fluid.layers.elementwise_add(x,
+                                         fluid.layers.gather(table, pos))
+        x = _hint(x)
+        for i in range(n_layer):
+            if kv_int8:
+                kcache, vcache, kscale, vscale = caches(i)
+                q, k, v = qkv(x, i, 1)
+                kcache, kscale = fluid.layers.kv_block_write_quant(
+                    kcache, kscale, k, pos, tables)
+                vcache, vscale = fluid.layers.kv_block_write_quant(
+                    vcache, vscale, v, pos, tables)
+                a = fluid.layers.kv_block_attention_quant(
+                    q, kcache, kscale, vcache, vscale, pos, tables,
+                    n_head)
+            else:
+                kcache, vcache = caches(i)
+                q, k, v = qkv(x, i, 1)
+                kcache = fluid.layers.kv_block_write(kcache, k, pos,
+                                                     tables)
+                vcache = fluid.layers.kv_block_write(vcache, v, pos,
+                                                     tables)
+                a = fluid.layers.kv_block_attention(q, kcache, vcache,
+                                                    pos, tables, n_head)
+            x = block_tail(x, a, i, 1)
+        step_logits = out_logits(x)                             # [S, V]
+
+    # ---- chunked-prefill programs: one CHUNK of one prompt ---------------
+    chunk_progs = {}
+    for C in chunks:
+        cp = fluid.Program()
+        with fluid.program_guard(cp, startup):
+            chunk_ids = fluid.layers.data(name='chunk_ids', shape=[1, C],
+                                          append_batch_size=False,
+                                          dtype='int64')
+            start = fluid.layers.data(name='start', shape=[1, 1],
+                                      append_batch_size=False,
+                                      dtype='int32')
+            clen = fluid.layers.data(name='chunk_len', shape=[1, 1],
+                                     append_batch_size=False,
+                                     dtype='int32')
+            btab = fluid.layers.data(name='block_table', shape=[1, MAXB],
+                                     append_batch_size=False,
+                                     dtype='int32')
+            table = pe_param()
+            x = embed(chunk_ids)                               # [1, C, D]
+            cidx = fluid.layers.range(0, C, 1, 'int32')        # [C]
+            posv = fluid.layers.elementwise_add(
+                cidx, fluid.layers.reshape(start, shape=[1]))
+            pe_c = fluid.layers.gather(table, posv)            # [C, D]
+            x = fluid.layers.elementwise_add(
+                x, fluid.layers.reshape(pe_c, shape=[1, C, D]))
+            x = _hint(x)
+            for i in range(n_layer):
+                if kv_int8:
+                    kcache, vcache, kscale, vscale = caches(i)
+                    q, k, v = qkv(x, i, 2)
+                    kcache, kscale = \
+                        fluid.layers.kv_block_chunk_write_quant(
+                            kcache, kscale, k, start, btab)
+                    vcache, vscale = \
+                        fluid.layers.kv_block_chunk_write_quant(
+                            vcache, vscale, v, start, btab)
+                    a = fluid.layers.kv_block_chunk_attention_quant(
+                        q, kcache, kscale, vcache, vscale, k, v, start,
+                        btab, n_head)
+                else:
+                    kcache, vcache = caches(i)
+                    q, k, v = qkv(x, i, 2)
+                    kcache = fluid.layers.kv_block_chunk_write(
+                        kcache, k, start, btab)
+                    vcache = fluid.layers.kv_block_chunk_write(
+                        vcache, v, start, btab)
+                    a = fluid.layers.kv_block_chunk_attention(
+                        q, kcache, vcache, start, btab, n_head)
+                x = block_tail(x, a, i, 2)
+            # logits at the chunk's LAST VALID row (the scheduler reads
+            # them only from a prompt's FINAL chunk)
+            flat = fluid.layers.reshape(x, shape=[C, D])
+            last = fluid.layers.gather(
+                flat, fluid.layers.elementwise_sub(
+                    clen, fluid.layers.fill_constant([1], 'int32', 1)))
+            chunk_logits = out_logits(last)                    # [1, V]
+        chunk_progs[C] = {
+            'program': cp,
+            'feeds': ['chunk_ids', 'start', 'chunk_len', 'block_table'],
+            'samples': {'chunk_ids': np.zeros((1, C), np.int64),
+                        'start': np.zeros((1, 1), np.int32),
+                        'chunk_len': np.ones((1, 1), np.int32),
+                        'block_table': np.zeros((1, MAXB), np.int32)},
+            'fetches': [chunk_logits.name]}
+
+    spec = {'startup': startup,
+            'layout': 'block',
+            'block_size': BS, 'num_blocks': NB,
+            'max_blocks_per_slot': MAXB,
+            'step': {'program': step_p,
+                     'feeds': ['tokens', 'pos', 'block_tables'],
+                     'samples': {'tokens': np.zeros((S, 1), np.int64),
+                                 'pos': np.zeros((S, 1), np.int32),
+                                 'block_tables': np.zeros((S, MAXB),
+                                                          np.int32)},
+                     'fetches': [step_logits.name]},
+            'chunk': chunk_progs,
+            'cache_vars': list(cache_vars),
+            'max_slots': S, 'max_cache_len': T,
+            'eos_id': int(eos_id), 'vocab': int(vocab),
+            'kv_cache_dtype': kv_cache_dtype}
+    if mp:
+        spec['mesh_axes'] = {'mp': mp}
+        spec['param_shardings'] = dict(param_shardings)
+        spec['state_shardings'] = dict(state_shardings)
+    return spec

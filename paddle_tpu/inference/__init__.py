@@ -36,7 +36,7 @@ from .serve import (CompiledPredictor, load_compiled,
 from .batching import (BatchingPredictor, ServingStats, load_batching,
                        ServerOverloaded, DeadlineExceeded)
 from .decoding import (DecodingPredictor, DecodeStats, TokenStream,
-                       load_decoding)
+                       MidStreamEvicted, load_decoding)
 from .fleet import (FleetRouter, FleetStats, Autoscaler, RollingRollout,
                     ReplicaFailed, FleetUnavailable, RolloutRolledBack,
                     load_fleet)
@@ -48,7 +48,7 @@ __all__ = ['Config', 'Predictor', 'create_predictor',
            'export_compiled', 'CompiledPredictor', 'load_compiled',
            'export_train_step', 'CompiledTrainer', 'load_trainer',
            'export_decode', 'DecodingPredictor', 'DecodeStats',
-           'TokenStream', 'load_decoding',
+           'TokenStream', 'MidStreamEvicted', 'load_decoding',
            'BatchingPredictor', 'ServingStats', 'load_batching',
            'ServerOverloaded', 'DeadlineExceeded',
            'FleetRouter', 'FleetStats', 'Autoscaler', 'RollingRollout',

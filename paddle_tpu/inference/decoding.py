@@ -53,10 +53,12 @@ import numpy as np
 try:
     from . import serve as _serve
     from . import batching as _batching
+    from .kv_blocks import BlockManager, BlockPoolExhausted, TRASH_BLOCK
 except ImportError:  # imported by file path: siblings sit alongside
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import serve as _serve
     import batching as _batching
+    from kv_blocks import BlockManager, BlockPoolExhausted, TRASH_BLOCK
 
 _STOP = object()
 _WAKE = object()   # no-op queue item: rouse an idle scheduler (drain)
@@ -66,11 +68,64 @@ select_bucket = _batching.select_bucket
 ServerOverloaded = _batching.ServerOverloaded
 DeadlineExceeded = _batching.DeadlineExceeded
 
+
+class MidStreamEvicted(ServerOverloaded):
+    """Overload shed of a request that ALREADY DISPATCHED device work:
+    the block-pool preflight evicts the youngest DECODING stream under
+    unresolvable pressure, after tokens may have streamed to the
+    caller. Still a ServerOverloaded for local callers, but a fleet
+    router must NOT blindly re-route it (base ServerOverloaded means
+    shed at the door — no device work, always re-routable)."""
+
 # -- artifact layout (export.py export_decode writes exactly this) ----------
 _DECODE_SIGNATURE = 'decode_signature.json'
 _STEP_DIR = 'decode_step'
 _PREFILL_DIR = 'prefill_%05d'   # % prompt-length bucket
 _REORDER_DIR = 'decode_reorder'
+# block-paged layout (ISSUE 13): chunked-prefill programs + the
+# block-copy program (beam CoW moves diverged BLOCKS, not slot rows)
+_CHUNK_DIR = 'prefill_chunk_%05d'   # % chunk size
+_BLOCKCOPY_DIR = 'decode_blockcopy'
+
+
+def _decode_mesh(axes, platform=None):
+    """Build a sharded decode mesh: the first prod(axes) devices of
+    `platform` (or the default backend), row-major over the SORTED axis
+    names. THE one copy of the rule — export.py delegates here, so an
+    artifact exported on one host places identically on any host with
+    the same device count."""
+    import jax
+    from jax.sharding import Mesh
+    names = tuple(sorted(axes))
+    shape = tuple(int(axes[a]) for a in names)
+    n = 1
+    for s in shape:
+        n *= s
+    devs = jax.devices(platform) if platform else jax.devices()
+    if len(devs) < n:
+        raise ValueError(
+            'sharded decode mesh %r needs %d device(s); this process '
+            'sees %d. Run on a host with the full mesh (or export '
+            'unsharded).' % (dict(axes), n, len(devs)))
+    return Mesh(np.asarray(devs[:n]).reshape(shape), names)
+
+
+def _state_shardings_ns(mesh, spec_map, names):
+    """Map state names through a {name: partition-spec} dict into
+    concrete NamedShardings, replicated fallback for unlisted names.
+    THE one copy of the rule — export-time (_decode_shard_ctx) and
+    load-time (_sig_mesh_ctx) both resolve through here, so an exported
+    artifact can never place state differently at serve time. Returns
+    (rep, state_ns) with state_ns aligned to `names`."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    rep = NamedSharding(mesh, PartitionSpec())
+    spec_map = spec_map or {}
+    state_ns = []
+    for n in names:
+        ps = spec_map.get(n)
+        state_ns.append(NamedSharding(mesh, PartitionSpec(*ps))
+                        if ps else rep)
+    return rep, state_ns
 
 
 def _percentiles(values, qs):
@@ -112,6 +167,15 @@ class DecodeStats(object):
         self.expired = 0
         self.drained = 0         # shed by drain(): queued at scale-in
         self.busy_s = 0.0        # wall time with >= 1 active slot
+        # block-paged layout (ISSUE 13); zero/absent on slot artifacts.
+        # block_source is the BlockManager.stats callable (pool gauges +
+        # prefix-share accounting merge into snapshot()); block_reset
+        # its reset_counters, so reset() covers the merged counters too
+        self.block_source = None
+        self.block_reset = None
+        self.cow_blocks = 0      # blocks copied for beam copy-on-write
+        self.blockcopies = 0     # block-copy dispatches
+        self.chunk_slices = 0    # chunked-prefill slice dispatches
 
     def reset(self):
         """Zero counters and latency windows (queue_depth is a live gauge
@@ -130,6 +194,14 @@ class DecodeStats(object):
             self.expired = 0
             self.drained = 0
             self.busy_s = 0.0
+            self.cow_blocks = 0
+            self.blockcopies = 0
+            self.chunk_slices = 0
+            if self.block_reset is not None:
+                # the BlockManager-sourced counters merge into
+                # snapshot(): a reset-then-measure window must not
+                # report pre-reset prefix hits / peaks
+                self.block_reset()
 
     def snapshot(self):
         with self._lock:
@@ -137,7 +209,7 @@ class DecodeStats(object):
             itl50, itl99 = _percentiles(list(self._itl), [50, 99])
             occ = (self.active_slot_steps / self.slot_steps
                    if self.slot_steps else 0.0)
-            return {'kind': 'decode',
+            snap = {'kind': 'decode',
                     'tier': self.tier,
                     'queue_depth': int(self.queue_depth),
                     'requests': int(self.requests),
@@ -153,6 +225,21 @@ class DecodeStats(object):
                     'drained': int(self.drained),
                     'ttft_p50_ms': ttft50, 'ttft_p99_ms': ttft99,
                     'itl_p50_ms': itl50, 'itl_p99_ms': itl99}
+            if self.block_source is None:
+                return snap
+            snap['cow_blocks'] = int(self.cow_blocks)
+            snap['blockcopies'] = int(self.blockcopies)
+            snap['chunk_slices'] = int(self.chunk_slices)
+        # outside the stats lock: the BlockManager takes its own
+        bs = self.block_source()
+        snap['blocks_in_use'] = int(bs['blocks_in_use'])
+        snap['blocks_peak'] = int(bs['blocks_peak'])
+        snap['blocks_total'] = int(bs['num_blocks'])
+        snap['prefix_hits'] = int(bs['prefix_hits'])
+        snap['prefix_hit_rate'] = float(bs['prefix_hit_rate'])
+        snap['prefix_tokens_reused'] = int(bs['prefix_tokens_reused'])
+        snap['block_evictions'] = int(bs['evictions'])
+        return snap
 
 
 class TokenStream(object):
@@ -216,7 +303,9 @@ class TokenStream(object):
 class _Request(object):
     __slots__ = ('prompt', 'max_new', 'beam', 'stream', 't_submit',
                  'deadline', 'slots', 'produced', 'tokens', 'last_tokens',
-                 'scores', 'finished', 'hyps', 't_first', 't_last')
+                 'scores', 'finished', 'hyps', 't_first', 't_last',
+                 'tables', 'next_start', 'prefilling', 'match',
+                 'match_epoch')
 
     def __init__(self, prompt, max_new, beam, stream, deadline_ms):
         self.prompt = prompt
@@ -235,6 +324,12 @@ class _Request(object):
         self.hyps = []                    # per beam token lists
         self.t_first = None
         self.t_last = None
+        # block layout (ISSUE 13)
+        self.tables = []                  # per beam: logical block ids
+        self.next_start = 0               # next chunked-prefill position
+        self.prefilling = False           # still admitting via chunks
+        self.match = None                 # cached (shared blocks, covered)
+        self.match_epoch = -1             # prefix_epoch the match saw
 
 
 class _DecodeModule(object):
@@ -244,7 +339,7 @@ class _DecodeModule(object):
     bookkeeping guards the cold path; the sidecar carries certified
     aliasing for the warm path)."""
 
-    def __init__(self, d, donate_state, device=None):
+    def __init__(self, d, donate_state, device=None, aot_tag=None):
         with open(os.path.join(d, _serve._MODULE), 'rb') as f:
             self._module_bytes = f.read()
         self._donate = bool(donate_state)
@@ -253,10 +348,13 @@ class _DecodeModule(object):
         if os.environ.get('PTPU_ARTIFACT_AOT', '1') not in ('0', 'false'):
             # sidecar keyed on the PINNED device's platform (the
             # CompiledPredictor discipline): an explicit platform= must
-            # never load an executable baked for the default backend
+            # never load an executable baked for the default backend.
+            # Sharded artifacts carry a MESH TAG instead (e.g. tpu_mp2):
+            # an executable partitioned for one mesh must never load
+            # into an unsharded serve or a different mesh shape.
             self._aot = _serve._load_aot(
                 os.path.join(d, _serve._AOT_SIDECAR
-                             % _serve._aot_platform(device)),
+                             % (aot_tag or _serve._aot_platform(device))),
                 _serve._module_sha(self._module_bytes))
 
     def _jitted(self):
@@ -278,20 +376,33 @@ class _DecodeModule(object):
             return fn(*args)
 
 
-def _precompile_decode_dir(d, state_specs, arg_specs, donate, platform=None):
+def _precompile_decode_dir(d, state_specs, arg_specs, donate,
+                           platform=None, mesh_ctx=None):
     """AOT-compile one decode program for `platform` and write its
     warm-start sidecar. Step/prefill compile WITH donate_argnums=(0,)
     (the paged cache updates in place on warm replicas); the reorder
     program compiles undonated — it doubles as the owned-buffer boundary
-    for freshly loaded state."""
+    for freshly loaded state. With `mesh_ctx` (a sharded artifact) the
+    state specs carry their mesh shardings and the sidecar writes under
+    the MESH TAG (aot_<platform>_<axes>.jaxexec)."""
     import jax
     from jax import export as jexport
     with open(os.path.join(d, _serve._MODULE), 'rb') as f:
         module_bytes = f.read()
-    plat = platform or _serve._aot_platform()
-    dev = jax.devices(plat)[0]
     exp = jexport.deserialize(module_bytes)
     kw = {'donate_argnums': (0,)} if donate else {}
+    if mesh_ctx is not None:
+        state_specs = [jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=ns)
+                       for s, ns in zip(state_specs,
+                                        mesh_ctx['state_ns'])]
+        with _serve._fresh_compile():
+            compiled = jax.jit(exp.call, **kw).lower(
+                state_specs, *arg_specs).compile()
+        return _serve._save_aot(
+            os.path.join(d, _serve._AOT_SIDECAR % mesh_ctx['tag']),
+            compiled, _serve._module_sha(module_bytes))
+    plat = platform or _serve._aot_platform()
+    dev = jax.devices(plat)[0]
     with jax.default_device(dev), _serve._fresh_compile():
         compiled = jax.jit(exp.call, **kw).lower(
             state_specs, *arg_specs).compile()
@@ -299,36 +410,76 @@ def _precompile_decode_dir(d, state_specs, arg_specs, donate, platform=None):
                             compiled, _serve._module_sha(module_bytes))
 
 
+def _sig_mesh_ctx(sig, platform=None):
+    """Resolve a sharded signature's mesh block into concrete
+    NamedShardings for the state list; None for unsharded artifacts.
+    An explicit `platform` that contradicts the artifact's recorded
+    platform raises — a sharded executable is single-platform."""
+    mesh_sig = sig.get('mesh')
+    if not mesh_sig:
+        return None
+    plat = mesh_sig.get('platform')
+    if platform and plat and platform != plat:
+        raise ValueError(
+            'sharded decode artifact was exported for platform %r; '
+            'cannot serve/prewarm it on %r' % (plat, platform))
+    mesh = _decode_mesh(mesh_sig['axes'], plat)
+    rep, state_ns = _state_shardings_ns(
+        mesh, mesh_sig.get('state_shardings'),
+        [e['name'] for e in sig['state']])
+    return {'mesh': mesh, 'rep': rep, 'state_ns': state_ns,
+            'tag': mesh_sig['tag'], 'platform': plat}
+
+
 def precompile_decode_artifact(artifact_dir, platform=None):
     """Prewarm a continuous-decode artifact: AOT-compile the decode-step
-    program, EVERY prefill bucket, and the reorder program, writing
-    warm-start sidecars — a replica that loads the artifact afterwards
-    answers with zero traces and zero XLA compiles. Driven by
-    `tools/cache_ctl.py prewarm` (serve.precompile_artifact detects the
-    decode layout). Returns the sidecar paths written."""
+    program, EVERY prefill bucket (slot layout) or chunked-prefill size
+    plus the block-copy program (block layout), and the reorder program,
+    writing warm-start sidecars — a replica that loads the artifact
+    afterwards answers with zero traces and zero XLA compiles. Sharded
+    artifacts (signature carries a mesh) prewarm over the recorded mesh
+    and write MESH-TAGGED sidecars; the host must see the full device
+    count. Driven by `tools/cache_ctl.py prewarm`
+    (serve.precompile_artifact detects the decode layout). Returns the
+    sidecar paths written."""
     import jax
     with open(os.path.join(artifact_dir, _DECODE_SIGNATURE)) as f:
         sig = json.load(f)
     state_specs = [jax.ShapeDtypeStruct(tuple(e['shape']),
                                         np.dtype(e['dtype']))
                    for e in sig['state']]
+    mesh_ctx = _sig_mesh_ctx(sig, platform)
 
     def feed_specs(entries):
         return [jax.ShapeDtypeStruct(tuple(e['shape']), np.dtype(e['dtype']))
                 for e in entries]
 
-    written = [_precompile_decode_dir(
-        os.path.join(artifact_dir, _STEP_DIR), state_specs,
-        [feed_specs(sig['step']['feeds'])], donate=True, platform=platform)]
-    for b in sig['prompt_buckets']:
-        written.append(_precompile_decode_dir(
-            os.path.join(artifact_dir, _PREFILL_DIR % int(b)), state_specs,
-            [feed_specs(sig['prefill'][str(b)]['feeds'])], donate=True,
-            platform=platform))
-    src_spec = jax.ShapeDtypeStruct((int(sig['max_slots']),), np.int32)
-    written.append(_precompile_decode_dir(
-        os.path.join(artifact_dir, _REORDER_DIR), state_specs, [src_spec],
-        donate=False, platform=platform))
+    def dir_(d, args, donate):
+        return _precompile_decode_dir(
+            os.path.join(artifact_dir, d), state_specs, args,
+            donate=donate, platform=platform, mesh_ctx=mesh_ctx)
+
+    written = [dir_(_STEP_DIR, [feed_specs(sig['step']['feeds'])],
+                    donate=True)]
+    if sig.get('layout', 'slot') == 'block':
+        for c in sig['chunk_buckets']:
+            written.append(dir_(
+                _CHUNK_DIR % int(c),
+                [feed_specs(sig['chunk'][str(c)]['feeds'])], donate=True))
+        pair_spec = jax.ShapeDtypeStruct((int(sig['max_slots']),),
+                                         np.int32)
+        written.append(dir_(_BLOCKCOPY_DIR, [pair_spec, pair_spec],
+                            donate=True))
+        reorder_n = int(sig['block']['num_blocks'])
+    else:
+        for b in sig['prompt_buckets']:
+            written.append(dir_(
+                _PREFILL_DIR % int(b),
+                [feed_specs(sig['prefill'][str(b)]['feeds'])],
+                donate=True))
+        reorder_n = int(sig['max_slots'])
+    src_spec = jax.ShapeDtypeStruct((reorder_n,), np.int32)
+    written.append(dir_(_REORDER_DIR, [src_spec], donate=False))
     return written
 
 
@@ -370,27 +521,69 @@ class DecodingPredictor(object):
         self._T = int(self._sig['max_cache_len'])
         self._eos = int(self._sig['eos_id'])
         self._vocab = int(self._sig['vocab'])
-        # sorted once at load: select_bucket prefers the smallest fitting
-        # bucket deterministically (inference/batching.py discipline)
-        self._buckets = sorted(int(b) for b in self._sig['prompt_buckets'])
+        self._layout = self._sig.get('layout', 'slot')
         self._default_max_new = int(default_max_new_tokens)
         self._max_queue = int(max_queue) if max_queue else None
         platform = platform or os.environ.get('PTPU_PLATFORM')
-        self._device = jax.devices(platform)[0] if platform else None
+        # sharded artifact (ISSUE 13): rebuild the export mesh; state
+        # places per the recorded shardings, programs load through the
+        # mesh-tagged AOT sidecars, feeds/fetches stay replicated
+        self._mesh_ctx = _sig_mesh_ctx(self._sig, platform)
+        aot_tag = None
+        if self._mesh_ctx is not None:
+            self._device = None     # state placement IS the mesh
+            aot_tag = self._mesh_ctx['tag']
+        else:
+            self._device = jax.devices(platform)[0] if platform else None
         self._step_mod = _DecodeModule(
             os.path.join(artifact_dir, _STEP_DIR), donate_state=True,
-            device=self._device)
-        self._prefill_mods = {
-            b: _DecodeModule(os.path.join(artifact_dir, _PREFILL_DIR % b),
-                             donate_state=True, device=self._device)
-            for b in self._buckets}
+            device=self._device, aot_tag=aot_tag)
         self._reorder_mod = _DecodeModule(
             os.path.join(artifact_dir, _REORDER_DIR), donate_state=False,
-            device=self._device)
+            device=self._device, aot_tag=aot_tag)
         self._step_feeds = [e['name'] for e in self._sig['step']['feeds']]
-        self._prefill_feeds = {
-            b: [e['name'] for e in self._sig['prefill'][str(b)]['feeds']]
-            for b in self._buckets}
+        if self._layout == 'block':
+            blk = self._sig['block']
+            self._bs = int(blk['block_size'])
+            self._nb = int(blk['num_blocks'])
+            self._maxb = int(blk['max_blocks_per_slot'])
+            self._trash = TRASH_BLOCK
+            # the block allocator itself is built (and wired into
+            # stats.block_source) by _reset_state — the single owner
+            # chunked prefill: prompts admit in fixed slices, so the
+            # prompt ceiling is the CACHE length, not a prefill bucket
+            self._chunks = sorted(int(c) for c in
+                                  self._sig['chunk_buckets'])
+            self._max_prompt = self._T
+            self._chunk_mods = {
+                c: _DecodeModule(
+                    os.path.join(artifact_dir, _CHUNK_DIR % c),
+                    donate_state=True, device=self._device,
+                    aot_tag=aot_tag)
+                for c in self._chunks}
+            self._chunk_feeds = {
+                c: [e['name'] for e in self._sig['chunk'][str(c)]['feeds']]
+                for c in self._chunks}
+            self._blockcopy_mod = _DecodeModule(
+                os.path.join(artifact_dir, _BLOCKCOPY_DIR),
+                donate_state=True, device=self._device, aot_tag=aot_tag)
+            self._buckets = list(self._chunks)
+        else:
+            # sorted once at load: select_bucket prefers the smallest
+            # fitting bucket deterministically (batching.py discipline)
+            self._buckets = sorted(int(b)
+                                   for b in self._sig['prompt_buckets'])
+            self._max_prompt = self._buckets[-1]
+            self._prefill_mods = {
+                b: _DecodeModule(
+                    os.path.join(artifact_dir, _PREFILL_DIR % b),
+                    donate_state=True, device=self._device,
+                    aot_tag=aot_tag)
+                for b in self._buckets}
+            self._prefill_feeds = {
+                b: [e['name']
+                    for e in self._sig['prefill'][str(b)]['feeds']]
+                for b in self._buckets}
         self._state = None
         self._slots = [None] * self._S    # slot -> (request, beam index)
         self._closed = False
@@ -425,6 +618,26 @@ class DecodingPredictor(object):
     def prompt_buckets(self):
         return list(self._buckets)
 
+    @property
+    def layout(self):
+        """'slot' (contiguous rows, bucketed prefill) or 'block'
+        (block-paged cache, chunked prefill — ISSUE 13)."""
+        return self._layout
+
+    @property
+    def mesh_tag(self):
+        """Mesh tag of a sharded artifact (e.g. 'tpu_mp2'); None for
+        single-chip artifacts."""
+        return self._mesh_ctx['tag'] if self._mesh_ctx is not None \
+            else None
+
+    @property
+    def block_manager(self):
+        """The live BlockManager of a block-layout artifact (None on
+        slot artifacts): stats()/peak accounting for tooling, and
+        evict_all_prefixes() for an explicit prefix-cache clear."""
+        return self._blocks if self._layout == 'block' else None
+
     def submit(self, prompt_ids, max_new_tokens=None, beam=None,
                deadline_ms=None):
         """Enqueue one decode request; returns a TokenStream. Validation
@@ -458,10 +671,15 @@ class DecodingPredictor(object):
             prompt = np.asarray(prompt_ids, np.int64).reshape(-1).copy()
             if not prompt.size:
                 raise ValueError('empty prompt')
-            if prompt.size > self._buckets[-1]:
+            if prompt.size > self._max_prompt:
                 raise ValueError(
-                    'prompt of %d tokens exceeds the largest compiled '
-                    'prompt bucket %d' % (prompt.size, self._buckets[-1]))
+                    'prompt of %d tokens exceeds %s' % (
+                        prompt.size,
+                        'max_cache_len %d (chunked prefill admits up to '
+                        'the cache length)' % self._max_prompt
+                        if self._layout == 'block' else
+                        'the largest compiled prompt bucket %d'
+                        % self._max_prompt))
             max_new = int(max_new_tokens if max_new_tokens is not None
                           else self._default_max_new)
             # cache capacity: the last generated token writes position
@@ -512,11 +730,23 @@ class DecodingPredictor(object):
                 'warmup() must run before traffic: requests are queued or '
                 'decoding, and a caller-thread dispatch would race the '
                 "scheduler over the donated cache state")
-        for b in self._buckets:
-            self._dispatch_prefill(b, np.zeros((1, b), np.int64), 1, 0)
-        self._dispatch_step(np.zeros((self._S, 1), np.int64),
-                            np.zeros((self._S, 1), np.int32))
+        if self._layout == 'block':
+            trash_tables = np.full((self._S, self._maxb), self._trash,
+                                   np.int32)
+            for c in self._chunks:
+                self._dispatch_chunk(c, np.zeros((1, c), np.int64), 0, 1,
+                                     trash_tables[:1])
+            self._dispatch_step(np.zeros((self._S, 1), np.int64),
+                                np.zeros((self._S, 1), np.int32),
+                                tables=trash_tables)
+            self._dispatch_blockcopy([])      # identity (trash-to-trash)
+        else:
+            for b in self._buckets:
+                self._dispatch_prefill(b, np.zeros((1, b), np.int64), 1, 0)
+            self._dispatch_step(np.zeros((self._S, 1), np.int64),
+                                np.zeros((self._S, 1), np.int32))
         self._reset_state()
+        self.stats.reset()   # warmup dispatches must not count as traffic
         return self
 
     def drain(self, timeout=None):
@@ -569,23 +799,51 @@ class DecodingPredictor(object):
         return (jax.default_device(self._device)
                 if self._device is not None else contextlib.nullcontext())
 
+    def _feed(self, a):
+        """Host feed -> device arg. Sharded artifacts: every feed places
+        REPLICATED over the mesh explicitly — a numpy arg next to
+        mesh-sharded state would otherwise commit to one device and fail
+        the multi-device dispatch."""
+        if self._mesh_ctx is None:
+            return a
+        import jax
+        return jax.device_put(a, self._mesh_ctx['rep'])
+
     def _reset_state(self):
         """(Re)zero the paged KV cache. The zeros route through the
         UNDONATED reorder program so every leaf handed to the donated
         step/prefill executables is an XLA-owned buffer (a reloaded
         donating executable honors its baked-in aliasing without jax's
-        external-buffer guard — round-8/10 cliff)."""
+        external-buffer guard — round-8/10 cliff). Sharded artifacts
+        place each state leaf per its recorded mesh sharding; block
+        artifacts also rebuild the block allocator (every table is dead
+        by the time this runs)."""
         import jax
         zeros = [np.zeros(tuple(e['shape']), np.dtype(e['dtype']))
                  for e in self._sig['state']]
-        src = np.arange(self._S, dtype=np.int32)
+        n = (self._nb if self._layout == 'block' else self._S)
+        src = np.arange(n, dtype=np.int32)
         with self._dev_ctx():
-            state = [jax.device_put(z, self._device) for z in zeros]
-            self._state = list(self._reorder_mod.call(state, src))
+            if self._mesh_ctx is not None:
+                state = [jax.device_put(z, ns) for z, ns in
+                         zip(zeros, self._mesh_ctx['state_ns'])]
+            else:
+                state = [jax.device_put(z, self._device) for z in zeros]
+            self._state = list(self._reorder_mod.call(state,
+                                                      self._feed(src)))
+        if self._layout == 'block':
+            self._blocks = BlockManager(self._nb, self._bs)
+            # block-cache gauges + prefix-share accounting merge into
+            # stats.snapshot() (serving_report's block columns)
+            self.stats.block_source = self._blocks.stats
+            self.stats.block_reset = self._blocks.reset_counters
 
-    def _dispatch_step(self, tokens, pos):
+    def _dispatch_step(self, tokens, pos, tables=None):
         feed = {'tokens': tokens, 'pos': pos}
-        args = [feed[n] for n in self._step_feeds]  # signature feed order
+        if tables is not None:
+            feed['block_tables'] = tables
+        args = [self._feed(feed[n])
+                for n in self._step_feeds]  # signature feed order
         with self._dev_ctx():
             fetches, new_state = self._step_mod.call(self._state, args)
         self._state = list(new_state)
@@ -597,7 +855,7 @@ class DecodingPredictor(object):
         feed = {'prompt_ids': padded,
                 'prompt_len': np.full((1, 1), plen, np.int32),
                 'slot': np.full((1, 1), slot, np.int32)}
-        args = [feed[n] for n in self._prefill_feeds[bucket]]
+        args = [self._feed(feed[n]) for n in self._prefill_feeds[bucket]]
         with self._dev_ctx():
             fetches, new_state = self._prefill_mods[bucket].call(
                 self._state, args)
@@ -606,10 +864,47 @@ class DecodingPredictor(object):
             self.stats.prefills += 1
         return np.asarray(fetches[0])[0]                   # [V] sync
 
+    def _dispatch_chunk(self, size, ids, start, take, table_row):
+        """One chunked-prefill slice: `take` real rows of one prompt at
+        absolute positions start..start+take-1 (the rest of the `size`
+        rows are pad) write through `table_row` [1, max_blocks]."""
+        feed = {'chunk_ids': ids,
+                'start': np.full((1, 1), start, np.int32),
+                'chunk_len': np.full((1, 1), take, np.int32),
+                'block_table': np.asarray(table_row, np.int32)}
+        args = [self._feed(feed[n]) for n in self._chunk_feeds[size]]
+        with self._dev_ctx():
+            fetches, new_state = self._chunk_mods[size].call(
+                self._state, args)
+        self._state = list(new_state)
+        with self.stats._lock:
+            self.stats.prefills += 1
+            self.stats.chunk_slices += 1
+        return np.asarray(fetches[0])[0]                   # [V] sync
+
+    def _dispatch_blockcopy(self, pairs):
+        """One block-copy dispatch: every (dst, src) PHYSICAL-BLOCK pair
+        copies pool-wide (all layers' K/V (+scale) vars). Unused pairs
+        pad with (trash, trash) — a self-copy of the write-only trash
+        block. This is the CoW device half: dispatch bytes scale with
+        len(pairs) x block bytes, not with slot rows."""
+        dst = np.full((self._S,), self._trash, np.int32)
+        src = np.full((self._S,), self._trash, np.int32)
+        for i, (d, s) in enumerate(pairs):
+            dst[i] = d
+            src[i] = s
+        with self._dev_ctx():
+            new_state = self._blockcopy_mod.call(
+                self._state, self._feed(dst), self._feed(src))
+        self._state = list(new_state)
+        with self.stats._lock:
+            self.stats.blockcopies += 1
+            self.stats.cow_blocks += len(pairs)
+
     def _dispatch_reorder(self, src):
         with self._dev_ctx():
             self._state = list(self._reorder_mod.call(
-                self._state, np.asarray(src, np.int32)))
+                self._state, self._feed(np.asarray(src, np.int32))))
         with self.stats._lock:
             self.stats.reorders += 1
 
@@ -627,6 +922,29 @@ class DecodingPredictor(object):
     def _release(self, req):
         for s in req.slots:
             self._slots[s] = None
+        if self._layout == 'block':
+            # refcount-to-zero blocks return to the pool; blocks a
+            # prefix entry (or another request) still references live on
+            for t in req.tables:
+                self._blocks.decref(t)
+            req.tables = []
+            self._drop_match(req)
+
+    def _drop_match(self, req):
+        """Release a waiting request's cached prefix-match refs (held
+        from the first admission attempt so the matched blocks cannot
+        evict while the request waits at the head of the queue)."""
+        if req.match is not None:
+            self._blocks.decref(req.match[0])
+            req.match = None
+
+    def _table_row(self, table):
+        """One slot's block-table row, padded to max_blocks_per_slot
+        with the trash block (pad rows are never read: attention masks
+        j <= pos and pos never reaches the pad span)."""
+        row = np.full((1, self._maxb), self._trash, np.int32)
+        row[0, :len(table)] = table
+        return row
 
     def _sched_loop(self):
         waiting = deque()
@@ -652,12 +970,24 @@ class DecodingPredictor(object):
                 self._shed_waiting(waiting)
             self._expire(waiting)
             if not self._draining:
-                self._admit(waiting)
+                if self._layout == 'block':
+                    self._admit_block(waiting)
+                else:
+                    self._admit(waiting)
             if any(s is not None for s in self._slots):
                 try:
-                    self._step()
+                    if self._layout == 'block':
+                        # one prefill slice per admitting request, then
+                        # one step for the running batch: a long prompt
+                        # interleaves instead of stalling every stream
+                        self._prefill_tick()
+                        if any(e is not None and not e[0].prefilling
+                               for e in self._slots):
+                            self._step_block(waiting)
+                    else:
+                        self._step()
                 except Exception as e:
-                    self._fail_all(e)
+                    self._fail_all(e, waiting)
                 with self.stats._lock:
                     self.stats.busy_s += time.perf_counter() - t0
             if self._draining and not waiting \
@@ -670,6 +1000,7 @@ class DecodingPredictor(object):
         slot, so a fleet router can re-route them."""
         while waiting:
             req = waiting.popleft()
+            self._drop_match(req)
             with self.stats._lock:
                 self.stats.queue_depth -= 1
                 self.stats.shed += 1
@@ -683,6 +1014,7 @@ class DecodingPredictor(object):
             self._release(req)
             req.stream._fail(err)
         for req in waiting:
+            self._drop_match(req)
             with self.stats._lock:
                 self.stats.queue_depth -= 1
             req.stream._fail(err)
@@ -704,6 +1036,7 @@ class DecodingPredictor(object):
             cancelled = req.stream._cancelled
             if cancelled or (req.deadline is not None
                              and now > req.deadline):
+                self._drop_match(req)
                 with self.stats._lock:
                     self.stats.queue_depth -= 1
                     if not cancelled:
@@ -756,7 +1089,7 @@ class DecodingPredictor(object):
                 # co-resident requests loudly, rebuild zero state)
                 self._release(req)
                 req.stream._fail(e)
-                self._fail_all(e)
+                self._fail_all(e, waiting)
                 return
 
     def _prefill(self, req):
@@ -765,9 +1098,19 @@ class DecodingPredictor(object):
         padded = np.zeros((1, bucket), np.int64)
         padded[0, :plen] = req.prompt
         logits = self._dispatch_prefill(bucket, padded, plen, req.slots[0])
-        now = time.perf_counter()
         for i, s in enumerate(req.slots):
             self._slots[s] = (req, i)
+        self._first_token(req, logits)
+
+    def _first_token(self, req, logits):
+        """Emit a request's first token from its prompt logits: greedy
+        argmax, or the top-W DISTINCT tokens seeding a beam group (the
+        standard first-expansion; a naive W*V step over identical beams
+        would collapse onto one token). Beam history fan-out: the slot
+        layout replicates slot 0's cache rows through the reorder
+        program; the block layout FORKS the prompt's block table — a
+        host-side copy + incref, zero device work."""
+        now = time.perf_counter()
         if req.beam is None:
             tok = int(np.argmax(logits))
             req.last_tokens = [tok]
@@ -778,15 +1121,18 @@ class DecodingPredictor(object):
             if tok == self._eos or req.produced >= req.max_new:
                 self._finish_greedy(req)
             return
-        # beam: replicate slot 0's cache to the other beam slots, then
-        # seed the W beams with the top-W DISTINCT first tokens (the
-        # standard first-expansion; a naive W*V step over identical
-        # beams would collapse onto one token)
         if len(req.slots) > 1:
-            src = np.arange(self._S, dtype=np.int32)
-            for s in req.slots[1:]:
-                src[s] = req.slots[0]
-            self._dispatch_reorder(src)
+            if self._layout == 'block':
+                base = req.tables[0]
+                req.tables = [base] + [list(base)
+                                       for _ in req.slots[1:]]
+                for t in req.tables[1:]:
+                    self._blocks.incref(t)
+            else:
+                src = np.arange(self._S, dtype=np.int32)
+                for s in req.slots[1:]:
+                    src[s] = req.slots[0]
+                self._dispatch_reorder(src)
         lp = _log_softmax(logits)
         order = np.argsort(-lp, kind='stable')[:req.beam]
         req.last_tokens = [int(t) for t in order]
@@ -797,6 +1143,269 @@ class DecodingPredictor(object):
         self._record_emit(req, now, count=req.beam)
         if all(req.finished) or req.produced >= req.max_new:
             self._finish_beam(req)
+
+    # -- block-layout scheduling (ISSUE 13) --------------------------------
+    def _admit_block(self, waiting):
+        """Strict-FIFO block-layout admission: a request admits when a
+        slot group AND blocks for its whole prompt span are available.
+        A prefix-cache hit maps the shared blocks into the table and
+        skips allocating (and later prefilling) the covered span; the
+        match is cached on the request across attempts, so its refs pin
+        the matched blocks against eviction while the request waits at
+        the head of the queue."""
+        while waiting:
+            req = waiting[0]
+            need = req.beam or 1
+            free = self._free_slots()
+            if len(free) < need:
+                return
+            plen = int(req.prompt.size)
+            if req.match is None or (not req.match[0] and
+                                     req.match_epoch
+                                     != self._blocks.prefix_epoch):
+                # a cached HIT's refs pin the matched blocks across
+                # attempts; a cached MISS holds no refs, so re-match —
+                # but only when a prefix was PUBLISHED since the last
+                # attempt (e.g. by the in-flight request ahead of us):
+                # the epoch gate keeps a slow-to-admit request from
+                # re-hashing its prompt (and counting a fresh miss)
+                # every scheduler tick
+                req.match_epoch = self._blocks.prefix_epoch
+                req.match = self._blocks.match_prefix(req.prompt)
+            shared, covered = req.match
+            try:
+                fresh = self._blocks.alloc(
+                    self._blocks.blocks_for(plen) - len(shared))
+            except BlockPoolExhausted:
+                if self._active_requests():
+                    return   # head-of-line waits for blocks to free
+                # nothing running will ever free blocks: this prompt can
+                # never fit — shed loudly instead of deadlocking
+                waiting.popleft()
+                self._drop_match(req)
+                with self.stats._lock:
+                    self.stats.queue_depth -= 1
+                    self.stats.shed += 1
+                req.stream._fail(ServerOverloaded(
+                    'KV block pool exhausted: prompt of %d token(s) '
+                    'needs more blocks than the pool can free'
+                    % plen))
+                continue
+            waiting.popleft()
+            req.match = None        # refs transferred into the table
+            with self.stats._lock:
+                self.stats.queue_depth -= 1
+            req.tables = [list(shared) + list(fresh)]
+            req.next_start = int(covered)
+            req.prefilling = True
+            req.slots = free[:need]
+            for i, s in enumerate(req.slots):
+                self._slots[s] = (req, i)
+
+    def _prefill_tick(self):
+        """One chunked-prefill slice per ADMITTING request: the
+        uncovered prompt span (a prefix hit skips the covered span's
+        compute AND storage) admits in fixed-size slices, one per
+        scheduler iteration, interleaved with the running batch's decode
+        steps — a max-length prompt no longer stalls every stream's
+        inter-token latency for its whole prefill."""
+        for req in self._active_requests():
+            if not req.prefilling:
+                continue
+            plen = int(req.prompt.size)
+            remaining = plen - req.next_start
+            size = select_bucket(self._chunks,
+                                 min(remaining, self._chunks[-1]))
+            take = min(size, remaining)
+            ids = np.zeros((1, size), np.int64)
+            ids[0, :take] = req.prompt[req.next_start:
+                                       req.next_start + take]
+            logits = self._dispatch_chunk(size, ids, req.next_start,
+                                          take,
+                                          self._table_row(req.tables[0]))
+            req.next_start += take
+            if req.next_start < plen:
+                continue
+            req.prefilling = False
+            # publish the prompt's FULL blocks for prefix reuse (the
+            # partial tail stays private: decode writes land there)
+            self._blocks.register_prefix(req.prompt, req.tables[0])
+            self._first_token(req, logits)
+
+    def _live_rows(self):
+        """(request, beam index, write position) for every slot that
+        writes this step: decoding requests' unfinished beams. Finished
+        beams idle (trash row) — their frozen candidate needs no cache
+        writes, and skipping them avoids spurious CoW/extension."""
+        rows = []
+        for req in self._active_requests():
+            if req.prefilling:
+                continue
+            for bi in range(len(req.slots)):
+                if req.beam is not None and req.finished[bi]:
+                    continue
+                p = int(req.prompt.size) + req.produced - 1
+                rows.append((req, bi, p))
+        return rows
+
+    def _preflight_blocks(self, waiting=()):
+        """Reserve this step's exact fresh-block demand (one per row
+        whose write block must extend or copy-on-write) BEFORE building
+        the dispatch. Pressure resolves in severity order: first
+        un-pin WAITING requests' cached prefix matches (their refs can
+        make prefix entries non-evictable; a queued request simply
+        re-matches at its next admission attempt), only then shed the
+        YOUNGEST decoding request — never kill an in-flight stream for
+        a pin a queued request can re-acquire. All-or-nothing, so row
+        building never unwinds a half-planned step."""
+        while True:
+            need = 0
+            shared = {}
+            for req, bi, p in self._live_rows():
+                table = req.tables[bi]
+                lblk = p // self._bs
+                if lblk >= len(table):
+                    need += 1            # extension: always a fresh block
+                elif not self._blocks.writable(table[lblk]):
+                    b = table[lblk]
+                    shared[b] = shared.get(b, 0) + 1
+            for b, k in shared.items():
+                # k rows CoW the same block in table order; each CoW
+                # decrefs it, so the LAST sharer writes in place when no
+                # reference beyond this step's k tables remains
+                need += k if self._blocks.refcount(b) > k else k - 1
+            if self._blocks.reserve(need):
+                return
+            dropped = False
+            for req in waiting:
+                if req.match is not None and req.match[0]:
+                    self._drop_match(req)
+                    dropped = True
+            if dropped:
+                continue     # pins released: entries may evict now
+            victims = [r for r in self._active_requests()
+                       if not r.prefilling]
+            if not victims:
+                return
+            victim = max(victims, key=lambda r: r.t_submit)
+            self._release(victim)
+            with self.stats._lock:
+                self.stats.shed += 1
+            victim.stream._fail(MidStreamEvicted(
+                'evicted under KV block-pool pressure after %d '
+                'token(s): pool fully pinned by older requests'
+                % victim.produced))
+
+    def _ensure_writable(self, req, bi, p, cow):
+        """Make the block backing logical position p of beam `bi`
+        exclusively owned before the step writes it: extend the table
+        when p enters a new block, copy-on-write when the block is
+        shared (beam fork or prefix sharing) — the diverged BLOCK is
+        the unit of copy, not the slot row."""
+        table = req.tables[bi]
+        lblk = p // self._bs
+        while len(table) <= lblk:
+            table.extend(self._blocks.alloc(1))
+        b = table[lblk]
+        if not self._blocks.writable(b):
+            nb = self._blocks.alloc(1)[0]
+            cow.append((nb, b))
+            self._blocks.decref([b])
+            table[lblk] = nb
+
+    def _step_block(self, waiting):
+        """One iteration of the continuous batch over the block pool:
+        CoW copies dispatch first (one block-copy for ALL diverged
+        blocks), then every live slot advances one token through the
+        fixed-shape step; beam reorder afterwards is pure block-table
+        permutation (incref/decref, zero device work until the next
+        write diverges a shared tail block)."""
+        tokens = np.zeros((self._S, 1), np.int64)
+        pos = np.zeros((self._S, 1), np.int32)
+        tables = np.full((self._S, self._maxb), self._trash, np.int32)
+        self._preflight_blocks(waiting)
+        cow = []
+        active = 0
+        for req, bi, p in self._live_rows():
+            self._ensure_writable(req, bi, p, cow)
+            s = req.slots[bi]
+            active += 1
+            tokens[s, 0] = req.last_tokens[bi]
+            pos[s, 0] = p
+            table = req.tables[bi]
+            tables[s, :len(table)] = table
+        if not active:
+            return   # preflight shed every live stream: nothing to step
+        with self.stats._lock:
+            self.stats.active_slot_steps += active
+            self.stats.slot_steps += self._S
+        if cow:
+            self._dispatch_blockcopy(cow)
+        logits = self._dispatch_step(tokens, pos, tables=tables)
+        now = time.perf_counter()
+        for req in self._active_requests():
+            if req.prefilling:
+                continue
+            if req.beam is None:
+                self._advance_greedy(req, logits, now)
+                continue
+            # shared beam scoring; the history move is the block
+            # layout's own — table permutation instead of a slot-row
+            # gather
+            parents = self._score_beam(req, logits)
+            if any(int(p) != i for i, p in enumerate(parents)):
+                old = req.tables
+                new = [list(old[int(p)]) for p in parents]
+                for t in new:
+                    self._blocks.incref(t)
+                for t in old:
+                    self._blocks.decref(t)
+                req.tables = new
+                with self.stats._lock:
+                    self.stats.reorders += 1
+            req.produced += 1
+            self._record_emit(req, now, count=req.beam)
+            if all(req.finished) or req.produced >= req.max_new:
+                self._finish_beam(req)
+
+    def _advance_greedy(self, req, logits, now):
+        """Shared slot/block greedy advance: emit the argmax token,
+        finish on eos/max_new."""
+        tok = int(np.argmax(logits[req.slots[0]]))
+        req.last_tokens[0] = tok
+        req.tokens.append(tok)
+        req.produced += 1
+        self._record_emit(req, now)
+        req.stream._push(tok)
+        if tok == self._eos or req.produced >= req.max_new:
+            self._finish_greedy(req)
+
+    def _score_beam(self, req, logits):
+        """Fixed-width beam candidate scoring (finished beams
+        contribute one frozen eos candidate — ops/decode_ops.py
+        beam_search discipline): updates scores/hyps/finished/
+        last_tokens and returns `parents` for the layout's own history
+        move (slot-row gather vs block-table permutation). ONE copy, so
+        the two layouts can never drift out of the bit-identity the
+        cross-tier tests and rollout 'bit' promotion depend on."""
+        W, V = req.beam, self._vocab
+        cand = np.full((W, V), -np.inf, np.float64)
+        for i in range(W):
+            if req.finished[i]:
+                cand[i, self._eos] = req.scores[i]
+            else:
+                cand[i] = req.scores[i] + _log_softmax(
+                    logits[req.slots[i]])
+        order = np.argsort(-cand, axis=None, kind='stable')[:W]
+        parents = order // V
+        toks = order % V
+        req.scores = [float(cand[p, t]) for p, t in zip(parents, toks)]
+        req.hyps = [req.hyps[p] + [int(t)]
+                    for p, t in zip(parents, toks)]
+        req.finished = [req.finished[p] or int(t) == self._eos
+                        for p, t in zip(parents, toks)]
+        req.last_tokens = [int(t) for t in toks]
+        return parents
 
     def _record_emit(self, req, now, count=1):
         with self.stats._lock:
@@ -844,38 +1453,15 @@ class DecodingPredictor(object):
         src = np.arange(self._S, dtype=np.int32)
         for req in self._active_requests():
             if req.beam is None:
-                tok = int(np.argmax(logits[req.slots[0]]))
-                req.last_tokens[0] = tok
-                req.tokens.append(tok)
-                req.produced += 1
-                self._record_emit(req, now)
-                req.stream._push(tok)
-                if tok == self._eos or req.produced >= req.max_new:
-                    self._finish_greedy(req)
+                self._advance_greedy(req, logits, now)
                 continue
-            # fixed-width beam: finished beams contribute one frozen
-            # eos candidate (ops/decode_ops.py beam_search discipline)
-            W, V = req.beam, self._vocab
-            cand = np.full((W, V), -np.inf, np.float64)
-            for i in range(W):
-                if req.finished[i]:
-                    cand[i, self._eos] = req.scores[i]
-                else:
-                    cand[i] = req.scores[i] + _log_softmax(
-                        logits[req.slots[i]])
-            order = np.argsort(-cand, axis=None, kind='stable')[:W]
-            parents = order // V
-            toks = order % V
-            req.scores = [float(cand[p, t]) for p, t in zip(parents, toks)]
-            req.hyps = [req.hyps[p] + [int(t)]
-                        for p, t in zip(parents, toks)]
-            req.finished = [req.finished[p] or int(t) == self._eos
-                            for p, t in zip(parents, toks)]
-            req.last_tokens = [int(t) for t in toks]
-            for i in range(W):
+            # shared beam scoring; the history move is the slot
+            # layout's own — a slot-row gather
+            parents = self._score_beam(req, logits)
+            for i in range(req.beam):
                 src[req.slots[i]] = req.slots[parents[i]]
             req.produced += 1
-            self._record_emit(req, now, count=W)
+            self._record_emit(req, now, count=req.beam)
             if all(req.finished) or req.produced >= req.max_new:
                 self._finish_beam(req)
                 for s in req.slots:   # a finished group never reorders
@@ -885,7 +1471,7 @@ class DecodingPredictor(object):
             # cache follows its parent before the next step writes
             self._dispatch_reorder(src)
 
-    def _fail_all(self, exc):
+    def _fail_all(self, exc, waiting=()):
         """A dispatch failure mid-step may have consumed the donated
         state: fail every in-flight request loudly and rebuild a clean
         zero state so the endpoint keeps serving. If even the rebuild
@@ -895,6 +1481,13 @@ class DecodingPredictor(object):
         for req in self._active_requests():
             self._release(req)
             req.stream._fail(exc)
+        for req in waiting:
+            # cached prefix matches hold block ids of the manager the
+            # rebuild below discards: a stale HIT would map dead blocks
+            # (zeroed, re-allocatable) into a fresh table — drop them
+            # so the next admission attempt re-matches the new pool
+            req.match = None
+            req.match_epoch = -1
         try:
             self._reset_state()
         except Exception as e:

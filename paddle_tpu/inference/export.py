@@ -307,6 +307,44 @@ def _quantize_for_export(predictor, calibration, mode, q):
     return qprog, meta
 
 
+def _decode_mesh(axes, platform=None):
+    """Build the compile mesh for a sharded decode export. Delegates to
+    the load-time reconstruction in decoding.py — ONE copy of the
+    device-ordering rule, so an exported artifact can never place
+    differently at serve time."""
+    from . import decoding as _decoding
+    return _decoding._decode_mesh(axes, platform)
+
+
+def _mesh_tag(platform, axes):
+    """Mesh-tagged AOT sidecar key: aot_<platform>_<axes>.jaxexec (e.g.
+    aot_cpu_mp2.jaxexec) — a sharded executable must never load into an
+    unsharded serve (or a different mesh shape), so the tag carries the
+    axis layout next to the platform."""
+    return '%s_%s' % (platform, ''.join(
+        '%s%d' % (a, int(axes[a])) for a in sorted(axes)))
+
+
+def _decode_shard_ctx(spec, state_names, platform=None):
+    """Resolve the spec's mesh annotations into concrete NamedShardings:
+    returns None for unsharded specs, else {mesh, rep, state_ns (aligned
+    with state_names), param_ns, axes, tag}."""
+    axes = spec.get('mesh_axes')
+    if not axes:
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec
+    from .decoding import _state_shardings_ns
+    mesh = _decode_mesh(axes, platform)
+    rep, state_ns = _state_shardings_ns(
+        mesh, spec.get('state_shardings'), state_names)
+    param_ns = {n: NamedSharding(mesh, PartitionSpec(*ps))
+                for n, ps in (spec.get('param_shardings') or {}).items()}
+    plat = np.asarray(mesh.devices).reshape(-1)[0].platform
+    return {'mesh': mesh, 'rep': rep, 'state_ns': state_ns,
+            'param_ns': param_ns, 'axes': dict(axes),
+            'platform': plat, 'tag': _mesh_tag(plat, axes)}
+
+
 def export_decode(spec, out_dir, scope=None, precompile=None,
                   kv_cache_dtype=None):
     """Export a TWO-PROGRAM continuous-decode serving artifact (ISSUE 8).
@@ -354,6 +392,25 @@ def export_decode(spec, out_dir, scope=None, precompile=None,
     same budget serves ~2x max_slots. The signature records the dtype
     and the per-state byte accounting for capacity planning.
 
+    Block-paged specs (ISSUE 13, build_decode_spec(block_size=...))
+    export the BLOCK layout: the cache pool is addressed through block
+    tables fed at dispatch time, prefill is chunked (prefill_chunk_<C>/
+    one program per chunk size), and the artifact carries a BLOCKCOPY
+    program (decode_blockcopy/: up to max_slots (dst, src) block pairs
+    copy per dispatch — beam copy-on-write moves diverged BLOCKS, not
+    slot rows) next to the reorder program (which gathers over blocks
+    and remains the owned-buffer init boundary).
+
+    Specs annotated for tensor-model sharding (build_decode_spec
+    mp_shard=k) trace every program over the composed mesh: params bake
+    in as mp-sharded constants, the KV block pool threads through as
+    mp-sharded donated state (round-13 output-sharding pinning keeps
+    the step a sharding-stable loop), and AOT sidecars are MESH-TAGGED
+    (aot_<platform>_mp<k>.jaxexec). The signature records the mesh so
+    DecodingPredictor rebuilds it at load; serving needs prod(axes)
+    devices. Sharded artifacts are single-platform (the exporting
+    backend).
+
     Load with inference/decoding.py DecodingPredictor (framework-free).
     Returns out_dir.
     """
@@ -369,6 +426,7 @@ def export_decode(spec, out_dir, scope=None, precompile=None,
             "requested cache dtype (build_decode_spec(kv_cache_dtype=...))"
             % (kv_cache_dtype, spec_kv))
     scope = scope if scope is not None else global_scope()
+    layout = spec.get('layout', 'slot')
     state_names = list(spec['cache_vars'])
     state0 = []
     for n in state_names:
@@ -378,38 +436,69 @@ def export_decode(spec, out_dir, scope=None, precompile=None,
                 "cache var %r has no value in the scope — run the spec's "
                 "startup program before export_decode" % n)
         state0.append(np.asarray(val))
+    shard = _decode_shard_ctx(spec, state_names)
     step = spec['step']
-    if sorted(step['feeds']) != ['pos', 'tokens']:
-        raise ValueError("decode-step feeds must be ['tokens', 'pos'], "
-                         "got %r" % (step['feeds'],))
-    buckets = sorted(int(b) for b in spec['prefill'])
-    if not buckets:
-        raise ValueError("export_decode needs at least one prompt bucket")
+    step_want = (['block_tables', 'pos', 'tokens'] if layout == 'block'
+                 else ['pos', 'tokens'])
+    if sorted(step['feeds']) != step_want:
+        raise ValueError("decode-step feeds must be %r, got %r"
+                         % (step_want, step['feeds']))
     os.makedirs(out_dir, exist_ok=True)
 
     step_feeds = _export_decode_program(
         step, state_names, state0, scope,
-        os.path.join(out_dir, _decoding._STEP_DIR))
+        os.path.join(out_dir, _decoding._STEP_DIR), shard=shard)
     prefill_sig = {}
-    for L in buckets:
-        p = spec['prefill'][L]
-        if sorted(p['feeds']) != ['prompt_ids', 'prompt_len', 'slot']:
-            raise ValueError(
-                "prefill feeds must be ['prompt_ids', 'prompt_len', "
-                "'slot'], got %r" % (p['feeds'],))
-        prefill_sig[str(L)] = {
-            'feeds': _export_decode_program(
-                p, state_names, state0, scope,
-                os.path.join(out_dir, _decoding._PREFILL_DIR % L)),
-            'fetches': list(p['fetches'])}
-    _export_decode_reorder(state0, int(spec['max_slots']),
-                           os.path.join(out_dir, _decoding._REORDER_DIR))
+    chunk_sig = {}
+    if layout == 'block':
+        chunks = sorted(int(c) for c in spec['chunk'])
+        if not chunks:
+            raise ValueError("block-layout export needs at least one "
+                             "chunk size")
+        for C in chunks:
+            p = spec['chunk'][C]
+            if sorted(p['feeds']) != ['block_table', 'chunk_ids',
+                                      'chunk_len', 'start']:
+                raise ValueError(
+                    "chunk feeds must be ['chunk_ids', 'start', "
+                    "'chunk_len', 'block_table'], got %r" % (p['feeds'],))
+            chunk_sig[str(C)] = {
+                'feeds': _export_decode_program(
+                    p, state_names, state0, scope,
+                    os.path.join(out_dir, _decoding._CHUNK_DIR % C),
+                    shard=shard),
+                'fetches': list(p['fetches'])}
+        _export_decode_blockcopy(
+            state0, int(spec['max_slots']),
+            os.path.join(out_dir, _decoding._BLOCKCOPY_DIR), shard=shard)
+        reorder_n = int(spec['num_blocks'])
+    else:
+        buckets = sorted(int(b) for b in spec['prefill'])
+        if not buckets:
+            raise ValueError("export_decode needs at least one prompt "
+                             "bucket")
+        for L in buckets:
+            p = spec['prefill'][L]
+            if sorted(p['feeds']) != ['prompt_ids', 'prompt_len', 'slot']:
+                raise ValueError(
+                    "prefill feeds must be ['prompt_ids', 'prompt_len', "
+                    "'slot'], got %r" % (p['feeds'],))
+            prefill_sig[str(L)] = {
+                'feeds': _export_decode_program(
+                    p, state_names, state0, scope,
+                    os.path.join(out_dir, _decoding._PREFILL_DIR % L),
+                    shard=shard),
+                'fetches': list(p['fetches'])}
+        reorder_n = int(spec['max_slots'])
+    _export_decode_reorder(state0, reorder_n,
+                           os.path.join(out_dir, _decoding._REORDER_DIR),
+                           shard=shard)
 
-    sig = {'version': 1, 'kind': 'decode',
+    sig = {'version': 2, 'kind': 'decode',
+           'layout': layout,
            'max_slots': int(spec['max_slots']),
            'max_cache_len': int(spec['max_cache_len']),
            'eos_id': int(spec['eos_id']), 'vocab': int(spec['vocab']),
-           'prompt_buckets': buckets,
            'kv_cache_dtype': spec_kv,
            # fixed-HBM capacity planning: what the paged cache state
            # costs per replica (int8 tier: int8 pages + f32 page scales)
@@ -417,8 +506,25 @@ def export_decode(spec, out_dir, scope=None, precompile=None,
            'state': [{'name': n, 'shape': list(a.shape),
                       'dtype': a.dtype.name}
                      for n, a in zip(state_names, state0)],
-           'step': {'feeds': step_feeds, 'fetches': list(step['fetches'])},
-           'prefill': prefill_sig}
+           'step': {'feeds': step_feeds, 'fetches': list(step['fetches'])}}
+    if layout == 'block':
+        sig['block'] = {'block_size': int(spec['block_size']),
+                        'num_blocks': int(spec['num_blocks']),
+                        'max_blocks_per_slot':
+                            int(spec['max_blocks_per_slot'])}
+        sig['chunk_buckets'] = chunks
+        sig['chunk'] = chunk_sig
+    else:
+        sig['prompt_buckets'] = buckets
+        sig['prefill'] = prefill_sig
+    if shard is not None:
+        sig['mesh'] = {'axes': {a: int(n) for a, n in
+                                shard['axes'].items()},
+                       'platform': shard['platform'],
+                       'tag': shard['tag'],
+                       'state_shardings':
+                           {n: list(ps) for n, ps in
+                            (spec.get('state_shardings') or {}).items()}}
     with open(os.path.join(out_dir, _decoding._DECODE_SIGNATURE), 'w') as f:
         json.dump(sig, f, indent=1)
     if _should_precompile(precompile):
@@ -434,13 +540,60 @@ def export_decode(spec, out_dir, scope=None, precompile=None,
     return out_dir
 
 
-def _export_decode_program(entry, state_names, state0, scope, out_dir):
+def _shard_trace_ctx(shard):
+    """Trace-time context for a sharded decode export: the spec's
+    sharding_hint ops resolve against the mesh via the round-13
+    trace_mesh_scope machinery. Null context when unsharded."""
+    import contextlib
+    if shard is None:
+        return contextlib.nullcontext()
+    from ..parallel.mesh import trace_mesh_scope
+    return trace_mesh_scope(shard['mesh'])
+
+
+def _export_serialize(fn, in_specs, out_dir, shard=None,
+                      out_shardings=None):
+    """jit + jax.export one decode program and write its module. An
+    unsharded program exports cross-platform (cpu+tpu); a sharded one is
+    single-platform (the mesh's) with the state pinned input AND output
+    to its annotated shardings — the round-13 fixed-point discipline
+    that keeps the step a sharding-stable loop under the AOT warm
+    path."""
+    import jax
+    from jax import export as jexport
+    if shard is None:
+        jitted = jax.jit(fn)
+        platforms = ['cpu', 'tpu']
+    else:
+        def rep_like(spec_tree):
+            return jax.tree_util.tree_map(lambda _: shard['rep'],
+                                          spec_tree)
+        in_sh = (list(shard['state_ns']),) + tuple(
+            rep_like(s) for s in in_specs[1:])
+        out_sh = (out_shardings if out_shardings is not None
+                  else (None, list(shard['state_ns'])))
+        jitted = jax.jit(fn, in_shardings=in_sh, out_shardings=out_sh)
+        platforms = [shard['platform']]
+    with _shard_trace_ctx(shard):
+        exp = jexport.export(jitted, platforms=platforms)(*in_specs)
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, _MODULE), 'wb') as f:
+        f.write(exp.serialize())
+
+
+def _export_decode_program(entry, state_names, state0, scope, out_dir,
+                           shard=None):
     """Trace one decode program as fn(state, feeds) -> (fetches,
     new_state) — export_train_step's state-threading convention minus
     the rng (decode programs draw no randomness) — and serialize it.
-    Returns the feed signature entries."""
+    With `shard` (_decode_shard_ctx), the trace runs over the composed
+    mesh: baked params CONSTRAIN to their annotated shardings (so the
+    weights genuinely partition across the mesh instead of replicating
+    as constants), the KV state threads through mp-sharded input->output
+    (fixed-point pinned), and feeds/fetches stay replicated (the host
+    scheduler sees full arrays). Returns the feed signature entries."""
     import jax
-    from jax import export as jexport
+    import jax.numpy as jnp
     from ..core.lowering import Tracer
     from ..core.lod import LoDArray
     from .. import passes
@@ -474,10 +627,18 @@ def _export_decode_program(entry, state_names, state0, scope, out_dir):
                 baked[v.name] = np.asarray(
                     val.data if isinstance(val, LoDArray) else val)
     rng = jax.random.key(0)  # decode programs draw no randomness
+    param_ns = shard['param_ns'] if shard is not None else {}
 
     def fn(state_list, feed_list):
         tracer = Tracer(program, rng)
-        tracer.env.update(baked)
+        for n, v in baked.items():
+            ns = param_ns.get(n)
+            if ns is not None:
+                # baked constant -> sharded resident weight: without the
+                # constraint GSPMD may replicate the constant and the
+                # model stops fitting the per-chip HBM the mesh buys
+                v = jax.lax.with_sharding_constraint(jnp.asarray(v), ns)
+            tracer.env[n] = v
         tracer.env.update(dict(zip(state_names, state_list)))
         tracer.env.update(dict(zip(feed_names, feed_list)))
         tracer.run_block(program.global_block())
@@ -487,35 +648,59 @@ def _export_decode_program(entry, state_names, state0, scope, out_dir):
     state_specs = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in state0]
     feed_specs = [jax.ShapeDtypeStruct(samples[n].shape, samples[n].dtype)
                   for n in feed_names]
-    exp = jexport.export(jax.jit(fn), platforms=['cpu', 'tpu'])(
-        state_specs, feed_specs)
-    os.makedirs(out_dir, exist_ok=True)
-    with open(os.path.join(out_dir, _MODULE), 'wb') as f:
-        f.write(exp.serialize())
+    out_sh = None
+    if shard is not None:
+        out_sh = ([shard['rep']] * len(fetch_names),
+                  list(shard['state_ns']))
+    _export_serialize(fn, (state_specs, feed_specs), out_dir, shard=shard,
+                      out_shardings=out_sh)
     return [{'name': n, 'shape': list(samples[n].shape),
              'dtype': samples[n].dtype.name} for n in feed_names]
 
 
-def _export_decode_reorder(state0, max_slots, out_dir):
-    """Serialize the slot-gather program: new_state[i] = state[i][src]
-    per cache var (src [max_slots] int32). Pure structural jax — no
+def _export_decode_reorder(state0, n_rows, out_dir, shard=None):
+    """Serialize the axis-0 gather program: new_state[i] = state[i][src]
+    per cache var (src [n_rows] int32 — slot rows in the slot layout,
+    PHYSICAL BLOCKS in the block layout). Pure structural jax — no
     Program IR needed. Undonated by design: besides beam reordering, the
     serving tier routes freshly loaded state through it once so every
     buffer reaching the DONATED step/prefill executables is XLA-owned."""
     import jax
     import jax.numpy as jnp
-    from jax import export as jexport
 
     def fn(state_list, src):
         return [jnp.take(s, src, axis=0) for s in state_list]
 
     state_specs = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in state0]
-    src_spec = jax.ShapeDtypeStruct((max_slots,), np.int32)
-    exp = jexport.export(jax.jit(fn), platforms=['cpu', 'tpu'])(
-        state_specs, src_spec)
-    os.makedirs(out_dir, exist_ok=True)
-    with open(os.path.join(out_dir, _MODULE), 'wb') as f:
-        f.write(exp.serialize())
+    src_spec = jax.ShapeDtypeStruct((n_rows,), np.int32)
+    out_sh = None
+    if shard is not None:
+        out_sh = list(shard['state_ns'])
+    _export_serialize(fn, (state_specs, src_spec), out_dir, shard=shard,
+                      out_shardings=out_sh)
+
+
+def _export_decode_blockcopy(state0, max_pairs, out_dir, shard=None):
+    """Serialize the block-copy program (block layout only): up to
+    `max_pairs` (dst, src) PHYSICAL-BLOCK pairs copy per dispatch —
+    new_state[i] = state[i].at[dst].set(state[i][src]) for every pool
+    var. This is beam copy-on-write's device half: the scheduler copies
+    only the DIVERGED partial tail blocks of a reordered beam group (and
+    pads unused pairs with (0, 0) — a trash-to-trash self-copy), so
+    reorder dispatch bytes scale with diverged blocks instead of whole
+    slot rows. Donated at load (in-place on the live pool)."""
+    import jax
+
+    def fn(state_list, dst, src):
+        return [s.at[dst].set(s[src]) for s in state_list]
+
+    state_specs = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in state0]
+    idx_spec = jax.ShapeDtypeStruct((max_pairs,), np.int32)
+    out_sh = None
+    if shard is not None:
+        out_sh = list(shard['state_ns'])
+    _export_serialize(fn, (state_specs, idx_spec, idx_spec), out_dir,
+                      shard=shard, out_shardings=out_sh)
 
 
 def _optimize_for_export(predictor):

@@ -272,6 +272,10 @@ class _Replica(object):
                 'pid': self.proc.pid if self.proc else None,
                 'tier': self.hello.get('tier', self.spec.get('tier')
                                        or 'bf16'),
+                # decode artifacts: cache layout + mesh tag the worker
+                # actually loaded (ISSUE 13 block/sharded tiers)
+                'layout': self.hello.get('layout'),
+                'mesh': self.hello.get('mesh'),
                 'outstanding': len(self.outstanding),
                 'pending': len(self.pending),
                 'hb_age_s': (round(self.hb_age, 3)

@@ -21,7 +21,11 @@ inflight, default_max_new.
 Frames handled: infer / decode (per-request), drain (predictor drain()
 hook: stop admitting, finish in-flight, shed the queue re-routably),
 stop. Replies: result (ok or etype/error/requeue), tok (greedy decode
-streaming), drained, bye.
+streaming), drained, bye. The hello frame carries the artifact tier the
+endpoint ACTUALLY serves plus — for decode artifacts — the cache layout
+('slot' or 'block') and mesh tag ('cpu_mp2', None unsharded), so
+block-paged and mp-sharded decode tiers (ISSUE 13) route through the
+same protocol with the router able to audit what each replica loaded.
 """
 import json
 import os
@@ -64,11 +68,21 @@ def _is_requeueable(exc, draining):
     — the router can safely re-route them; a draining/closed refusal
     raised by submit() itself is the same no-work case. Errors from a
     request that already DISPATCHED (delivery callbacks, stream pumps)
-    must use isinstance(exc, ServerOverloaded) directly — a mid-
-    execution RuntimeError may have cost device work and the fleet
-    contract forbids blind retries of those."""
+    must use _stream_requeueable instead — a mid-execution error may
+    have cost device work and the fleet contract forbids blind retries
+    of those."""
     return isinstance(exc, _batching.ServerOverloaded) or (
         draining and isinstance(exc, RuntimeError))
+
+
+def _stream_requeueable(exc):
+    """POST-DISPATCH (stream pump / delivery callback) re-route
+    decision: only a shed that provably cost no device work may
+    re-route. MidStreamEvicted is a ServerOverloaded whose victim
+    already streamed tokens — re-routing would replay them to the
+    client and blindly retry device work."""
+    return (isinstance(exc, _batching.ServerOverloaded)
+            and not isinstance(exc, _decoding.MidStreamEvicted))
 
 
 class _BatchingEndpoint(object):
@@ -108,9 +122,7 @@ class _BatchingEndpoint(object):
             if exc is not None:
                 # post-submit resolution: only a genuine shed (never
                 # dispatched) is safe to re-route
-                conn.reply_err(req_id, exc,
-                               isinstance(exc,
-                                          _batching.ServerOverloaded))
+                conn.reply_err(req_id, exc, _stream_requeueable(exc))
                 return
             outs = fut.result()
             conn.send({'op': 'result', 'id': req_id, 'ok': True,
@@ -150,6 +162,12 @@ class _DecodingEndpoint(object):
         if opts.get('warmup', True):
             self.pred.warmup()
         self.tier = self.pred.stats.tier
+        # ISSUE 13: block-paged and mp-sharded decode artifacts load
+        # through the same endpoint (DecodingPredictor reads the layout
+        # and mesh from the signature); surface both so the router and
+        # fleet_ctl can audit which tier a replica actually serves
+        self.layout = self.pred.layout
+        self.mesh = self.pred.mesh_tag
         self.draining = False
 
     def submit(self, hdr, arrays, conn):
@@ -177,8 +195,7 @@ class _DecodingEndpoint(object):
         except Exception as e:
             # stream-side failure: the request may have decoded tokens
             # already — only a genuine shed re-routes
-            conn.reply_err(req_id, e,
-                           isinstance(e, _batching.ServerOverloaded))
+            conn.reply_err(req_id, e, _stream_requeueable(e))
             return
         if stream.beam is None:
             conn.send({'op': 'result', 'id': req_id, 'ok': True,
@@ -292,8 +309,7 @@ class _CompiledEndpoint(object):
                 if key:
                     self._stats[key] += 1
             # the run may have dispatched: only sheds re-route
-            conn.reply_err(req_id, e,
-                           isinstance(e, _batching.ServerOverloaded))
+            conn.reply_err(req_id, e, _stream_requeueable(e))
             return
         with self._lock:
             self._stats['requests'] += 1
@@ -391,6 +407,8 @@ def main():
     conn = _Conn(sock)
     conn.send({'op': 'hello', 'replica': rid, 'pid': os.getpid(),
                'kind': kind, 'tier': endpoint.tier,
+               'layout': getattr(endpoint, 'layout', None),
+               'mesh': getattr(endpoint, 'mesh', None),
                'compiles': compiles[0],
                'framework_free': 'paddle_tpu' not in sys.modules})
 

@@ -1663,6 +1663,167 @@ def kv_cache_attention_quant(query, k_cache, k_scale, v_cache, v_scale,
     return out
 
 
+def sharding_hint(x, spec=()):
+    """Constrain `x` to a GSPMD partition spec (mesh axis name per dim,
+    None/'' to replicate a dim; empty spec = fully replicated) on the
+    trace-time mesh. Identity when traced without a mesh — programs
+    carrying hints stay valid single-chip programs. The mp-sharded
+    decode spec places replicate hints at contraction boundaries so
+    every reduction stays full-width (bit-identity with the single-chip
+    artifact; ops/decode_ops.py sharding_hint)."""
+    helper = LayerHelper('sharding_hint')
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='sharding_hint', inputs={'X': x},
+                     outputs={'Out': out},
+                     attrs={'spec': [a or '' for a in spec]},
+                     infer_shape=False)
+    out.shape = x.shape
+    out.stop_gradient = x.stop_gradient
+    return out
+
+
+def kv_block_write(cache, kv, pos, block_table):
+    """Block-paged continuous-decode primitive (ISSUE 13): write this
+    step's K or V rows [max_slots, d] into the BLOCK pool `cache`
+    [num_blocks, block_size, d] through each slot's row of
+    `block_table` [max_slots, max_blocks] int32 at position `pos`.
+    In-place on `cache` (output aliases the input var); returns it so
+    downstream kv_block_attention reads the post-write binding."""
+    helper = LayerHelper('kv_block_write')
+    helper.append_op(type='kv_block_write',
+                     inputs={'Cache': cache, 'KV': kv, 'Pos': pos,
+                             'BlockTable': block_table},
+                     outputs={'Out': cache}, attrs={})
+    return cache
+
+
+def kv_block_attention(query, k_cache, v_cache, pos, block_table,
+                       n_head, scale=None):
+    """One-token-per-slot attention over the block-paged cache: `query`
+    [max_slots, d] attends its own slot's logically-ordered block view
+    (rows j <= pos) gathered through `block_table`. Masked rows get
+    exactly-zero weight — foreign blocks and trash-block garbage can
+    never perturb an active slot (the block form of the continuous-
+    batching bit-identity contract)."""
+    helper = LayerHelper('kv_block_attention')
+    out = helper.create_variable_for_type_inference(query.dtype)
+    helper.append_op(type='kv_block_attention',
+                     inputs={'Q': query, 'KCache': k_cache,
+                             'VCache': v_cache, 'Pos': pos,
+                             'BlockTable': block_table},
+                     outputs={'Out': out},
+                     attrs={'n_head': int(n_head),
+                            'scale': float(scale or 0.0)})
+    out.stop_gradient = True
+    return out
+
+
+def kv_block_chunk_write(cache, kv, start, block_table):
+    """Chunked-prefill write (ISSUE 13): one chunk's K or V rows
+    [1, chunk, d] for absolute positions start..start+chunk-1 of ONE
+    slot scatter into the block pool through the slot's `block_table`
+    row [1, max_blocks]. In-place on `cache`."""
+    helper = LayerHelper('kv_block_chunk_write')
+    helper.append_op(type='kv_block_chunk_write',
+                     inputs={'Cache': cache, 'KV': kv, 'Start': start,
+                             'BlockTable': block_table},
+                     outputs={'Out': cache}, attrs={})
+    return cache
+
+
+def kv_block_chunk_attention(query, k_cache, v_cache, start, block_table,
+                             n_head, scale=None):
+    """Chunked-prefill attention: chunk row i ([1, chunk, d] `query`)
+    attends the slot's block view rows j <= start + i — causal within
+    the chunk AND over every previously written position (earlier
+    chunks, shared prefix blocks), which is what lets a prefix-cache
+    hit skip recomputing the shared span."""
+    helper = LayerHelper('kv_block_chunk_attention')
+    out = helper.create_variable_for_type_inference(query.dtype)
+    helper.append_op(type='kv_block_chunk_attention',
+                     inputs={'Q': query, 'KCache': k_cache,
+                             'VCache': v_cache, 'Start': start,
+                             'BlockTable': block_table},
+                     outputs={'Out': out},
+                     attrs={'n_head': int(n_head),
+                            'scale': float(scale or 0.0)})
+    out.stop_gradient = True
+    return out
+
+
+def kv_block_write_quant(cache, cache_scale, kv, pos, block_table):
+    """kv_block_write over the INT8 block pool (block paging composed
+    with the ISSUE 11 quantized cache): int8 pages [num_blocks,
+    block_size, d] + one f32 scale per page position in `cache_scale`
+    [num_blocks, block_size]. In-place on the pair; returns both
+    post-write bindings."""
+    helper = LayerHelper('kv_block_write_quant')
+    helper.append_op(type='kv_block_write_quant',
+                     inputs={'Cache': cache, 'Scale': cache_scale,
+                             'KV': kv, 'Pos': pos,
+                             'BlockTable': block_table},
+                     outputs={'Out': cache, 'OutScale': cache_scale},
+                     attrs={})
+    return cache, cache_scale
+
+
+def kv_block_attention_quant(query, k_cache, k_scale, v_cache, v_scale,
+                             pos, block_table, n_head, scale=None):
+    """kv_block_attention over the INT8 block pool: per-slot views
+    dequantize (int8 page x its scale) inside the body — no f32 cache
+    copy materializes."""
+    helper = LayerHelper('kv_block_attention_quant')
+    out = helper.create_variable_for_type_inference(query.dtype)
+    helper.append_op(type='kv_block_attention_quant',
+                     inputs={'Q': query, 'KCache': k_cache,
+                             'KScale': k_scale, 'VCache': v_cache,
+                             'VScale': v_scale, 'Pos': pos,
+                             'BlockTable': block_table},
+                     outputs={'Out': out},
+                     attrs={'n_head': int(n_head),
+                            'scale': float(scale or 0.0)})
+    out.stop_gradient = True
+    return out
+
+
+def kv_block_chunk_write_quant(cache, cache_scale, kv, start, block_table):
+    """kv_block_chunk_write over the INT8 block pool: chunk rows
+    quantize per page position and scatter through the slot's table.
+    In-place on the (cache, scale) pair."""
+    helper = LayerHelper('kv_block_chunk_write_quant')
+    helper.append_op(type='kv_block_chunk_write_quant',
+                     inputs={'Cache': cache, 'Scale': cache_scale,
+                             'KV': kv, 'Start': start,
+                             'BlockTable': block_table},
+                     outputs={'Out': cache, 'OutScale': cache_scale},
+                     attrs={})
+    return cache, cache_scale
+
+
+def kv_block_chunk_attention_quant(query, k_cache, k_scale, v_cache,
+                                   v_scale, k, v, start, block_table,
+                                   n_head, scale=None):
+    """kv_block_chunk_attention over the INT8 block pool. `k`/`v` are
+    the CURRENT chunk's fresh f32 projections (the arrays the write op
+    quantized): they splice over the view's in-chunk span so the chunk
+    attends itself at full precision — the slot tier's int8 prefill
+    semantics, bit-identical for single-chunk prompts. Earlier chunks
+    and shared prefix blocks dequantize from their int8 pages."""
+    helper = LayerHelper('kv_block_chunk_attention_quant')
+    out = helper.create_variable_for_type_inference(query.dtype)
+    helper.append_op(type='kv_block_chunk_attention_quant',
+                     inputs={'Q': query, 'KCache': k_cache,
+                             'KScale': k_scale, 'VCache': v_cache,
+                             'VScale': v_scale, 'K': k, 'V': v,
+                             'Start': start,
+                             'BlockTable': block_table},
+                     outputs={'Out': out},
+                     attrs={'n_head': int(n_head),
+                            'scale': float(scale or 0.0)})
+    out.stop_gradient = True
+    return out
+
+
 def fused_multihead_attention(q, k, v, causal=False, scale=1.0,
                               sequence_parallel=False, name=None):
     """Fused [B, H, S, D] attention: Pallas flash attention on TPU where

@@ -581,6 +581,278 @@ def _kv_cache_attention_quant(ctx, ins):
 
 
 # ---------------------------------------------------------------------------
+# Block-paged KV cache (ISSUE 13): the cache is a pool of fixed-size
+# blocks [num_blocks, block_size, D] addressed through per-slot BLOCK
+# TABLES (int32 [*, max_blocks]): logical position p of a slot lives at
+# cache[table[p // bs], p % bs]. Tables are host state the scheduler
+# feeds every dispatch (inference/kv_blocks.py owns the refcounts), so
+# beam reorder is a table permutation + copy-on-write of the partial
+# tail block instead of a whole-slot-row gather, and requests with a
+# common prompt prefix SHARE the prefix's blocks. Physical block 0 is
+# the reserved trash block: idle/padded rows scatter there and no table
+# maps it into an attention window, so its (possibly write-racy, but
+# never read) bits cannot perturb any active slot — the same masked-
+# idle-slot determinism contract as the slot-paged ops above.
+# ---------------------------------------------------------------------------
+
+def _block_view(cache, table_row):
+    """Gather one slot's logically-ordered cache view from the block
+    pool: cache [NB, BS, D(+)], table_row [MAXB] int32 ->
+    [MAXB * BS, D(+)] (logical row j = position j)."""
+    v = jnp.take(cache, table_row, axis=0)       # [MAXB, BS, ...]
+    return v.reshape((-1,) + v.shape[2:])
+
+
+def _block_scatter_idx(table, pos, bs):
+    """(physical block, in-block offset) per row: table [R, MAXB], pos
+    [R] int32 -> (bidx [R], boff [R]). Rows whose table entry is the
+    trash block land at (0, off) — never read. Rows whose position
+    overflows the table's logical span (chunked-prefill pad rows past
+    max_cache_len) are forced to the trash block too: gather clamping
+    would otherwise resolve them to the LAST table column, a real
+    block when the table is full."""
+    pos = pos.astype(jnp.int32)
+    lblk = pos // bs
+    boff = pos % bs
+    bidx = jnp.take_along_axis(table.astype(jnp.int32),
+                               lblk[:, None], axis=1)[:, 0]
+    bidx = jnp.where(lblk < table.shape[1], bidx, 0)
+    return bidx, boff
+
+
+@register('sharding_hint', no_grad=True, lod='none')
+def _sharding_hint(ctx, ins):
+    """GSPMD placement hint: constrain X to the partition spec named by
+    attr 'spec' (mesh axis name per dim, '' = replicate that dim; empty
+    spec = fully replicated) on the CURRENT TRACE MESH
+    (parallel/mesh.trace_mesh_scope — the round-13 pinning machinery).
+    Identity when no mesh is in scope, so hinted programs lower
+    unchanged on a single chip. The mp-sharded decode programs use
+    replicate hints at contraction boundaries: gathering a sharded
+    activation BEFORE a matmul contracts over it keeps every reduction
+    full-width, which is what makes the sharded transcripts bit-
+    identical to the single-chip artifact (partial-sum all-reduces
+    reorder the accumulation; all-gathers do not)."""
+    x = ins['X'][0]
+    from ..parallel.mesh import current_trace_mesh
+    mesh = current_trace_mesh()
+    if mesh is None:
+        return {'Out': [x]}
+    from jax.sharding import NamedSharding, PartitionSpec
+    spec = tuple((a or None) for a in (ctx.attr('spec', ()) or ()))
+    unknown = [a for a in spec if a is not None and a not in mesh.shape]
+    if unknown:
+        # a silently ignored hint would let GSPMD shard straight through
+        # a contraction boundary — partial-sum all-reduces reorder the
+        # accumulation and the transcripts drift from single-chip.
+        # Fail the trace (= the export) instead.
+        raise ValueError(
+            'sharding_hint spec %r names axes %r absent from the trace '
+            'mesh %r' % (spec, unknown, dict(mesh.shape)))
+    return {'Out': [jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, PartitionSpec(*spec)))]}
+
+
+@register('kv_block_write', no_grad=True, lod='none')
+def _kv_block_write(ctx, ins):
+    """Write one decode step's K or V row per slot into the BLOCK pool:
+    Cache [NB, BS, D], KV [S, D], Pos [S] int32, BlockTable [S, MAXB]
+    int32. Each slot's row scatters to (table[pos // BS], pos % BS);
+    the scheduler guarantees write blocks are uniquely owned (CoW), so
+    real scatter indices never collide; idle slots scatter identical
+    rows into the trash block. Out aliases Cache (in-place on the
+    persistable pool)."""
+    cache = ins['Cache'][0]
+    kv = ins['KV'][0]
+    pos = ins['Pos'][0].reshape(-1)
+    table = ins['BlockTable'][0]
+    bidx, boff = _block_scatter_idx(table, pos, cache.shape[1])
+    return {'Out': [cache.at[bidx, boff].set(kv.astype(cache.dtype))]}
+
+
+@register('kv_block_attention', no_grad=True, lod='none')
+def _kv_block_attention(ctx, ins):
+    """kv_cache_attention over the block pool: Q [S, D], KCache/VCache
+    [NB, BS, D], Pos [S] int32, BlockTable [S, MAXB] int32. Each slot
+    attends its own table's logical view rows j <= pos; masked rows get
+    exactly-zero weight, so foreign blocks and trash garbage can never
+    perturb an active slot (the fp body is the slot-paged op's, so a
+    slot's output is bit-identical however its history is paged)."""
+    q = ins['Q'][0]
+    kc = ins['KCache'][0]
+    vc = ins['VCache'][0]
+    pos = ins['Pos'][0].reshape(-1).astype(jnp.int32)
+    table = ins['BlockTable'][0].astype(jnp.int32)
+    kv_view = jax.vmap(lambda r: _block_view(kc, r))(table)  # [S, T', D]
+    vv_view = jax.vmap(lambda r: _block_view(vc, r))(table)
+    return {'Out': [_paged_attention_body(ctx, q, kv_view, vv_view, pos)]}
+
+
+def _chunk_attention_body(ctx, q, kview, vview, start, d):
+    """Chunked-prefill attention for ONE slot: q [1, C, D] (chunk rows at
+    absolute positions start + i), kview/vview [T', D] the slot's
+    logical cache view. Row i attends j <= start + i — causal within
+    the chunk AND over every previously written position (earlier
+    chunks, shared prefix blocks). Heads inside; exactly-zero masked
+    weights (the step op's contract)."""
+    n_head = int(ctx.attr('n_head', 1))
+    c = q.shape[1]
+    t = kview.shape[0]
+    dh = d // n_head
+    scale = float(ctx.attr('scale', 0.0) or 0.0) or dh ** -0.5
+    qh = q.reshape(c, n_head, dh)
+    kh = kview.reshape(t, n_head, dh)
+    vh = vview.reshape(t, n_head, dh)
+    scores = jnp.einsum('chd,thd->cht', qh, kh) * scale
+    start = start.reshape(()).astype(jnp.int32)
+    valid = (jnp.arange(t, dtype=jnp.int32)[None, :]
+             <= start + jnp.arange(c, dtype=jnp.int32)[:, None])
+    scores = jnp.where(valid[:, None, :], scores, -jnp.inf)
+    w = jax.nn.softmax(scores, axis=-1)
+    ctxv = jnp.einsum('cht,thd->chd', w, vh)
+    return ctxv.reshape(1, c, d).astype(q.dtype)
+
+
+@register('kv_block_chunk_write', no_grad=True, lod='none')
+def _kv_block_chunk_write(ctx, ins):
+    """Chunked-prefill write: KV [1, C, D] rows for chunk positions
+    start..start+C-1 of ONE slot scatter into the block pool through
+    the slot's table (Cache [NB, BS, D], Start [1, 1] int32, BlockTable
+    [1, MAXB] int32). Rows beyond the chunk's true length carry pad
+    garbage into the slot's own tail block (or the trash block past the
+    allocated span) — never attended before a decode step overwrites
+    them, the prefill contract in block form. Out aliases Cache."""
+    cache = ins['Cache'][0]
+    kv = ins['KV'][0]
+    start = ins['Start'][0].reshape(()).astype(jnp.int32)
+    table = ins['BlockTable'][0]
+    c = kv.shape[1]
+    pos = start + jnp.arange(c, dtype=jnp.int32)
+    bidx, boff = _block_scatter_idx(
+        jnp.broadcast_to(table[0], (c, table.shape[1])), pos,
+        cache.shape[1])
+    return {'Out': [cache.at[bidx, boff].set(
+        kv[0].astype(cache.dtype))]}
+
+
+@register('kv_block_chunk_attention', no_grad=True, lod='none')
+def _kv_block_chunk_attention(ctx, ins):
+    """Chunked-prefill attention: Q [1, C, D] chunk rows of one slot
+    attend the slot's logical view (KCache/VCache [NB, BS, D] through
+    BlockTable [1, MAXB]) rows j <= Start + i — causal in the chunk and
+    across everything already written (earlier chunks, SHARED prefix
+    blocks, which is what lets a prefix hit skip recompute)."""
+    q = ins['Q'][0]
+    kc = ins['KCache'][0]
+    vc = ins['VCache'][0]
+    start = ins['Start'][0]
+    table = ins['BlockTable'][0].astype(jnp.int32)[0]
+    kview = _block_view(kc, table)
+    vview = _block_view(vc, table)
+    return {'Out': [_chunk_attention_body(ctx, q, kview, vview, start,
+                                          kc.shape[2])]}
+
+
+@register('kv_block_write_quant', no_grad=True, lod='none')
+def _kv_block_write_quant(ctx, ins):
+    """kv_block_write over the int8 block pool (composes ISSUE 11's
+    quantized cache with block paging): Cache int8 [NB, BS, D], Scale
+    f32 [NB, BS], KV f32 [S, D]. Rows quantize at their own abs-max
+    page scale at write time; Out/OutScale alias Cache/Scale."""
+    cache = ins['Cache'][0]
+    cscale = ins['Scale'][0]
+    kv = ins['KV'][0]
+    pos = ins['Pos'][0].reshape(-1)
+    table = ins['BlockTable'][0]
+    q, s = _quantize_kv_rows(kv.astype(jnp.float32))
+    bidx, boff = _block_scatter_idx(table, pos, cache.shape[1])
+    return {'Out': [cache.at[bidx, boff].set(q)],
+            'OutScale': [cscale.at[bidx, boff].set(s)]}
+
+
+@register('kv_block_attention_quant', no_grad=True, lod='none')
+def _kv_block_attention_quant(ctx, ins):
+    """kv_block_attention over the int8 block pool: per-slot views
+    dequantize (int8 page x its f32 scale) inside the body, then the
+    exact fp masked-attention expression runs."""
+    q = ins['Q'][0]
+    kc = ins['KCache'][0]
+    ks = ins['KScale'][0]
+    vc = ins['VCache'][0]
+    vs = ins['VScale'][0]
+    pos = ins['Pos'][0].reshape(-1).astype(jnp.int32)
+    table = ins['BlockTable'][0].astype(jnp.int32)
+
+    def view(cache, scale, r):
+        return (_block_view(cache, r).astype(jnp.float32)
+                * _block_view(scale, r)[:, None])
+
+    kview = jax.vmap(lambda r: view(kc, ks, r))(table)
+    vview = jax.vmap(lambda r: view(vc, vs, r))(table)
+    return {'Out': [_paged_attention_body(ctx, q, kview, vview, pos)]}
+
+
+@register('kv_block_chunk_write_quant', no_grad=True, lod='none')
+def _kv_block_chunk_write_quant(ctx, ins):
+    """kv_block_chunk_write over the int8 block pool: chunk rows
+    quantize per position (per block page) and scatter through the
+    slot's table."""
+    cache = ins['Cache'][0]
+    cscale = ins['Scale'][0]
+    kv = ins['KV'][0]
+    start = ins['Start'][0].reshape(()).astype(jnp.int32)
+    table = ins['BlockTable'][0]
+    c = kv.shape[1]
+    q, s = _quantize_kv_rows(kv[0].astype(jnp.float32))  # [C, D], [C]
+    pos = start + jnp.arange(c, dtype=jnp.int32)
+    bidx, boff = _block_scatter_idx(
+        jnp.broadcast_to(table[0], (c, table.shape[1])), pos,
+        cache.shape[1])
+    return {'Out': [cache.at[bidx, boff].set(q)],
+            'OutScale': [cscale.at[bidx, boff].set(s)]}
+
+
+@register('kv_block_chunk_attention_quant', no_grad=True, lod='none')
+def _kv_block_chunk_attention_quant(ctx, ins):
+    """kv_block_chunk_attention over the int8 block pool. The CURRENT
+    chunk's rows attend at FULL precision: K/V carry the fresh f32
+    projections ([1, C, D], the same arrays the write op quantized) and
+    splice over the view's span [start, start + C) — the slot tier's
+    int8 prefill semantics (attend fresh f32, store int8), so a
+    single-chunk prompt is bit-identical to the slot tier. Earlier
+    chunks and shared prefix blocks exist only as int8 pages and
+    dequantize — the unavoidable (and vLLM-standard) chunked-prefill
+    quantization boundary."""
+    q = ins['Q'][0]
+    kc = ins['KCache'][0]
+    ks = ins['KScale'][0]
+    vc = ins['VCache'][0]
+    vs = ins['VScale'][0]
+    k_f = ins['K'][0]
+    v_f = ins['V'][0]
+    start = ins['Start'][0].reshape(()).astype(jnp.int32)
+    table = ins['BlockTable'][0].astype(jnp.int32)[0]
+
+    def spliced(cache, scale, fresh):
+        view = (_block_view(cache, table).astype(jnp.float32)
+                * _block_view(scale, table)[:, None])
+        t, c = view.shape[0], fresh.shape[1]
+        j = jnp.arange(t, dtype=jnp.int32)
+        # gather (clipped: out-of-span rows are masked off below, and
+        # clipping keeps every index in-bounds even for the final padded
+        # chunk near the cache end)
+        rel = jnp.clip(j - start, 0, c - 1)
+        in_chunk = (j >= start) & (j < start + c)
+        return jnp.where(in_chunk[:, None],
+                         fresh[0][rel].astype(jnp.float32), view)
+
+    kview = spliced(kc, ks, k_f)
+    vview = spliced(vc, vs, v_f)
+    return {'Out': [_chunk_attention_body(ctx, q, kview, vview, start,
+                                          kc.shape[2])]}
+
+
+# ---------------------------------------------------------------------------
 # beam search (fixed-width; see module docstring)
 # ---------------------------------------------------------------------------
 

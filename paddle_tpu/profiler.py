@@ -145,7 +145,11 @@ def serving_report():
     with kind='decode': inference/decoding.DecodingPredictor) render in
     their own table — tokens/s, slot occupancy, prefill/decode dispatch
     split, TTFT and inter-token latency percentiles — next to the
-    request-batching table."""
+    request-batching table. Block-paged sources (ISSUE 13: snapshots
+    carrying blocks_in_use) grow block-cache columns: blocks in use /
+    total, prefix-share hit rate, copy-on-write block copies, and
+    chunked-prefill slices — the capacity-vs-sharing picture per
+    replica."""
     out = {}
     rows = []
     decode_rows = []
@@ -176,22 +180,40 @@ def serving_report():
                    s.get('expired', 0), s.get('p50_ms', 0.0),
                    s.get('p95_ms', 0.0), s.get('p99_ms', 0.0)))
     if decode_rows:
-        print("%-26s %5s %5s %6s %7s %8s %8s %6s %5s %5s %10s %10s %9s "
-              "%9s" %
-              ('Decode source', 'tier', 'queue', 'reqs', 'tokens',
-               'tok/s', 'prefills', 'steps', 'occ', 'shed',
-               'ttftp50(ms)', 'ttftp99(ms)', 'itlp50(ms)', 'itlp99(ms)'))
+        # block-cache columns render only when some source serves the
+        # block-paged layout; slot-layout-only fleets keep the old width
+        blocks = any('blocks_in_use' in s for _, s in decode_rows)
+        hdr = ("%-26s %5s %5s %6s %7s %8s %8s %6s %5s %5s %10s %10s %9s "
+               "%9s" %
+               ('Decode source', 'tier', 'queue', 'reqs', 'tokens',
+                'tok/s', 'prefills', 'steps', 'occ', 'shed',
+                'ttftp50(ms)', 'ttftp99(ms)', 'itlp50(ms)', 'itlp99(ms)'))
+        if blocks:
+            hdr += " %11s %6s %6s %6s" % ('blocks', 'pfxhit', 'cow',
+                                          'slices')
+        print(hdr)
         for name, s in decode_rows:
-            print("%-26s %5s %5d %6d %7d %8.1f %8d %6d %5.2f %5d %10.2f "
-                  "%10.2f %9.2f %9.2f" %
-                  (name[:26], s.get('tier', 'bf16'),
-                   s.get('queue_depth', 0),
-                   s.get('requests', 0), s.get('tokens', 0),
-                   s.get('tokens_s', 0.0), s.get('prefills', 0),
-                   s.get('steps', 0), s.get('occupancy', 0.0),
-                   s.get('shed', 0) + s.get('expired', 0),
-                   s.get('ttft_p50_ms', 0.0), s.get('ttft_p99_ms', 0.0),
-                   s.get('itl_p50_ms', 0.0), s.get('itl_p99_ms', 0.0)))
+            row = ("%-26s %5s %5d %6d %7d %8.1f %8d %6d %5.2f %5d %10.2f "
+                   "%10.2f %9.2f %9.2f" %
+                   (name[:26], s.get('tier', 'bf16'),
+                    s.get('queue_depth', 0),
+                    s.get('requests', 0), s.get('tokens', 0),
+                    s.get('tokens_s', 0.0), s.get('prefills', 0),
+                    s.get('steps', 0), s.get('occupancy', 0.0),
+                    s.get('shed', 0) + s.get('expired', 0),
+                    s.get('ttft_p50_ms', 0.0), s.get('ttft_p99_ms', 0.0),
+                    s.get('itl_p50_ms', 0.0), s.get('itl_p99_ms', 0.0)))
+            if blocks:
+                if 'blocks_in_use' in s:
+                    row += " %11s %6.2f %6d %6d" % (
+                        '%d/%d' % (s['blocks_in_use'],
+                                   s.get('blocks_total', 0)),
+                        s.get('prefix_hit_rate', 0.0),
+                        s.get('cow_blocks', 0),
+                        s.get('chunk_slices', 0))
+                else:
+                    row += " %11s %6s %6s %6s" % ('-', '-', '-', '-')
+            print(row)
     return out
 
 

@@ -56,7 +56,7 @@ PTPU_PLATFORM=cpu python bench.py
 echo "== serving bench smoke (serve.py bench on a tiny artifact) =="
 python scripts/serve_bench_smoke.py
 
-echo "== decode serving smoke (continuous in-flight batching: Poisson A/B >=3x tokens/s vs sequential decode, bit-identical transcripts, 0-compile warm replica) =="
+echo "== decode serving smoke (continuous in-flight batching: Poisson A/B >=3x tokens/s vs sequential decode, bit-identical transcripts, 0-compile warm replica; block tier: prefix-share A/B >=1.5x effective capacity at fixed cache HBM, beam reorder >=10x fewer dispatch bytes block-level, chunked prefill >=2x below the monolithic-prefill stall) =="
 JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/decode_serve_smoke.py
 
 echo "== quantized serving smoke (int8 tier: calibrate -> export both tiers, top-1 parity, 0-compile warm int8 replica, >=1.3x fixed-cache-HBM decode throughput via 2x max_slots) =="

@@ -91,6 +91,25 @@ def decode_art(tmp_path_factory):
     return art
 
 
+@pytest.fixture(scope='module')
+def block_art(tmp_path_factory):
+    """Block-paged decode artifact (ISSUE 13): same model as decode_art
+    but with the cache as a block pool + chunked prefill."""
+    tmp = str(tmp_path_factory.mktemp('fleet_block'))
+    art = os.path.join(tmp, 'block')
+    from models.transformer import build_decode_spec
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope), fluid.unique_name.guard():
+        spec = build_decode_spec(vocab=VOCAB, d_model=8, n_head=2,
+                                 n_layer=1, d_ff=16, max_slots=4,
+                                 max_cache_len=40, prompt_buckets=(4,),
+                                 eos_id=1, block_size=4)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(spec['startup'])
+        export_decode(spec, art, scope=scope)
+    return art
+
+
 def _x(seed, rows=8):
     return np.random.RandomState(100 + seed).randn(
         rows, DIM).astype(np.float32)
@@ -423,6 +442,63 @@ def test_fleet_chaos_sigkill_loses_only_victim_inflight(decode_art):
             # survivors keep serving
             assert router.run(prompts[0], max_new_tokens=24,
                               timeout=300) == want[0]
+
+
+def test_mid_stream_eviction_is_not_requeueable():
+    """ISSUE 13: a block-pool eviction of an IN-FLIGHT stream raises
+    MidStreamEvicted — still a ServerOverloaded for local callers, but
+    the worker's post-dispatch re-route decision must refuse it: tokens
+    may already have streamed, so a re-route would replay them on
+    another replica and blindly retry device work. Door sheds (base
+    ServerOverloaded) stay re-routable."""
+    import paddle_tpu.inference.fleet_worker as fw
+    door = fw._batching.ServerOverloaded('queue full')
+    mid = fw._decoding.MidStreamEvicted('evicted mid-decode')
+    assert isinstance(mid, fw._batching.ServerOverloaded)
+    assert fw._stream_requeueable(door)
+    assert not fw._stream_requeueable(mid)
+    assert not fw._stream_requeueable(RuntimeError('dispatch failed'))
+
+
+def test_fleet_block_paged_artifact_unchanged_protocol(block_art):
+    """ISSUE 13: a block-paged decode artifact routes through
+    FleetRouter/fleet_worker UNCHANGED — detect_kind sees the decode
+    signature, the worker's DecodingPredictor reads the layout, and
+    transcripts stay bit-identical to a direct in-process serve. The
+    hello frame surfaces layout='block' so fleet_ctl can audit the
+    tier, and replica heartbeats carry the block-cache gauges."""
+    prompts = _prompts(12, seed=21)
+    with DecodingPredictor(block_art, platform='cpu') as ref:
+        assert ref.layout == 'block' and ref.mesh_tag is None
+        want = [ref.generate(p, max_new_tokens=12) for p in prompts]
+        want_beam = ref.generate(prompts[0], max_new_tokens=8, beam=3)
+    with warnings.catch_warnings():
+        warnings.simplefilter('ignore')
+        with _patient(FleetRouter(block_art, replicas=2,
+                                  platform='cpu')) as router:
+            assert router.kind == 'decoding'
+            futs = [router.submit(p, max_new_tokens=12)
+                    for p in prompts]
+            got = [f.result(300) for f in futs]
+            assert got == want
+            ids, scores = router.run(prompts[0], max_new_tokens=8,
+                                     beam=3, timeout=300)
+            np.testing.assert_array_equal(ids, want_beam[0])
+            np.testing.assert_array_equal(scores, want_beam[1])
+            st = router.status()
+            for s in st['replicas'].values():
+                assert s['layout'] == 'block'
+                assert s['mesh'] is None
+            # worker heartbeats surface the block-cache gauges
+            # (serving_report's columns work fleet-wide)
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                stats = [s.get('stats', {})
+                         for s in router.status()['replicas'].values()]
+                if any('blocks_in_use' in x for x in stats):
+                    break
+                time.sleep(0.2)
+            assert any('blocks_in_use' in x for x in stats)
 
 
 def test_fleet_hung_replica_sigstop_watchdog(decode_art):

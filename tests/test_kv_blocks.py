@@ -1,0 +1,502 @@
+"""Block-granular KV-cache management (ISSUE 13): BlockManager edge
+cases — refcount-to-zero frees, copy-on-write ownership, prefix-hash
+collision safety, LRU eviction under pressure — plus the served block
+tier: bit-identity with the slot layout (greedy, beam, chunked prefill,
+int8 pages), CoW under beam divergence at block boundaries, and prefix
+sharing's capacity effect."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.inference import DecodingPredictor, export_decode
+from paddle_tpu.inference.kv_blocks import (BlockManager,
+                                            BlockPoolExhausted,
+                                            TRASH_BLOCK)
+
+VOCAB, SLOTS, CACHE = 41, 4, 64
+
+
+# -- allocator units ---------------------------------------------------------
+
+def test_capacity_excludes_trash_block():
+    m = BlockManager(num_blocks=8, block_size=4)
+    assert m.capacity() == 7
+    assert m.free_blocks() == 7
+    got = m.alloc(7)
+    assert TRASH_BLOCK not in got
+    assert sorted(got) == list(range(1, 8))
+    with pytest.raises(ValueError):
+        BlockManager(num_blocks=1, block_size=4)
+
+
+def test_refcount_to_zero_frees():
+    m = BlockManager(num_blocks=6, block_size=2)
+    blocks = m.alloc(3)
+    m.incref(blocks)                      # share (beam fork)
+    m.decref(blocks)
+    assert m.free_blocks() == 2           # still referenced once
+    assert m.in_use() == 3
+    m.decref(blocks)                      # refcount-to-zero
+    assert m.free_blocks() == 5
+    assert m.in_use() == 0
+    st = m.stats()
+    assert st['allocs'] == 3 and st['frees'] == 3
+    # freed blocks are allocatable again
+    assert sorted(m.alloc(5)) == sorted(range(1, 6))
+
+
+def test_double_free_and_foreign_incref_raise():
+    m = BlockManager(num_blocks=4, block_size=2)
+    b = m.alloc(1)
+    m.decref(b)
+    with pytest.raises(RuntimeError, match='double free'):
+        m.decref(b)
+    with pytest.raises(RuntimeError, match='unallocated'):
+        m.incref(b)
+    # trash block refs are ignored, never counted
+    m.incref([TRASH_BLOCK])
+    m.decref([TRASH_BLOCK])
+    assert m.refcount(TRASH_BLOCK) == 0
+
+
+def test_writable_is_sole_ownership():
+    m = BlockManager(num_blocks=4, block_size=2)
+    b = m.alloc(1)[0]
+    assert m.writable(b)
+    m.incref([b])                         # shared: fork / prefix hit
+    assert not m.writable(b)              # must copy-on-write
+    m.decref([b])
+    assert m.writable(b)
+    assert not m.writable(TRASH_BLOCK)    # trash is never writable
+
+
+def test_alloc_all_or_nothing_when_pinned():
+    m = BlockManager(num_blocks=4, block_size=2)
+    m.alloc(2)
+    with pytest.raises(BlockPoolExhausted):
+        m.alloc(2)                        # only 1 free, nothing evictable
+    assert m.free_blocks() == 1           # failed alloc leaked nothing
+    assert m.alloc(1)
+
+
+def test_prefix_register_match_and_refcounts():
+    m = BlockManager(num_blocks=16, block_size=4)
+    tokens = list(range(100, 111))        # 11 tokens = 2 full blocks + 3
+    blocks = m.alloc(3)
+    m.register_prefix(tokens, blocks)     # publishes 1- and 2-block entries
+    assert m.prefix_entries() == 2
+    # full prompt released: prefix refs keep the FULL blocks alive
+    m.decref(blocks)
+    assert m.in_use() == 2                # tail block freed, 2 pinned
+    shared, covered = m.match_prefix(tokens)
+    assert covered == 8 and shared == blocks[:2]
+    st = m.stats()
+    assert st['prefix_hits'] == 1 and st['prefix_tokens_reused'] == 8
+    # shorter prompt sharing only the first block hits the 1-block entry
+    shared1, covered1 = m.match_prefix(tokens[:4] + [7, 8])
+    assert covered1 == 4 and shared1 == blocks[:1]
+    # a prompt the cache covers ENTIRELY still leaves its last token
+    # uncovered: the admitting request must compute first-token logits
+    sh, cov = m.match_prefix(tokens[:8])
+    assert cov == 4 and sh == blocks[:1]
+    m.decref(shared + shared1 + sh)
+    assert m.in_use() == 2
+
+
+def test_prefix_hash_collision_never_aliases():
+    # force EVERY key onto one bucket: a colliding entry whose tokens
+    # differ must be a miss, never an alias onto foreign blocks
+    m = BlockManager(num_blocks=16, block_size=2,
+                     hash_fn=lambda b: 'same')
+    a = m.alloc(2)
+    m.register_prefix([1, 2, 3, 4], a)
+    b = m.alloc(2)
+    m.register_prefix([9, 8, 7, 6], b)
+    sh_a, cov_a = m.match_prefix([1, 2, 3, 4, 5])
+    sh_b, cov_b = m.match_prefix([9, 8, 7, 6, 5])
+    assert (sh_a, cov_a) == (a, 4)
+    assert (sh_b, cov_b) == (b, 4)
+    miss, cov = m.match_prefix([2, 1, 8, 9, 5])
+    assert (miss, cov) == ([], 0)
+    assert m.stats()['prefix_misses'] == 1
+
+
+def test_lru_eviction_under_pressure():
+    m = BlockManager(num_blocks=9, block_size=2)
+    a, b = m.alloc(2), m.alloc(2)
+    m.register_prefix([1, 2, 3, 4], a)
+    m.register_prefix([5, 6, 7, 8], b)
+    m.decref(a)
+    m.decref(b)                           # both live only via the cache
+    assert m.in_use() == 4 and m.free_blocks() == 4
+    m.match_prefix([1, 2, 3, 4, 0])       # touch a: b becomes LRU
+    m.decref(a)                           # drop the match's refs again
+    got = m.alloc(6)                      # needs eviction to cover
+    assert len(got) == 6
+    st = m.stats()
+    assert st['evictions'] >= 1
+    # a (recently used) survived where possible; b evicted first
+    sh, cov = m.match_prefix([5, 6, 7, 8, 0])
+    assert (sh, cov) == ([], 0)
+
+
+def test_reserve_preflight_contract():
+    m = BlockManager(num_blocks=6, block_size=2)
+    a = m.alloc(2)
+    m.register_prefix([1, 2, 3, 4], a)
+    m.decref(a)                           # evictable
+    assert m.reserve(5)                   # evicts the prefix entry
+    for _ in range(5):
+        m.alloc(1)                        # cannot fail after reserve
+    assert not m.reserve(1)               # fully pinned now
+    m.alloc(1) if m.free_blocks() else None
+    with pytest.raises(BlockPoolExhausted):
+        m.alloc(1)
+
+
+def test_evict_all_and_stats_keys():
+    m = BlockManager(num_blocks=8, block_size=2)
+    a = m.alloc(2)
+    m.register_prefix([1, 2, 3, 4], a)
+    m.decref(a)
+    m.evict_all_prefixes()
+    assert m.prefix_entries() == 0 and m.in_use() == 0
+    st = m.stats()
+    for k in ('num_blocks', 'block_size', 'blocks_in_use', 'blocks_peak',
+              'blocks_free', 'allocs', 'frees', 'prefix_entries',
+              'prefix_hits', 'prefix_misses', 'prefix_hit_rate',
+              'prefix_tokens_reused', 'evictions'):
+        assert k in st, k
+
+
+def test_doomed_alloc_does_not_wipe_prefix_cache():
+    """An over-capacity alloc whose shortfall eviction CANNOT cover
+    (every prefix entry's blocks also table-pinned) must fail without
+    evicting anything: wiping the cache would trade the prefix-sharing
+    capacity win for zero freed blocks."""
+    m = BlockManager(num_blocks=6, block_size=2)
+    a = m.alloc(3)
+    m.register_prefix([1, 2, 3, 4, 5, 6], a)   # entries share pinned blocks
+    m.alloc(2)                                 # pool now fully pinned
+    with pytest.raises(BlockPoolExhausted):
+        m.alloc(1)
+    assert m.prefix_entries() == 3             # cache survived the miss
+    assert not m.reserve(1)
+    assert m.prefix_entries() == 3
+    m.decref(a)   # table gone: entries alone hold the prefix blocks
+    got, cov = m.match_prefix([1, 2, 3, 4, 5, 6, 7])
+    assert cov == 6 and got == a
+
+
+# -- served block tier -------------------------------------------------------
+
+def _build(tmp, **kw):
+    from models.transformer import build_decode_spec
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope), fluid.unique_name.guard():
+        spec = build_decode_spec(
+            vocab=VOCAB, d_model=16, n_head=2, n_layer=2, d_ff=32,
+            max_slots=SLOTS, max_cache_len=CACHE, eos_id=1, **kw)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(spec['startup'])
+        export_decode(spec, tmp, scope=scope)
+    return tmp
+
+
+@pytest.fixture(scope='module')
+def arts(tmp_path_factory):
+    """Slot/block artifact pairs (f32 and int8 tiers) of the same tiny
+    LM: the slot tier is the bit-identity reference."""
+    t = tmp_path_factory.mktemp('kvblocks')
+    return {
+        'slot': _build(str(t / 'slot'), prompt_buckets=(4, 8)),
+        'block': _build(str(t / 'block'), prompt_buckets=(4, 8),
+                        block_size=4),
+        'slot8': _build(str(t / 'slot8'), prompt_buckets=(4, 8),
+                        kv_cache_dtype='int8'),
+        'block8': _build(str(t / 'block8'), prompt_buckets=(4, 8),
+                         block_size=4, kv_cache_dtype='int8'),
+    }
+
+
+def _prompts(seed, n, lo=2):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(lo, VOCAB, int(rng.randint(2, 9)))
+            for _ in range(n)]
+
+
+def test_block_artifact_layout(arts):
+    from paddle_tpu.inference import decoding
+    with open(os.path.join(arts['block'],
+                           decoding._DECODE_SIGNATURE)) as f:
+        sig = json.load(f)
+    assert sig['layout'] == 'block'
+    blk = sig['block']
+    assert blk['block_size'] == 4
+    assert blk['max_blocks_per_slot'] == CACHE // 4
+    assert blk['num_blocks'] == SLOTS * (CACHE // 4) + 1
+    for e in sig['state']:
+        assert e['shape'][:2] == [blk['num_blocks'], 4]
+    for d in ([decoding._STEP_DIR, decoding._REORDER_DIR,
+               decoding._BLOCKCOPY_DIR] +
+              [decoding._CHUNK_DIR % c for c in sig['chunk_buckets']]):
+        assert os.path.exists(os.path.join(arts['block'], d,
+                                           'module.jaxexport'))
+        assert os.path.exists(os.path.join(arts['block'], d,
+                                           'aot_cpu.jaxexec'))
+
+
+def test_block_greedy_and_beam_bit_identical_to_slot(arts):
+    prompts = _prompts(31, 8)
+    with DecodingPredictor(arts['slot']) as ps:
+        g_ref = [ps.generate(p, max_new_tokens=10) for p in prompts]
+        b_ref = [ps.generate(p, max_new_tokens=8, beam=3)
+                 for p in prompts[:3]]
+    with DecodingPredictor(arts['block']) as pb:
+        assert pb.layout == 'block'
+        g = [pb.generate(p, max_new_tokens=10) for p in prompts]
+        b = [pb.generate(p, max_new_tokens=8, beam=3)
+             for p in prompts[:3]]
+        snap = pb.stats.snapshot()
+    assert g == g_ref
+    for (i1, s1), (i2, s2) in zip(b_ref, b):
+        np.testing.assert_array_equal(i1, i2)
+        np.testing.assert_array_equal(s1, s2)
+    # beam history moves were table permutations + block CoW — and the
+    # copies dispatched blocks, not slot rows
+    assert snap['cow_blocks'] > 0
+    assert snap['blockcopies'] <= snap['cow_blocks']
+
+
+def test_block_int8_pages_bit_identical_to_slot_int8(arts):
+    """int8 KV pages compose with block paging (round-14 x ISSUE 13):
+    per-page scales ride the pool and, with a COLD prefix cache,
+    transcripts AND beam scores match the int8 slot tier exactly (the
+    chunk op attends the current chunk's fresh f32 rows — the slot
+    tier's int8 prefill semantics). Once prefix sharing engages, a hit
+    attends the covered span via its int8 pages where a cold prefill
+    recomputes it at f32: token ids stay identical, scores track within
+    the quantization step — the (vLLM-standard) int8 prefix-cache
+    boundary."""
+    prompts = _prompts(32, 6)
+    with DecodingPredictor(arts['slot8']) as ps:
+        ref = [ps.generate(p, max_new_tokens=10) for p in prompts]
+        b_ref = ps.generate(prompts[0], max_new_tokens=8, beam=3)
+    with DecodingPredictor(arts['block8']) as pb:
+        assert pb.stats.tier == 'int8'
+        b_cold = pb.generate(prompts[0], max_new_tokens=8, beam=3)
+        got = [pb.generate(p, max_new_tokens=10) for p in prompts]
+        b_warm = pb.generate(prompts[0], max_new_tokens=8, beam=3)
+        warm_snap = pb.stats.snapshot()
+    assert got == ref
+    np.testing.assert_array_equal(b_ref[0], b_cold[0])
+    np.testing.assert_array_equal(b_ref[1], b_cold[1])
+    # warm (prefix-hit) serve: same tokens, scores within quant step
+    assert warm_snap['prefix_hits'] > 0
+    np.testing.assert_array_equal(b_ref[0], b_warm[0])
+    np.testing.assert_allclose(b_ref[1], b_warm[1], atol=0.05)
+    with open(os.path.join(arts['block8'],
+                           'decode_signature.json')) as f:
+        sig = json.load(f)
+    dt = {e['name']: e['dtype'] for e in sig['state']}
+    assert dt['kv_k_0'] == 'int8' and dt['kv_ks_0'] == 'float32'
+
+
+def test_beam_divergence_cow_at_block_boundary(arts):
+    """Force beam CoW exactly where it is subtle: a prompt whose length
+    is a multiple of block_size (the fork point is a BLOCK BOUNDARY, so
+    the first divergent write extends into a fresh block — no copy) and
+    one mid-block (the shared partial tail must CoW). Both must match
+    the slot tier bit-for-bit."""
+    rng = np.random.RandomState(33)
+    at_boundary = rng.randint(2, VOCAB, 8)    # 8 % 4 == 0
+    mid_block = rng.randint(2, VOCAB, 6)      # 6 % 4 != 0
+    with DecodingPredictor(arts['slot']) as ps:
+        ref = [ps.generate(p, max_new_tokens=10, beam=3)
+               for p in (at_boundary, mid_block)]
+    with DecodingPredictor(arts['block']) as pb:
+        got = [pb.generate(p, max_new_tokens=10, beam=3)
+               for p in (at_boundary, mid_block)]
+        snap = pb.stats.snapshot()
+    for (i1, s1), (i2, s2) in zip(ref, got):
+        np.testing.assert_array_equal(i1, i2)
+        np.testing.assert_array_equal(s1, s2)
+    assert snap['cow_blocks'] > 0
+
+
+def test_prefix_sharing_skips_compute_and_storage(arts):
+    """Two requests with the same prompt: the second hits the prefix
+    cache — fewer chunk slices (covered span skips prefill compute) and
+    shared full blocks (storage) — with an identical transcript."""
+    rng = np.random.RandomState(34)
+    prompt = rng.randint(2, VOCAB, 9)          # 2 full blocks + 1
+    with DecodingPredictor(arts['block']) as pb:
+        a = pb.generate(prompt, max_new_tokens=10)
+        s1 = pb.stats.snapshot()
+        b = pb.generate(prompt, max_new_tokens=10)
+        s2 = pb.stats.snapshot()
+    assert a == b
+    assert s2['prefix_hits'] == s1['prefix_hits'] + 1
+    assert s2['prefix_tokens_reused'] == s1['prefix_tokens_reused'] + 8
+    # the covered 8 tokens (2 blocks) admitted without chunk dispatches:
+    # request 1 took 2 slices (8 + 1 tokens), request 2 only 1
+    assert (s2['chunk_slices'] - s1['chunk_slices']
+            < s1['chunk_slices'])
+
+
+def test_chunked_prefill_admits_beyond_largest_chunk(arts):
+    """A prompt longer than the largest chunk size admits in slices (the
+    slot tier would reject it: no bucket fits) and its transcript
+    matches a short-prompt continuation computed the long way around:
+    greedy decode is deterministic, so serving the same prompt twice on
+    the block tier across chunk boundaries must agree."""
+    rng = np.random.RandomState(35)
+    long_prompt = rng.randint(2, VOCAB, 23)    # > max chunk (8)
+    with DecodingPredictor(arts['block']) as pb:
+        one = pb.generate(long_prompt, max_new_tokens=12)
+        s = pb.stats.snapshot()
+        two = pb.generate(long_prompt, max_new_tokens=12)
+    assert one == two
+    assert s['chunk_slices'] >= 3              # 23 tokens over 8-chunks
+    with DecodingPredictor(arts['slot']) as ps:
+        with pytest.raises(ValueError, match='exceeds'):
+            ps.generate(long_prompt, max_new_tokens=4)
+
+
+def test_mp_sharded_decode_transcripts_match_single_chip(arts,
+                                                         tmp_path):
+    """ISSUE 13 acceptance: the 2-chip mp-sharded decode artifact's
+    TOKEN TRANSCRIPTS (greedy and beam ids) are bit-identical to the
+    single-chip artifact's. The replicate-hint discipline keeps every
+    contraction full-width (no partial-sum all-reduces), so logits
+    agree to within local-fusion ulps — accumulated float beam scores
+    may differ in the last ~1e-6 (the standard the sharded serving
+    systems hold); ids must not."""
+    mp2 = _build(str(tmp_path / 'mp2'), prompt_buckets=(4, 8),
+                 block_size=4, mp_shard=2)
+    with open(os.path.join(mp2, 'decode_signature.json')) as f:
+        sig = json.load(f)
+    assert sig['mesh']['axes'] == {'mp': 2}
+    assert sig['mesh']['tag'] == 'cpu_mp2'
+    # mesh-tagged sidecars: a sharded executable can never load into an
+    # unsharded serve (or another mesh shape)
+    from paddle_tpu.inference import decoding
+    for d in (decoding._STEP_DIR, decoding._REORDER_DIR,
+              decoding._BLOCKCOPY_DIR):
+        assert os.path.exists(os.path.join(mp2, d,
+                                           'aot_cpu_mp2.jaxexec'))
+        assert not os.path.exists(os.path.join(mp2, d,
+                                               'aot_cpu.jaxexec'))
+    prompts = _prompts(36, 6)
+    with DecodingPredictor(arts['block']) as p1:
+        g1 = [p1.generate(p, max_new_tokens=10) for p in prompts]
+        b1 = [p1.generate(p, max_new_tokens=8, beam=3)
+              for p in prompts[:2]]
+    with DecodingPredictor(mp2) as p2:
+        assert p2.mesh_tag == 'cpu_mp2'
+        g2 = [p2.generate(p, max_new_tokens=10) for p in prompts]
+        b2 = [p2.generate(p, max_new_tokens=8, beam=3)
+              for p in prompts[:2]]
+    assert g1 == g2
+    for (i1, s1), (i2, s2) in zip(b1, b2):
+        np.testing.assert_array_equal(i1, i2)
+        np.testing.assert_allclose(s1, s2, atol=1e-4)
+
+
+def test_mp_sharded_warm_replica_zero_compiles(arts, tmp_path):
+    """A FRESH process loading the prewarmed mp-sharded artifact serves
+    greedy + beam with ZERO XLA compiles (mesh-tagged AOT sidecars),
+    and its transcripts equal the single-chip artifact served the same
+    way — the full ISSUE 13 sharded-serve acceptance bar."""
+    import subprocess
+    import sys as _sys
+    mp2 = _build(str(tmp_path / 'mp2w'), prompt_buckets=(4, 8),
+                 block_size=4, mp_shard=2)
+    here = os.path.dirname(os.path.abspath(__file__))
+    outs = []
+    for art in (arts['block'], mp2):
+        env = dict(os.environ)
+        env['XLA_FLAGS'] = '--xla_force_host_platform_device_count=2'
+        env['JAX_PLATFORMS'] = 'cpu'
+        p = subprocess.run(
+            [_sys.executable, os.path.join(here,
+                                           'decode_serve_worker.py'),
+             art, '5', '4', '8'],
+            capture_output=True, text=True, env=env, timeout=600)
+        assert 'DECODE_OK' in p.stdout, p.stdout + p.stderr
+        line = [ln for ln in p.stdout.splitlines()
+                if ln.startswith('DECODE ')][0]
+        outs.append(json.loads(line[len('DECODE '):]))
+    single, sharded = outs
+    assert sharded['compiles'] == 0
+    assert sharded['greedy'] == single['greedy']
+    assert sharded['beam_ids'] == single['beam_ids']
+
+
+def test_chunk_pad_overflow_lands_in_trash_block():
+    """A near-max-length prompt whose FINAL chunk slice runs past
+    max_cache_len (take < size with a FULL block table) must scatter
+    its pad rows into the trash block: gather clamping would resolve
+    their overflowing positions to the LAST table column — a real
+    block when the table is full — and pad garbage would overwrite
+    prompt K/V written in the same dispatch. The transcript through a
+    big chunk (pad rows overflow) and a small chunk (none do) must
+    agree."""
+    import tempfile
+    t = tempfile.mkdtemp()
+    big = _build(os.path.join(t, 'big'), prompt_buckets=(8,),
+                 block_size=8, chunk_sizes=(48,))
+    small = _build(os.path.join(t, 'small'), prompt_buckets=(8,),
+                   block_size=8, chunk_sizes=(8,))
+    rng = np.random.RandomState(36)
+    prompt = rng.randint(2, VOCAB, CACHE - 1)  # 63 tokens: table full
+    with DecodingPredictor(small) as ps:
+        ref = ps.generate(prompt, max_new_tokens=1)
+    with DecodingPredictor(big) as pb:
+        # final slice: start=48, take=15, size=48 -> pad positions
+        # 64..95 overflow the 8-column table
+        assert pb.generate(prompt, max_new_tokens=1) == ref
+
+
+def test_waiting_request_rematches_published_prefix():
+    """A request whose FIRST admission attempt misses the prefix cache
+    (its twin ahead of it is still prefilling) and then stalls on
+    blocks must RE-match once it can admit: the twin published the
+    shared prefix while it waited. A cached miss holds no refs, so
+    only a cached HIT may pin across attempts."""
+    import tempfile
+    art = _build(tempfile.mkdtemp() + '/rematch', prompt_buckets=(4, 8),
+                 block_size=4, num_blocks=5)   # 4 usable blocks
+    rng = np.random.RandomState(37)
+    prompt = rng.randint(2, VOCAB, 12)         # 3 blocks at admission
+    with DecodingPredictor(art) as pb:
+        # A admits (3 blocks + 1 decode extension = the whole pool):
+        # B's first attempt MISSES the prefix cache and stalls on
+        # blocks; A publishes at prefill end and frees at finish —
+        # B must then admit on the re-matched HIT (2 shared + 1 fresh)
+        sa = pb.submit(prompt, max_new_tokens=4)
+        sb = pb.submit(prompt, max_new_tokens=4)
+        a = sa.result(120)
+        b = sb.result(120)
+        snap = pb.stats.snapshot()
+    assert a == b
+    assert snap['prefix_hits'] >= 1
+
+
+def test_pool_exhaustion_sheds_loudly(arts):
+    """A pool too small for the offered prompts sheds the unservable
+    request with ServerOverloaded instead of deadlocking."""
+    from paddle_tpu.inference import ServerOverloaded
+    import tempfile
+    small = _build(tempfile.mkdtemp() + '/tiny', prompt_buckets=(4, 8),
+                   block_size=4, num_blocks=3)  # 2 usable blocks
+    with DecodingPredictor(small) as pb:
+        ok = pb.generate(np.asarray([3, 4, 5]), max_new_tokens=4)
+        assert len(ok) == 4
+        with pytest.raises(ServerOverloaded, match='block pool'):
+            # needs 4 blocks (12 tokens + new): can never fit
+            pb.submit(np.asarray(range(2, 14)),
+                      max_new_tokens=4).result(60)

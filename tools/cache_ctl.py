@@ -28,11 +28,25 @@ compiles. Int8-KV decode artifacts (export_decode of a
 kv_cache_dtype='int8' spec) prewarm through the standard decode layout:
 the quantized cache is ordinary program state.
 
-Exit codes (all subcommands, including the decode and quantized-tier
-prewarm paths):
+Block-paged / mp-sharded decode artifacts (ISSUE 13,
+build_decode_spec(block_size=..., mp_shard=k)): a block-layout artifact
+prewarms its chunked-prefill programs (prefill_chunk_<C>/, one per chunk
+size) and the block-copy program (decode_blockcopy/) in place of the
+prompt-bucket prefill tree. An artifact whose signature carries a mesh
+block prewarms over that mesh — the host must see prod(mesh axes)
+devices of the artifact's platform or prewarm fails with exit 1 — and
+writes MESH-TAGGED sidecars (aot_<platform>_<axes>.jaxexec, e.g.
+aot_tpu_mp2.jaxexec) so a sharded executable can never load into an
+unsharded serve or a different mesh shape. A --platform that contradicts
+a sharded artifact's recorded platform is refused (sharded executables
+are single-platform).
+
+Exit codes (all subcommands, including the decode, quantized-tier, and
+sharded/block-paged prewarm paths):
   0  success (prewarm: at least one sidecar written)
   1  operation failed (compile error, unreadable module, no sidecar
-     written)
+     written, sharded artifact on a host without the full mesh's
+     device count)
   2  usage error (unknown subcommand, missing/non-artifact directory —
      a dir carrying none of decode_signature.json / signature.json /
      train_module.jaxexport; a bare int8/ tier dir IS an artifact dir)

@@ -108,9 +108,12 @@ def cmd_status(args):
           % (c.get('scale_out', 0), c.get('scale_in', 0),
              c.get('replica_deaths', 0),
              c.get('rollout', {}).get('state', 'idle')))
-    print('%-8s %-9s %5s %5s %8s %8s %5s %9s %8s' %
-          ('replica', 'state', 'tier', 'pid', 'backlog', 'requests',
-           'occ', 'hb-age(s)', 'compiles'))
+    # layout/mesh columns (ISSUE 13): which decode cache layout and
+    # mesh each replica ACTUALLY loaded — a rolling rollout to the
+    # block-paged or mp-sharded tier is auditable mid-flight
+    print('%-8s %-9s %5s %6s %8s %5s %8s %8s %5s %9s %8s' %
+          ('replica', 'state', 'tier', 'layout', 'mesh', 'pid',
+           'backlog', 'requests', 'occ', 'hb-age(s)', 'compiles'))
     reps = st.get('replicas', {})
     for rid in sorted(reps, key=lambda r: int(r)):
         s = reps[rid]
@@ -119,8 +122,9 @@ def cmd_status(args):
         # backlog = router pending + worker queue (outstanding would
         # double-count frames already inside the worker's queue)
         backlog = s.get('pending', 0) + s.get('queue_depth', 0)
-        print('%-8s %-9s %5s %5s %8d %8d %5.2f %9s %8s' %
+        print('%-8s %-9s %5s %6s %8s %5s %8d %8d %5.2f %9s %8s' %
               (rid, s.get('state', '?')[:9], s.get('tier', 'bf16'),
+               s.get('layout') or '-', s.get('mesh') or '-',
                s.get('pid', '-'), backlog, s.get('requests', 0),
                s.get('occupancy', 0.0),
                ('%.2f' % hb_age) if hb_age is not None else '-',
