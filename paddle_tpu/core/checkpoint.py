@@ -965,9 +965,15 @@ def pod_verify(path, num_hosts=None):
     if num_hosts is not None and int(pod.get('num_hosts', -1)) \
             != int(num_hosts):
         raise ValueError('pod shape changed: checkpoint was written by %s '
-                         'hosts, this pod has %d (resharding a checkpoint '
-                         'is not supported)' % (pod.get('num_hosts'),
-                                                int(num_hosts)))
+                         'hosts, this pod has %d (strict shape check; '
+                         'PodCheckpointManager.restore() performs '
+                         'topology-change resume)' % (pod.get('num_hosts'),
+                                                      int(num_hosts)))
+    if sorted(int(r) for r in hosts) != list(range(int(
+            pod.get('num_hosts', len(hosts))))):
+        raise ValueError('POD_COMMIT names hosts %r but records '
+                         'num_hosts=%s — inconsistent commit record'
+                         % (sorted(hosts), pod.get('num_hosts')))
     manifests = {}
     for r_str, sha in sorted(hosts.items()):
         host_dir = os.path.join(path, '%s%s' % (_HOST_PREFIX, r_str))
@@ -1058,9 +1064,24 @@ class PodCheckpointManager(CheckpointManager):
                  every_steps=None, every_seconds=None, max_retries=3,
                  retry_backoff_s=0.25, task_service=None,
                  commit_timeout_s=60.0, heartbeat_interval_s=0.5,
-                 run_id=None):
+                 run_id=None, topology=None):
         self.rank = int(rank)
         self.num_hosts = int(num_hosts)
+        # pod topology (hosts x mesh axes) for the operator surface: a
+        # dict of mesh axis -> size (or a pre-rendered string) carried
+        # in every heartbeat payload and POD_COMMIT, so a resize is
+        # visible in profiler.pod_report() — stale-shape heartbeat
+        # files from the previous incarnation are already ignored by
+        # run_id, exactly like stale shard dirs
+        self.topology = topology
+        if isinstance(topology, dict):
+            self._topology_str = '%dh x %s' % (
+                int(num_hosts), ','.join('%s=%d' % (a, int(s))
+                                         for a, s in topology.items()))
+        elif topology is not None:
+            self._topology_str = str(topology)
+        else:
+            self._topology_str = '%dh' % int(num_hosts)
         if not (0 <= self.rank < self.num_hosts):
             raise ValueError('rank %d outside pod of %d hosts'
                              % (self.rank, self.num_hosts))
@@ -1103,6 +1124,7 @@ class PodCheckpointManager(CheckpointManager):
     # -- heartbeat / pod-health surface --------------------------------
     def _hb_payload(self):
         p = {'rank': self.rank, 'run_id': self.run_id,
+             'topology': self._topology_str,
              'step': self._last_step if self._last_step is not None else 0}
         with self._stats_lock:
             p.update(commits=self.stats['commits'],
@@ -1304,11 +1326,18 @@ class PodCheckpointManager(CheckpointManager):
         from .lod import LoDArray
         step = meta['step']
         meta = dict(meta, rank=self.rank, num_hosts=self.num_hosts,
-                    run_id=self.run_id, pod=True)
+                    run_id=self.run_id, pod=True,
+                    topology=self._topology_str)
         pod_dir = os.path.join(self.dirname, '%s%d' % (_PREFIX, step))
         if os.path.exists(os.path.join(pod_dir, _POD_COMMIT)):
             try:
-                pod_verify(pod_dir, self.num_hosts)
+                # shape-agnostic (num_hosts=None): after an elastic
+                # resize, a committed checkpoint at this step from the
+                # OLD topology describes the same training history and
+                # is restorable by the elastic restore() — rewriting
+                # its host dirs in place would be the exact
+                # mixed-incarnation destruction this guard forbids
+                pod_verify(pod_dir, None)
                 committed = True
             except (ValueError, OSError):
                 committed = False
@@ -1396,7 +1425,13 @@ class PodCheckpointManager(CheckpointManager):
         committed = []
         for step, path in live:
             try:
-                pod_verify(path, self.num_hosts)
+                # shape-agnostic: a committed OLD-topology checkpoint
+                # (pre-resize history) is restorable by the elastic
+                # restore() and counts toward — and is protected by —
+                # the keep budget; verifying against THIS pod's shape
+                # would misclassify it as a dead partial and evict the
+                # entire pre-resize history on the first new commit
+                pod_verify(path, None)
                 committed.append((step, path))
             except (ValueError, OSError):
                 pass
@@ -1527,6 +1562,7 @@ class PodCheckpointManager(CheckpointManager):
             time.sleep(0.05)
         pod = {'version': _VERSION, 'step': step,
                'num_hosts': self.num_hosts, 'hosts': shas,
+               'topology': self._topology_str,
                'run_id': self.run_id, 'wall_time': meta['wall_time']}
         tmpf = os.path.join(pod_dir, '%s%s.%d' % (_TMP_PREFIX, _POD_COMMIT,
                                                   os.getpid()))
@@ -1581,23 +1617,74 @@ class PodCheckpointManager(CheckpointManager):
             out[var] = buf
         return out
 
-    def restore(self, executor=None, program=None, scope=None):
+    def restore(self, executor=None, program=None, scope=None, mesh=None):
         """Load the newest FULLY pod-committed checkpoint: POD_COMMIT
         present, every host manifest matching its recorded sha, every
         shard verifying on the read that loads it. Every rank assembles
-        the same global host values (the next mesh dispatch re-shards
-        them); partial pods — a host died between phase 1 and phase 2 —
-        are skipped with a loud warning, exactly like single-host corrupt
-        entries. Restores this rank's executor step counter and
-        task-journal position from its OWN host manifest."""
+        the same global host values; partial pods — a host died between
+        phase 1 and phase 2 — are skipped with a loud warning, exactly
+        like single-host corrupt entries.
+
+        Topology-change resume (ISSUE 14): the checkpoint does NOT have
+        to match this pod's host count. Same shape keeps today's
+        bit-exact fast path — assembled numpy straight into the scope,
+        ZERO resharding work (pinned by tests/test_elastic_pod.py).
+        When the checkpoint was written by N != num_hosts hosts, the
+        assembled global state is resharded onto the NEW mesh (the one
+        `program`/`mesh` describes) through parallel/reshard.py: the
+        same annotation + optimizer-slot-inheritance rule the executor
+        dispatches with, validated for divisibility FIRST — an
+        impossible reshard raises ReshardError naming the param, the
+        old/new shardings, and the nearest valid host counts. The info
+        dict then carries every OLD host's task-journal position
+        (`task_journals`) so the data plane can re-stride its
+        exactly-once journal (reader/sharded.restride_journal).
+
+        Restores this rank's executor step counter from its own host
+        manifest (rank 0's when this rank did not exist in the old pod
+        — the counters are identical across hosts by SPMD construction),
+        keeping the per-step rng stream exact across the resize."""
         from .scope import global_scope
-        for step, path, _pod, manifests in _pod_candidates(self.dirname,
-                                                           self.num_hosts):
+        for step, path, pod, manifests in _pod_candidates(self.dirname,
+                                                          None):
+            ckpt_hosts = int(pod.get('num_hosts', len(manifests)))
+            t0 = time.perf_counter()
             try:
                 values = self._load_pod(path, manifests)
             except (ValueError, OSError) as e:
                 _warn_skip(path, e)
                 continue
+            stitch_s = time.perf_counter() - t0
+            resharded = False
+            reshard = None
+            # a topology change is a different host count OR — when both
+            # incarnations recorded their mesh axes (topology=) — the
+            # same host count over different axes (dp=4,mp=2 ->
+            # dp=2,mp=4): the latter reshards just the same, and taking
+            # the fast path would skip the divisibility gate. When
+            # either side did not record axes (' x ' absent: the bare
+            # '%dh' default, or a pre-topology checkpoint) host count is
+            # all there is to compare, exactly as before.
+            ckpt_topo = pod.get('topology')
+            if ckpt_hosts != self.num_hosts or (
+                    ckpt_topo and ' x ' in ckpt_topo
+                    and ' x ' in self._topology_str
+                    and ckpt_topo != self._topology_str):
+                warnings.warn(
+                    'topology-change restore: checkpoint %s was written '
+                    'by %d host(s) (%s), restoring onto %d host(s) (%s) '
+                    '— global state reshards to the new mesh; same-step '
+                    'losses stay within float-accumulation tolerance, '
+                    'rng stream and sample accounting stay exact'
+                    % (path, ckpt_hosts, ckpt_topo or '?',
+                       self.num_hosts, self._topology_str),
+                    RuntimeWarning)
+                # ReshardError (axis not divisible by the new mesh)
+                # propagates: it is an operator error about the NEW
+                # topology, not a corrupt candidate to skip
+                values, reshard = self._reshard_restored(
+                    values, program, executor, mesh, ckpt_hosts)
+                resharded = True
             sc = scope if scope is not None else global_scope()
             for name, value in values.items():
                 sc.set(name, value)
@@ -1610,5 +1697,56 @@ class PodCheckpointManager(CheckpointManager):
             self._last_time = time.monotonic()
             return {'step': step, 'path': path, 'meta': my_meta,
                     'task_journal': my_meta.get('task_journal'),
+                    'task_journals': {
+                        r: m.get('meta', {}).get('task_journal')
+                        for r, m in sorted(manifests.items())},
+                    'pod_num_hosts': ckpt_hosts,
+                    'pod_topology': pod.get('topology'),
+                    'resharded': resharded, 'reshard': reshard,
+                    'stitch_s': stitch_s,
                     'loaded': sorted(values), 'missing': []}
         return None
+
+    def _reshard_restored(self, values, program, executor, mesh,
+                          ckpt_hosts):
+        """Shape-change half of restore(): validate divisibility against
+        the new mesh and scatter the assembled global values onto it.
+        Without a program/mesh (duck-typed units, standalone loads, a
+        caller that reshards at first dispatch) the assembled numpy is
+        returned as-is — the executor's `_mesh_put` replaces the
+        explicit resharding program, at the cost of meeting any
+        divisibility error only at dispatch."""
+        try:
+            from ..parallel.reshard import (state_shardings_for,
+                                            check_reshardable,
+                                            reshard_to_mesh,
+                                            reshard_stats)
+        except ImportError:
+            return values, None     # standalone module load (tools/)
+        if mesh is None and program is not None \
+                and hasattr(program, '_get_mesh'):
+            mesh = program._get_mesh(executor)
+        if mesh is None or program is None:
+            if program is not None:
+                # without a mesh the divisibility pre-check cannot run;
+                # say so instead of silently deferring the failure mode
+                # to a bare XLA shape error at first dispatch
+                warnings.warn(
+                    'topology-change restore has no mesh to reshard '
+                    'onto (pass mesh= or a CompiledProgram with a '
+                    'mesh): restoring host-side numpy — resharding and '
+                    'any divisibility error happen at first dispatch',
+                    RuntimeWarning)
+            return values, None
+        names = sorted(values)
+        shardings, specs = state_shardings_for(program, mesh, names)
+        shapes = {n: tuple(np.shape(v)) for n, v in values.items()
+                  if isinstance(v, np.ndarray)}
+        check_reshardable(shapes, specs, mesh,
+                          old_num_hosts=ckpt_hosts,
+                          new_num_hosts=self.num_hosts)
+        before = dict(reshard_stats)
+        out = reshard_to_mesh(values, shardings, mesh)
+        return out, {k: reshard_stats[k] - before[k] if isinstance(
+            reshard_stats[k], (int, float)) else reshard_stats[k]
+            for k in reshard_stats}

@@ -1214,43 +1214,16 @@ class Executor(object):
         # unless a parameter carries a sharding_spec (TP/EP annotation);
         # GSPMD partitions the program and inserts gradient all-reduces
         # (subsumes ParallelExecutor + nccl2 + pserver-dense, SURVEY §2.4).
-        from jax.sharding import NamedSharding, PartitionSpec
+        # The annotation + optimizer-slot-inheritance rule lives in
+        # parallel/reshard.py — ONE copy shared with the pod checkpoint
+        # manager's topology-change restore, so restore-time resharding
+        # and dispatch-time placement can never disagree.
         from .parallel.mesh import replicated, batch_sharded, DATA_AXIS
+        from .parallel.reshard import state_shardings_for
         rep = replicated(mesh)
         ndp = mesh.shape.get(DATA_AXIS, 1)
-
-        prog_vars = {}
-        for n in state_names:
-            for b in program.blocks:
-                v = b.vars.get(n)
-                if v is not None:
-                    prog_vars[n] = v
-                    break
-        annotated = {n: tuple(prog_vars[n].sharding_spec)
-                     for n in state_names
-                     if prog_vars.get(n) is not None
-                     and getattr(prog_vars[n], 'sharding_spec', None)}
-        state_shardings = {}
-        for n in state_names:
-            spec = annotated.get(n)
-            if spec is None:
-                # optimizer slots (<param>_velocity_0, <param>_moment_0,
-                # ...) inherit their param's annotation when shapes match:
-                # an unannotated same-shape slot replicated next to a
-                # sharded param would force a gather/scatter every update
-                v = prog_vars.get(n)
-                for pn, pspec in annotated.items():
-                    pv = prog_vars.get(pn)
-                    if v is not None and pv is not None \
-                            and n.startswith(pn + '_') \
-                            and tuple(v.shape) == tuple(pv.shape):
-                        spec = pspec
-                        break
-            if spec is not None and all(a is None or a in mesh.shape
-                                        for a in spec):
-                state_shardings[n] = NamedSharding(mesh, PartitionSpec(*spec))
-            else:
-                state_shardings[n] = rep
+        state_shardings, _specs = state_shardings_for(program, mesh,
+                                                      state_names)
 
         from .parallel import multihost
         multi = multihost.mesh_spans_processes(mesh)

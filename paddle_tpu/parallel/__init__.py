@@ -6,3 +6,6 @@ from .api import shard_parameter, shard_embedding, MultiStepTrainer  # noqa: F40
 from .ring_attention import ring_attention  # noqa: F401
 from .multihost import init_distributed, pod_run_id, \
     PodCheckpointManager, HostWatchdog, fs_barrier, BarrierTimeout  # noqa: F401,E501
+from .reshard import ReshardError, state_shardings_for, \
+    check_reshardable, reshard_to_mesh, reshard_stats, \
+    reset_reshard_stats  # noqa: F401

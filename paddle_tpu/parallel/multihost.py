@@ -52,16 +52,21 @@ def init_distributed(coordinator_address=None, num_trainers=None,
         eps = os.environ.get('PADDLE_TRAINER_ENDPOINTS', '')
         if eps:
             coordinator_address = eps.split(',')[0]
+    if platform is not None:
+        # pin the platform BEFORE backend init (e.g. 'cpu' for the
+        # simulated-pod tests; on a real pod the TPU platform is
+        # default). Also on the single-trainer path: an elastic pod
+        # resized down to ONE host runs the same worker script, and
+        # skipping the pin there would let an installed TPU plugin
+        # initialize (and hang on GCP metadata) despite the explicit
+        # platform argument.
+        jax.config.update('jax_platforms', platform)
     if num_trainers <= 1:
         return 1, 0
     if coordinator_address is None:
         raise ValueError(
             "multi-host init needs a coordinator: set PADDLE_COORDINATOR or "
             "PADDLE_TRAINER_ENDPOINTS (first endpoint is the coordinator)")
-    if platform is not None:
-        # pin the platform BEFORE backend init (e.g. 'cpu' for the
-        # simulated-pod tests; on a real pod the TPU platform is default)
-        jax.config.update('jax_platforms', platform)
     if _effective_platform(platform) == 'cpu':
         # XLA:CPU alone cannot execute a computation spanning processes
         # ("Multiprocess computations aren't implemented on the CPU

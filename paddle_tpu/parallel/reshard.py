@@ -1,0 +1,203 @@
+"""Topology-change resharding (ISSUE 14 tentpole).
+
+A pod checkpoint written by N hosts describes GLOBAL arrays; restoring it
+onto N' != N hosts means re-laying those arrays out over a DIFFERENT mesh.
+This module owns the three pieces that make that safe:
+
+- `state_shardings_for(program, mesh, names)` — THE state-sharding rule
+  (parameter annotations + optimizer slots inheriting their param's spec
+  by name-prefix + shape match), factored out of the executor's mesh
+  dispatch so checkpoint restore and step dispatch can never disagree
+  about where a tensor lives. One copy, two callers (the round-16
+  "_decode_mesh delegates" discipline applied to training state).
+- `check_reshardable(...)` — the loud, actionable gate: a checkpoint
+  axis that does not divide the new mesh axis raises `ReshardError`
+  naming the param, the old/new shardings, and the nearest VALID axis
+  sizes (= host counts when that axis spans hosts) instead of letting
+  the operator meet a bare XLA shape error three layers down.
+- `reshard_to_mesh(values, shardings, mesh)` — the resharding program:
+  each assembled host-side global value is scattered onto the new mesh
+  as a global jax.Array in its target NamedSharding (every process
+  serves its local shards from its own assembled copy — the same
+  construction the executor's `_mesh_put` uses at dispatch, done once
+  at restore so the first step starts from device-resident state and a
+  divisibility error surfaces HERE, not mid-dispatch).
+
+`reshard_stats` counts resharding work (distinct placement programs,
+arrays, bytes, seconds). The same-shape restore path never touches this
+module — `reshard_stats['programs'] == 0` after a same-shape restore is
+a pinned regression (tests/test_elastic_pod.py): topology-change resume
+must never tax the bit-exact common case.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+__all__ = ['ReshardError', 'state_shardings_for', 'check_reshardable',
+           'reshard_to_mesh', 'reshard_stats', 'reset_reshard_stats',
+           'nearest_valid_sizes']
+
+
+class ReshardError(ValueError):
+    """A checkpoint cannot be resharded onto the requested mesh; the
+    message names every offending param, its old/new sharding, and the
+    nearest valid mesh-axis sizes (host counts when the axis spans
+    hosts)."""
+
+
+# stitch (assembling globals from per-host shards) is timed by
+# PodCheckpointManager.restore() itself and returned as info['stitch_s'];
+# this dict books only the RESHARD work this module performs
+reshard_stats = {'programs': 0, 'arrays': 0, 'bytes': 0, 'place_s': 0.0}
+
+
+def reset_reshard_stats():
+    reshard_stats.update(programs=0, arrays=0, bytes=0, place_s=0.0)
+
+
+def _prog_vars(program, names):
+    out = {}
+    for n in names:
+        for b in program.blocks:
+            v = b.vars.get(n)
+            if v is not None:
+                out[n] = v
+                break
+    return out
+
+
+def state_shardings_for(program, mesh, state_names):
+    """The ONE state-sharding rule, shared by the executor's mesh
+    dispatch and PodCheckpointManager's topology-change restore.
+
+    Parameters carrying a `sharding_spec` annotation (parallel.api.
+    shard_parameter) shard accordingly; optimizer slots
+    (<param>_velocity_0, <param>_moment_0, ...) inherit their param's
+    annotation when the name is prefixed by the param's and the shapes
+    match — an unannotated same-shape slot replicated next to a sharded
+    param would force a gather/scatter every update. Everything else is
+    replicated. Specs naming axes the mesh does not carry fall back to
+    replicated (the executor's long-standing forgiving rule).
+
+    Returns (shardings, specs): {name: NamedSharding} over ALL
+    state_names, and {name: partition-spec tuple} for just the names
+    that resolved to a non-replicated sharding (the surface
+    check_reshardable validates)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    from .mesh import replicated
+    rep = replicated(mesh)
+    prog_vars = _prog_vars(program, state_names)
+    annotated = {n: tuple(prog_vars[n].sharding_spec)
+                 for n in state_names
+                 if prog_vars.get(n) is not None
+                 and getattr(prog_vars[n], 'sharding_spec', None)}
+    shardings, specs = {}, {}
+    for n in state_names:
+        spec = annotated.get(n)
+        if spec is None:
+            v = prog_vars.get(n)
+            for pn, pspec in annotated.items():
+                pv = prog_vars.get(pn)
+                if v is not None and pv is not None \
+                        and n.startswith(pn + '_') \
+                        and tuple(v.shape) == tuple(pv.shape):
+                    spec = pspec
+                    break
+        if spec is not None and all(a is None or a in mesh.shape
+                                    for a in spec):
+            shardings[n] = NamedSharding(mesh, PartitionSpec(*spec))
+            specs[n] = spec
+        else:
+            shardings[n] = rep
+    return shardings, specs
+
+
+def nearest_valid_sizes(dim, size):
+    """The nearest divisors of `dim` around `size`: (largest divisor
+    <= size, smallest divisor >= size). These are the nearest VALID
+    mesh-axis sizes — i.e. the nearest valid host counts when the axis
+    spans one device per host."""
+    dim, size = int(dim), int(size)
+    below = max((d for d in range(1, min(dim, size) + 1)
+                 if dim % d == 0), default=1)
+    above = next((d for d in range(max(size, 1), dim + 1)
+                  if dim % d == 0), dim)
+    return below, above
+
+
+def check_reshardable(shapes, specs, mesh, old_num_hosts=None,
+                      new_num_hosts=None):
+    """Validate that every annotated state var divides the new mesh.
+    `shapes`: {name: tuple}, `specs`: {name: partition-spec tuple} (the
+    non-replicated surface from state_shardings_for). Collects EVERY
+    violation into one ReshardError so the operator fixes the topology
+    once, not once per param."""
+    problems = []
+    for name in sorted(specs):
+        spec, shape = specs[name], shapes.get(name)
+        if shape is None:
+            continue
+        for dim, axis in enumerate(spec):
+            if axis is None or axis not in mesh.shape:
+                continue
+            k, s = int(mesh.shape[axis]), int(shape[dim])
+            if s % k == 0:
+                continue
+            below, above = nearest_valid_sizes(s, k)
+            if above > k:
+                hint = '%d (shrink) / %d (grow)' % (below, above)
+            else:
+                # no divisor of the dim is >= the requested size: the
+                # dim itself is the ceiling — never label it a "grow"
+                hint = '%d (largest valid)' % below
+            problems.append(
+                "param %r dim %d (=%d) is not divisible by mesh axis "
+                "%r (=%d) [spec %r, shape %r]; nearest valid %r sizes: "
+                "%s" % (name, dim, s, axis, k, tuple(spec),
+                        tuple(shape), axis, hint))
+    if problems:
+        topo = ''
+        if old_num_hosts is not None and new_num_hosts is not None:
+            topo = (' while restoring a %d-host checkpoint onto %d '
+                    'host(s)' % (int(old_num_hosts), int(new_num_hosts)))
+        raise ReshardError(
+            'cannot reshard the checkpoint onto mesh %r%s:\n  %s\n'
+            'pick a host count whose mesh axes divide every sharded '
+            'param (the nearest valid sizes above are host counts when '
+            'the axis spans hosts)'
+            % (dict(mesh.shape), topo, '\n  '.join(problems)))
+
+
+def reshard_to_mesh(values, shardings, mesh):
+    """Scatter assembled host-side global values onto `mesh` per their
+    target shardings. Only names with a NON-replicated sharding are
+    placed (replicated state rides the executor's dispatch-time
+    placement for free); non-ndarray values (LoD wrappers, scalars) are
+    passed through untouched. Returns a new {name: value} dict; books
+    the work into `reshard_stats`."""
+    import jax
+    from .mesh import replicated
+    rep = replicated(mesh)
+    out = dict(values)
+    seen_programs = set()
+    t0 = time.perf_counter()
+    for name in sorted(values):
+        ns = shardings.get(name)
+        if ns is None or ns == rep:
+            continue
+        host = values[name]
+        if not isinstance(host, np.ndarray):
+            continue
+        key = (tuple(host.shape), str(host.dtype), str(ns.spec))
+        if key not in seen_programs:
+            seen_programs.add(key)
+            reshard_stats['programs'] += 1
+        arr = jax.make_array_from_callback(
+            host.shape, ns, lambda idx, _h=host: _h[idx])
+        out[name] = arr
+        reshard_stats['arrays'] += 1
+        reshard_stats['bytes'] += int(host.nbytes)
+    reshard_stats['place_s'] += time.perf_counter() - t0
+    return out

@@ -382,14 +382,21 @@ def pod_report(stale_after_s=10.0):
         hosts = snap.get('hosts', {})
         if not hosts:
             continue
-        print("%-24s %5s %6s %10s %10s %6s %12s %8s %10s %6s" %
-              ('Pod source', 'host', 'step', 'hb-age(s)', 'ckpt(ms)',
-               'ckpt%', 'barrier(ms)', 'commits', 'abandoned', 'alive'))
+        print("%-24s %5s %6s %-16s %10s %10s %6s %12s %8s %10s %6s" %
+              ('Pod source', 'host', 'step', 'topology', 'hb-age(s)',
+               'ckpt(ms)', 'ckpt%', 'barrier(ms)', 'commits', 'abandoned',
+               'alive'))
         for rank in sorted(hosts):
             h = hosts[rank]
             age = h.get('age_s', float('inf'))
-            print("%-24s %5d %6d %10.2f %10.2f %6.2f %12.2f %8d %10d %6s" %
-                  (name[:24], rank, h.get('step', 0), age,
+            # topology (hosts x mesh axes) makes an elastic resize
+            # visible here: the new incarnation's heartbeats carry the
+            # NEW shape; stale-shape files from the old incarnation are
+            # ignored upstream by run_id/num_hosts
+            print("%-24s %5d %6d %-16s %10.2f %10.2f %6.2f %12.2f %8d "
+                  "%10d %6s" %
+                  (name[:24], rank, h.get('step', 0),
+                   str(h.get('topology', '-'))[:16], age,
                    h.get('ckpt_stall_ms', 0.0),
                    h.get('ckpt_stall_pct', 0.0),
                    h.get('barrier_ms', 0.0), h.get('commits', 0),

@@ -37,6 +37,105 @@ except ImportError:          # non-POSIX: no advisory locking available
     fcntl = None
 
 
+def read_journal_state(path, limit=None):
+    """Replay a task journal into its effective state — THE one copy of
+    the replay semantics, shared by TaskService recovery and the
+    topology-resize re-stride (reader/sharded.restride_journal).
+
+    `limit` reads only the first `limit` bytes: a checkpoint records
+    `journal_position()` at a step boundary, and replaying past it would
+    describe consumption the restored params never trained on. A torn
+    tail line (crash mid-append, or a limit landing mid-line — positions
+    are flushed line-aligned, so only real crashes produce one) is
+    ignored exactly like recovery always has.
+
+    Returns {'epoch', 'done': set, 'progress': {task: count},
+    'failures': {task: count}, 'dropped': set, 'meta': {}}."""
+    state = {'epoch': 0, 'done': set(), 'progress': {}, 'failures': {},
+             'dropped': set(), 'meta': {}}
+    if not path or not os.path.exists(path):
+        return state
+    with open(path, 'rb') as f:
+        raw = f.read() if limit is None else f.read(int(limit))
+    for line in raw.decode('utf-8', 'replace').splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue  # torn tail write from a crash
+        ev = rec.get('event')
+        if ev == 'epoch':
+            # epoch barrier: everything before it is history
+            state['done'].clear()
+            state['progress'].clear()
+            state['failures'].clear()
+            state['dropped'].clear()
+            state['epoch'] = rec.get('epoch', state['epoch'])
+        elif ev == 'done':
+            state['done'].add(rec['task'])
+            state['progress'].pop(rec['task'], None)
+        elif ev == 'progress':
+            state['progress'][rec['task']] = rec['count']
+        elif ev == 'failed':
+            state['failures'][rec['task']] = rec.get('count', 1)
+        elif ev == 'dropped':
+            # poison task hit the failure cap before a crash: a
+            # restart must not re-fail it max_failures more times
+            state['dropped'].add(rec['task'])
+        elif ev == 'meta':
+            state['meta'][rec['key']] = rec['value']
+    return state
+
+
+def merge_journal_states(states):
+    """Merge per-host journal states into ONE global epoch state — the
+    resize primitive: the union of N old hosts' journals describes the
+    whole pod's data consumption, which a new stride then partitions.
+
+    All states must agree on the epoch: pod checkpoints snapshot every
+    host at the SAME step boundary, so disagreement means the sources
+    are not one synchronized boundary (mixed incarnations, a journal
+    read past its checkpointed position) and silently merging them
+    would replay or lose chunks — refuse loudly instead. Disjoint
+    strides never journal the same task, but a lease-board reclaim can
+    (a survivor finishing a dead host's chunk): done wins over
+    progress, progress merges by max — consumption is monotonic."""
+    states = list(states)
+    if not states:
+        raise ValueError('merge_journal_states: no source states')
+    epochs = sorted({int(st['epoch']) for st in states})
+    if len(epochs) > 1:
+        raise ValueError(
+            'journals disagree on the epoch (%r): a topology resize '
+            'must merge journals captured at ONE synchronized step '
+            'boundary — check that every source is read at its '
+            "checkpoint-recorded position, not the file's tail"
+            % (epochs,))
+    merged = {'epoch': epochs[0], 'done': set(), 'progress': {},
+              'failures': {}, 'dropped': set(), 'meta': {}}
+    for st in states:
+        merged['done'] |= st['done']
+        merged['dropped'] |= st['dropped']
+        for t, c in st['progress'].items():
+            merged['progress'][t] = max(int(c),
+                                        merged['progress'].get(t, 0))
+        for t, c in st['failures'].items():
+            merged['failures'][t] = max(int(c),
+                                        merged['failures'].get(t, 0))
+        for k, v in st['meta'].items():
+            if k in merged['meta'] and merged['meta'][k] != v:
+                raise ValueError(
+                    'journals disagree on meta %r (%r vs %r) — resuming '
+                    'with incompatible settings mis-skips samples'
+                    % (k, merged['meta'][k], v))
+            merged['meta'][k] = v
+    for t in merged['done']:
+        merged['progress'].pop(t, None)
+    return merged
+
+
 class Lease(tuple):
     """(task_id, task, skip) plus a `.gen` lease generation. Reports that
     carry the generation are ignored when stale — a worker whose lease
@@ -152,38 +251,16 @@ class TaskService(object):
 
     # -- journal -----------------------------------------------------------
     def _recover(self, path):
-        if not os.path.exists(path):
-            return
-        with open(path) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue  # torn tail write from a crash
-                ev = rec.get('event')
-                if ev == 'epoch':
-                    # epoch barrier: everything before it is history
-                    self._done.clear()
-                    self._progress.clear()
-                    self._failures.clear()
-                    self._dropped.clear()
-                    self._epoch = rec.get('epoch', self._epoch)
-                elif ev == 'done':
-                    self._done.add(rec['task'])
-                    self._progress.pop(rec['task'], None)
-                elif ev == 'progress':
-                    self._progress[rec['task']] = rec['count']
-                elif ev == 'failed':
-                    self._failures[rec['task']] = rec.get('count', 1)
-                elif ev == 'dropped':
-                    # poison task hit the failure cap before a crash: a
-                    # restart must not re-fail it max_failures more times
-                    self._dropped.add(rec['task'])
-                elif ev == 'meta':
-                    self._meta[rec['key']] = rec['value']
+        """Recovery = the shared journal replay (read_journal_state) —
+        the resize re-stride writes journals through the same semantics,
+        so what it writes is exactly what a fresh service recovers."""
+        st = read_journal_state(path)
+        self._epoch = st['epoch']
+        self._done = st['done']
+        self._progress = st['progress']
+        self._failures = st['failures']
+        self._dropped = st['dropped']
+        self._meta.update(st['meta'])
         self._todo = [t for t in self._all
                       if t not in self._done and t not in self._dropped]
 

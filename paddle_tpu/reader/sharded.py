@@ -35,7 +35,7 @@ import time
 import warnings
 
 __all__ = ['shard_assignment', 'ShardedFileReader', 'pooled_map',
-           'WorkerDied', 'FeederStats']
+           'WorkerDied', 'FeederStats', 'build_tasks', 'restride_journal']
 
 
 def shard_assignment(items, num_shards, shard_id):
@@ -73,6 +73,118 @@ class ShardTask(object):
 
     def __repr__(self):
         return 'ShardTask(%s)' % str(self)
+
+
+def build_tasks(files, chunk_granular=True):
+    """The GLOBAL task list over a file set, in deterministic (file,
+    offset) order — THE one copy of the task-building rule, shared by
+    ShardedFileReader and the topology-resize re-stride so the two can
+    never disagree about task identity. `files` is a glob or list;
+    RecordIO files split into per-chunk tasks (header-only seek-table
+    scan; torn tails fail HERE, loudly), other files become whole-file
+    tasks."""
+    from .. import recordio as _rio
+    if isinstance(files, str):
+        files = sorted(_glob.glob(files))
+    files = list(files)
+    if not files:
+        raise ValueError("build_tasks: empty file set")
+    tasks = []
+    for path in files:
+        if chunk_granular and _rio.is_recordio(path):
+            for c in _rio.chunk_index(path):
+                tasks.append(ShardTask(path, c.offset, c.num_records))
+        else:
+            tasks.append(ShardTask(path))
+    return tasks
+
+
+def restride_journal(sources, files, num_shards, shard_id, out_path,
+                     chunk_granular=True, tasks=None):
+    """Re-stride the exactly-once data journal onto a NEW host count
+    (ISSUE 14): merge every OLD host's journal — each read only up to
+    its checkpoint-recorded position — into the pod's global epoch
+    state, partition that state by the NEW strided assignment, and
+    write this new shard's journal so the chunk-granular dispatch
+    continues exactly-once on N' != N hosts: done chunks never
+    re-dispatch, partially-delivered chunks resume at their delivered
+    position, and no chunk is lost.
+
+    sources: one entry per OLD host — (path, limit) or the checkpoint
+    meta dict {'path': ..., 'position': ...} straight from
+    PodCheckpointManager.restore()'s info['task_journals']. A missing
+    source journal is a loud error: silently merging N-1 of N journals
+    would re-dispatch (replay) every chunk the missing host consumed.
+
+    The write is atomic (tmp + os.replace): a crash mid-restride leaves
+    either the complete new journal or none, never a half state.
+    Returns {'epoch', 'total', 'done', 'progress', 'dropped'} counts
+    for this new shard."""
+    import json as _json
+    import os as _os
+    from .elastic import read_journal_state, merge_journal_states
+    states = []
+    for src in sources:
+        if isinstance(src, dict):
+            path, limit = src.get('path'), src.get('position')
+        elif src is None:
+            path, limit = None, None
+        else:
+            path, limit = src
+        if not path or not _os.path.exists(path):
+            raise ValueError(
+                "restride_journal: source journal %r is missing — "
+                "refusing to re-stride from a partial journal set (the "
+                "missing host's consumed chunks would silently replay); "
+                "every OLD host's journal (at its checkpoint-recorded "
+                "position) is required" % (path,))
+        states.append(read_journal_state(path, limit))
+    merged = merge_journal_states(states)
+    if tasks is None:
+        tasks = build_tasks(files, chunk_granular=chunk_granular)
+    task_ids = [str(t) for t in tasks]
+    known = set(task_ids)
+    unknown = sorted((merged['done'] | set(merged['progress'])
+                      | merged['dropped']) - known)
+    if unknown:
+        raise ValueError(
+            "restride_journal: old journals cover task(s) %r that the "
+            "current file set does not — the dataset changed under the "
+            "checkpoint; re-striding would mis-map the exactly-once "
+            "accounting" % (unknown[:4],))
+    mine = set(shard_assignment(task_ids, num_shards, shard_id))
+    tmp = '%s.%d.tmp' % (out_path, _os.getpid())
+    counts = {'epoch': merged['epoch'], 'total': len(mine), 'done': 0,
+              'progress': 0, 'dropped': 0}
+    with open(tmp, 'w') as f:
+        f.write(_json.dumps({'event': 'epoch',
+                             'epoch': merged['epoch']}) + '\n')
+        for k in sorted(merged['meta']):
+            f.write(_json.dumps({'event': 'meta', 'key': k,
+                                 'value': merged['meta'][k]}) + '\n')
+        for t in task_ids:          # deterministic task order
+            if t not in mine:
+                continue
+            if merged['failures'].get(t):
+                f.write(_json.dumps({'event': 'failed', 'task': t,
+                                     'count': merged['failures'][t],
+                                     'why': 'restride-carry'}) + '\n')
+            if t in merged['done']:
+                f.write(_json.dumps({'event': 'done', 'task': t}) + '\n')
+                counts['done'] += 1
+            elif t in merged['progress']:
+                f.write(_json.dumps({'event': 'progress', 'task': t,
+                                     'count': merged['progress'][t]})
+                        + '\n')
+                counts['progress'] += 1
+            if t in merged['dropped']:
+                f.write(_json.dumps({'event': 'dropped', 'task': t})
+                        + '\n')
+                counts['dropped'] += 1
+        f.flush()
+        _os.fsync(f.fileno())
+    _os.replace(tmp, out_path)
+    return counts
 
 
 class WorkerDied(Exception):
@@ -522,21 +634,11 @@ class ShardedFileReader(object):
                  lease_timeout_s=3600.0, max_failures=3,
                  progress_every=32, journal_limit=None, lease_dir=None,
                  holder_id=None, holder_timeout_s=30.0):
-        from .. import recordio as _rio
         from .elastic import TaskService
-        if isinstance(files, str):
-            files = sorted(_glob.glob(files))
-        files = list(files)
-        if not files:
-            raise ValueError("ShardedFileReader: empty file set")
-        tasks = []
-        for path in files:
-            if chunk_granular and _rio.is_recordio(path):
-                for c in _rio.chunk_index(path):  # torn tails fail HERE,
-                    # loudly, before any training starts
-                    tasks.append(ShardTask(path, c.offset, c.num_records))
-            else:
-                tasks.append(ShardTask(path))
+        # ONE task-building rule (build_tasks), shared with the resize
+        # re-stride; torn recordio tails fail loudly in the index scan,
+        # before any training starts
+        tasks = build_tasks(files, chunk_granular=chunk_granular)
         self.all_tasks = tasks
         self.tasks = shard_assignment(tasks, num_shards, shard_id)
         if not self.tasks:

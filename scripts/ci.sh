@@ -42,6 +42,9 @@ JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/crash_resume_smoke.py
 echo "== pod fault-tolerance smoke (2-process composed-mesh kill-one-host + full-pod resume in seconds off the warm compile cache; sharded two-phase checkpoints, stall < 2%, chaos --pod round with corruption) =="
 JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/pod_ft_smoke.py
 
+echo "== elastic resume smoke (topology-change restore: 4-host run killed mid-epoch, resumed on 2 AND 8 hosts with loss parity within float tolerance + exactly-once epoch digests; same-shape resume bit-exact with 0 resharding programs; chaos --resize round) =="
+JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/elastic_resume_smoke.py
+
 echo "== data plane smoke (sharded streaming input: serial-vs-pooled feeder A/B >=3x with bit-identical epochs, exactly-once journal resume, host-stall < 2% on the smallnet loop) =="
 JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/data_plane_smoke.py
 

@@ -1,0 +1,208 @@
+"""Elastic pod resize smoke (ISSUE 14, wired into ci.sh).
+
+1. An uninterrupted 4-host composed-mesh reference run over the sharded
+   data plane (dp spans hosts x mp within; exactly-once chunk journal):
+   losses replicated across hosts, per-step record sets recorded.
+2. The same pod killed MID-EPOCH at a committed boundary (victim waits
+   for POD_COMMIT, survivors exit through the heartbeat watchdog).
+3. Resume on 2 hosts AND on 8 hosts (fresh copies of the checkpoint
+   dir): topology-change restore reshards the stitched global state to
+   each new mesh, the data journal re-strides onto the new host count —
+   loss trajectory within float-accumulation tolerance of the
+   reference, per-step record SETS identical, every epoch's sample
+   accounting exactly-once (digest over the effective history).
+4. Same-shape (4-host) resume stays on the bit-exact fast path: ZERO
+   resharding programs, losses and final params digest BIT-match the
+   reference.
+5. tools/chaos.py --pod 2 --resize round (randomized kill/resize).
+
+Bounded wall time: the whole smoke must finish inside BUDGET_S.
+"""
+import importlib.util
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_spec = importlib.util.spec_from_file_location(
+    'ptpu_chaos', os.path.join(REPO, 'tools', 'chaos.py'))
+chaos = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(chaos)
+
+BUDGET_S = 900.0
+TOTAL, EVERY, KILL_AT = 12, 2, 6       # 4 steps/epoch: step 6 is mid-epoch
+T_START = time.time()
+
+# the 8-host arm runs 8 gloo processes on a 2-core CI box: a first-step
+# XLA compile can hold a worker's GIL long enough to starve its
+# heartbeat thread past the default 8s and false-positive the watchdog.
+# Detection latency is pod_ft_smoke's metric, not this smoke's — give
+# liveness room to breathe under 4x oversubscription.
+os.environ.setdefault('PTPU_POD_HB_TIMEOUT', '25')
+
+
+def main():
+    work = tempfile.mkdtemp(prefix='ptpu-elastic-smoke-')
+    cache = os.path.join(work, 'compile-cache')
+    data = os.path.join(work, 'data.rio')
+    ckpt = os.path.join(work, 'ckpts')
+
+    def fail(msg):
+        print('[elastic-smoke] FAIL: %s (workdir kept at %s)'
+              % (msg, work))
+        return 1
+
+    outs = lambda tag, n: [os.path.join(work, '%s-r%d.txt' % (tag, r))  # noqa: E731,E501
+                           for r in range(n)]
+
+    r = subprocess.run([sys.executable, chaos.ELASTIC_WORKER,
+                        '--make-data', data, '64'], capture_output=True,
+                       text=True, cwd=REPO, timeout=240)
+    if r.returncode != 0:
+        return fail('dataset build failed:\n%s' % r.stderr[-1500:])
+    dataset = [l.strip() for l in open(data + '.hashes') if l.strip()]
+
+    # 1) uninterrupted 4-host reference
+    t0 = time.time()
+    res = chaos.run_pod(os.path.join(work, 'ref-ck'), outs('ref', 4),
+                        TOTAL, EVERY, cache_dir=cache, timeout=400,
+                        worker=chaos.ELASTIC_WORKER, data_file=data)
+    if any(rc != 0 for rc, _ in res):
+        return fail('reference run failed:\n%s'
+                    % '\n'.join(e[-1200:] for _, e in res))
+    refs = [chaos.read_elastic_out(p) for p in outs('ref', 4)]
+    for i in range(1, 4):
+        if refs[i]['losses'] != refs[0]['losses']:
+            return fail('reference: replicated losses differ between '
+                        'hosts 0 and %d' % i)
+    failures = []
+    _collect = lambda msg: (failures.append(msg), 1)[1]  # noqa: E731
+    _err, ref_recs = chaos.merge_pod_recs(refs, _collect)
+    if failures:
+        return fail(failures[0])
+    print('[elastic-smoke] reference: 4 hosts, %d steps, %d records/'
+          'epoch  %.1fs' % (len(refs[0]['losses']), 64,
+                            time.time() - t0))
+
+    # 2) kill the 4-host pod mid-epoch at a committed boundary
+    t0 = time.time()
+    res = chaos.run_pod(ckpt, outs('kill', 4), TOTAL, EVERY,
+                        kill_rank=2, kill_at=KILL_AT, cache_dir=cache,
+                        timeout=400, worker=chaos.ELASTIC_WORKER,
+                        data_file=data)
+    if res[2][0] != -signal.SIGKILL:
+        return fail('victim exited %s, expected SIGKILL' % res[2][0])
+    if any('WEDGED' in err for _, err in res):
+        return fail('a survivor never detected the dead host')
+    killed = [chaos.read_elastic_out(p) for p in outs('kill', 4)]
+    print('[elastic-smoke] kill: victim h2 SIGKILLed at the committed '
+          'step-%d boundary (mid-epoch), survivors exited in bounded '
+          'time  %.1fs' % (KILL_AT, time.time() - t0))
+
+    # 3) resume the SAME checkpoint on 2 and on 8 hosts. The 8-host and
+    # same-shape arms run from COPIES; the 2-host arm then runs from a
+    # MOVE of the original tree — proving a relocated checkpoint dir
+    # (original path gone, journals carried inside the tree) still
+    # re-strides and resumes.
+    arms = {8: os.path.join(work, 'ck-resume-8'),
+            4: os.path.join(work, 'ck-resume-same'),
+            2: os.path.join(work, 'ck-resume-2')}
+    shutil.copytree(ckpt, arms[8])
+    shutil.copytree(ckpt, arms[4])
+    shutil.move(ckpt, arms[2])
+    table = []
+    for new_n in (2, 8):
+        arm = arms[new_n]
+        t0 = time.time()
+        res = chaos.run_pod(arm, outs('re%d' % new_n, new_n), TOTAL,
+                            EVERY, cache_dir=cache, timeout=500,
+                            worker=chaos.ELASTIC_WORKER, data_file=data)
+        wall = time.time() - t0
+        if any(rc != 0 for rc, _ in res):
+            return fail('resume on %d hosts failed:\n%s'
+                        % (new_n, '\n'.join(e[-1200:] for _, e in res)))
+        resumed = [chaos.read_elastic_out(p)
+                   for p in outs('re%d' % new_n, new_n)]
+        resume_at = resumed[0]['resume']
+        for i, o in enumerate(resumed):
+            if o['resume'] != resume_at or not resume_at \
+                    or resume_at > KILL_AT:
+                return fail('resume@%d host %d resumed at %s'
+                            % (new_n, i, o['resume']))
+            if o['topo'] != (4, new_n):
+                return fail('resume@%d host %d topo %r' % (new_n, i,
+                                                           o['topo']))
+            if o['reshard'][0] < 1 or o['restride'] is None:
+                return fail('resume@%d host %d: reshard/restride did '
+                            'not engage (%r/%r)'
+                            % (new_n, i, o['reshard'], o['restride']))
+        err = chaos.check_resize_round(
+            refs[0]['losses'], ref_recs, killed, resumed, resume_at,
+            TOTAL, dataset, _collect, 'resume@%d' % new_n)
+        if err is not None or failures:
+            return fail(failures[0] if failures else 'resume@%d' % new_n)
+        rs = resumed[0]['reshard']
+        table.append((new_n, resume_at, rs[1], rs[2], rs[3],
+                      resumed[0]['losses'][resume_at], wall))
+        print('[elastic-smoke] resume on %d hosts: committed step %d, '
+              'reshard %d arrays (stitch %.0f ms, place %.0f ms), loss '
+              'parity within tolerance, epochs exactly-once  %.1fs'
+              % (new_n, resume_at, rs[1], rs[2] * 1e3, rs[3] * 1e3,
+                 wall))
+
+    # 4) same-shape resume stays bit-exact with ZERO resharding programs
+    # (also from a relocated copy: the original tree moved away above)
+    t0 = time.time()
+    res = chaos.run_pod(arms[4], outs('re4', 4), TOTAL, EVERY,
+                        cache_dir=cache, timeout=400,
+                        worker=chaos.ELASTIC_WORKER, data_file=data)
+    if any(rc != 0 for rc, _ in res):
+        return fail('same-shape resume failed:\n%s'
+                    % '\n'.join(e[-1200:] for _, e in res))
+    fins = [chaos.read_elastic_out(p) for p in outs('re4', 4)]
+    for i, o in enumerate(fins):
+        if o['topo'] != (4, 4):
+            return fail('same-shape host %d topo %r' % (i, o['topo']))
+        if o['reshard'][0] != 0 or o['reshard'][1] != 0:
+            return fail('same-shape resume compiled %d resharding '
+                        'program(s) — the fast path regressed'
+                        % o['reshard'][0])
+        for s, v in o['losses'].items():
+            if v != refs[0]['losses'].get(s):
+                return fail('same-shape host %d: loss at step %d not '
+                            'BIT-equal after resume' % (i, s))
+        if o['sha'] != refs[i]['sha']:
+            return fail('same-shape host %d: params digest diverged' % i)
+    print('[elastic-smoke] same-shape resume: bit-exact fast path, 0 '
+          'resharding programs, params digest matches the reference  '
+          '%.1fs' % (time.time() - t0))
+
+    # 5) randomized chaos resize round
+    rc = chaos.main(['--pod', '2', '--resize', '--rounds', '1',
+                     '--total', '12', '--every', '2', '--seed', '14',
+                     '--resize-counts', '1,2,4'])
+    if rc != 0:
+        return fail('chaos --resize exited %d' % rc)
+
+    wall = time.time() - T_START
+    if wall > BUDGET_S:
+        return fail('smoke exceeded its wall-time budget: %.0fs > %.0fs'
+                    % (wall, BUDGET_S))
+    print('[elastic-smoke] resharding cost table '
+          '(hosts, resume_step, arrays, stitch_s, place_s, '
+          'first_loss, wall_s):')
+    for row in table:
+        print('[elastic-smoke]   %r' % (row,))
+    shutil.rmtree(work, ignore_errors=True)
+    print('[elastic-smoke] OK (%.0fs total)' % wall)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

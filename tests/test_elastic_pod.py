@@ -1,0 +1,579 @@
+"""Elastic pod resizing (ISSUE 14): restore a pod checkpoint onto a
+DIFFERENT topology.
+
+Units drive the three layers separately: journal re-striding
+(reader/elastic.read_journal_state + merge, reader/sharded.
+restride_journal), the shared state-sharding rule + divisibility gate
+(parallel/reshard.py), and PodCheckpointManager's topology-change
+restore (duck-typed pods, no jax.distributed needed). The same-shape
+fast path is PINNED: zero resharding programs, byte-identical params.
+The subprocess test runs the real thing — a 2-process composed-mesh
+run with a sharded data journal killed at a committed boundary and
+resumed on ONE host: loss trajectory within float-accumulation
+tolerance of the uninterrupted 2-host reference, per-step record sets
+identical, every epoch's sample accounting exactly-once.
+"""
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core.checkpoint import (
+    PodCheckpointManager, pod_verify, read_heartbeats)
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.parallel.reshard import (
+    ReshardError, check_reshardable, nearest_valid_sizes,
+    reshard_stats, reset_reshard_stats, state_shardings_for)
+from paddle_tpu.reader.elastic import (
+    TaskService, merge_journal_states, read_journal_state)
+from paddle_tpu.reader.sharded import restride_journal, shard_assignment
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _chaos():
+    spec = importlib.util.spec_from_file_location(
+        'ptpu_chaos_e', os.path.join(REPO, 'tools', 'chaos.py'))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# journal replay / merge / re-stride
+# ---------------------------------------------------------------------------
+def _write_journal(path, events):
+    with open(path, 'w') as f:
+        for ev in events:
+            f.write(json.dumps(ev) + '\n')
+
+
+def test_read_journal_state_replays_and_respects_limit(tmp_path):
+    p = str(tmp_path / 'j.jsonl')
+    evs = [{'event': 'epoch', 'epoch': 2},
+           {'event': 'done', 'task': 'a'},
+           {'event': 'progress', 'task': 'b', 'count': 3},
+           {'event': 'meta', 'key': 'bs', 'value': 16},
+           {'event': 'done', 'task': 'b'}]
+    _write_journal(p, evs)
+    st = read_journal_state(p)
+    assert st['epoch'] == 2 and st['done'] == {'a', 'b'}
+    assert st['progress'] == {} and st['meta'] == {'bs': 16}
+    # limit = everything before the final done: b is still in progress,
+    # exactly the state a checkpoint at that position described
+    limit = sum(len(json.dumps(e)) + 1 for e in evs[:-1])
+    st = read_journal_state(p, limit=limit)
+    assert st['done'] == {'a'} and st['progress'] == {'b': 3}
+    # a limit landing mid-line drops the torn record, like crash recovery
+    st = read_journal_state(p, limit=limit + 3)
+    assert st['done'] == {'a'}
+    # an epoch event resets everything before it
+    _write_journal(p, evs + [{'event': 'epoch', 'epoch': 3}])
+    st = read_journal_state(p)
+    assert st['epoch'] == 3 and not st['done'] and not st['progress']
+
+
+def test_merge_journal_states_epoch_and_meta_guards():
+    a = read_journal_state(None)
+    b = read_journal_state(None)
+    a['done'].add('t0')
+    b['progress']['t1'] = 4
+    merged = merge_journal_states([a, b])
+    assert merged['done'] == {'t0'} and merged['progress'] == {'t1': 4}
+    # done wins over progress (lease-board reclaim overlap)
+    b['progress']['t0'] = 2
+    assert merge_journal_states([a, b])['progress'] == {'t1': 4}
+    b['epoch'] = 1
+    with pytest.raises(ValueError, match='disagree on the epoch'):
+        merge_journal_states([a, b])
+    b['epoch'] = 0
+    a['meta']['bs'] = 16
+    b['meta']['bs'] = 32
+    with pytest.raises(ValueError, match="meta 'bs'"):
+        merge_journal_states([a, b])
+
+
+def test_restride_journal_maps_old_stride_onto_new(tmp_path):
+    """4 old hosts' journals at a synchronized boundary re-stride onto 2
+    and onto 8 shards: done chunks stay done exactly once, the one
+    mid-chunk progress position survives, nothing is lost."""
+    tasks = ['c%02d' % i for i in range(16)]
+    # old pod: 4 hosts, host r owns tasks r::4; the pod consumed the
+    # first 8 chunks (2 per host) and host 1 is 5 records into c05
+    olds = []
+    for r in range(4):
+        p = str(tmp_path / ('old-%d.jsonl' % r))
+        evs = [{'event': 'epoch', 'epoch': 1}]
+        mine = tasks[r::4]
+        evs += [{'event': 'done', 'task': t} for t in mine[:2]]
+        if r == 1:
+            evs.append({'event': 'progress', 'task': 'c09', 'count': 5})
+        _write_journal(p, evs)
+        olds.append((p, None))
+    consumed = {t for r in range(4) for t in tasks[r::4][:2]}
+    for new_n in (2, 8):
+        seen_done, seen_prog = set(), {}
+        for shard in range(new_n):
+            out = str(tmp_path / ('new-%d-of-%d.jsonl' % (shard, new_n)))
+            counts = restride_journal(olds, None, new_n, shard, out,
+                                      tasks=tasks)
+            st = read_journal_state(out)
+            assert st['epoch'] == 1
+            assert counts['total'] == len(tasks) // new_n
+            mine = set(shard_assignment(tasks, new_n, shard))
+            assert st['done'] == consumed & mine
+            assert set(st['progress']) == {'c09'} & mine
+            assert not (seen_done & st['done'])    # disjoint cover
+            seen_done |= st['done']
+            seen_prog.update(st['progress'])
+        assert seen_done == consumed               # nothing lost
+        assert seen_prog == {'c09': 5}
+        # a fresh TaskService over the new stride dispatches exactly the
+        # unconsumed remainder, resuming c09 at its delivered position
+        svc = TaskService(
+            shard_assignment(tasks, new_n, 0),
+            journal_path=str(tmp_path / ('new-0-of-%d.jsonl' % new_n)))
+        todo = {}
+        while True:
+            lease = svc.get_task()
+            if lease is None:
+                break
+            todo[lease[0]] = lease[2]
+        svc.close()
+        expect = {t: (5 if t == 'c09' else 0)
+                  for t in shard_assignment(tasks, new_n, 0)
+                  if t not in consumed}
+        assert todo == expect
+
+
+def test_restride_journal_guards(tmp_path):
+    tasks = ['a', 'b']
+    good = str(tmp_path / 'good.jsonl')
+    _write_journal(good, [{'event': 'done', 'task': 'a'}])
+    out = str(tmp_path / 'out.jsonl')
+    with pytest.raises(ValueError, match='missing'):
+        restride_journal([(good, None), (str(tmp_path / 'nope'), None)],
+                         None, 1, 0, out, tasks=tasks)
+    with pytest.raises(ValueError, match='missing'):
+        restride_journal([(good, None), None], None, 1, 0, out,
+                         tasks=tasks)
+    bad = str(tmp_path / 'bad.jsonl')
+    _write_journal(bad, [{'event': 'done', 'task': 'zz'}])
+    with pytest.raises(ValueError, match='file set does not'):
+        restride_journal([(good, None), (bad, None)], None, 1, 0, out,
+                         tasks=tasks)
+    # atomic: the failed attempts left no half-written journal behind
+    assert not os.path.exists(out)
+
+
+# ---------------------------------------------------------------------------
+# the shared sharding rule + the divisibility gate
+# ---------------------------------------------------------------------------
+def test_nearest_valid_sizes():
+    assert nearest_valid_sizes(32, 3) == (2, 4)
+    assert nearest_valid_sizes(32, 8) == (8, 8)
+    assert nearest_valid_sizes(5, 2) == (1, 5)
+    assert nearest_valid_sizes(7, 9) == (7, 7)
+
+
+def test_check_reshardable_names_param_and_nearest_counts():
+    from paddle_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(num_devices=3, axes={'dp': 3})
+    with pytest.raises(ReshardError) as e:
+        check_reshardable({'fc2_w': (32, 5)}, {'fc2_w': ('dp', None)},
+                          mesh, old_num_hosts=4, new_num_hosts=3)
+    msg = str(e.value)
+    assert "'fc2_w'" in msg and 'not divisible' in msg
+    assert '2 (shrink) / 4 (grow)' in msg
+    assert '4-host checkpoint onto 3 host' in msg
+    # divisible shapes pass silently
+    check_reshardable({'fc2_w': (33, 5)}, {'fc2_w': ('dp', None)}, mesh)
+
+
+def test_state_shardings_for_slot_inheritance():
+    """The factored rule (parallel/reshard.py) behaves exactly like the
+    executor's dispatch-time assignment: annotated params shard,
+    same-shape prefix-named optimizer slots inherit, everything else
+    replicates."""
+    import paddle_tpu as fluid
+    from paddle_tpu.parallel import shard_parameter
+    from paddle_tpu.parallel.mesh import make_mesh
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup_p):
+        x = fluid.layers.data(name='x', shape=[16], dtype='float32')
+        y = fluid.layers.fc(x, size=32,
+                            param_attr=fluid.ParamAttr(name='fcw'))
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.Momentum(learning_rate=0.1,
+                                 momentum=0.9).minimize(loss)
+    shard_parameter(main_p.global_block().var('fcw'), (None, 'mp'))
+    mesh = make_mesh(num_devices=4, axes={'dp': 2, 'mp': 2})
+    names = sorted(v.name for v in main_p.list_vars() if v.persistable)
+    shardings, specs = state_shardings_for(main_p, mesh, names)
+    slot = [n for n in names if n.startswith('fcw_velocity')]
+    assert slot, names
+    assert specs['fcw'] == (None, 'mp')
+    assert specs[slot[0]] == (None, 'mp')       # inherited
+    rep = [n for n in names if n not in specs]
+    assert rep and all(shardings[n].spec == () for n in rep)
+
+
+# ---------------------------------------------------------------------------
+# topology-change restore (duck-typed pods, as in test_pod_ft)
+# ---------------------------------------------------------------------------
+class FakeVar(object):
+    def __init__(self, name):
+        self.name, self.persistable = name, True
+
+
+class FakeProgram(object):
+    _uid = 5150
+    random_seed = 7
+
+    def __init__(self, names=('w', 'b')):
+        self._names = names
+
+    def list_vars(self):
+        return [FakeVar(n) for n in self._names]
+
+
+class _Dev(object):
+    def __init__(self, pi):
+        self.process_index = pi
+
+
+class _Sharding(object):
+    def __init__(self, imap):
+        self._imap = imap
+
+    def devices_indices_map(self, shape):
+        return self._imap
+
+
+class _Shard(object):
+    def __init__(self, idx, data):
+        self.index, self.data = idx, data
+
+
+class FakeGlobal(object):
+    is_fully_addressable = False
+
+    def __init__(self, shape, shards, imap):
+        self.shape = shape
+        self.addressable_shards = shards
+        self.sharding = _Sharding(imap)
+
+
+FULL_W = np.arange(16, dtype=np.float32).reshape(4, 4)
+
+
+def scope_for(rank):
+    sc = Scope()
+    top = _Shard((slice(0, 2), slice(None)), FULL_W[:2])
+    bot = _Shard((slice(2, 4), slice(None)), FULL_W[2:])
+    imap = {_Dev(0): (slice(0, 2), slice(None)),
+            _Dev(1): (slice(2, 4), slice(None))}
+    sc.set('w', FakeGlobal((4, 4), [top] if rank == 0 else [bot], imap))
+    sc.set('b', np.full((3,), 1.5, np.float32))
+    return sc
+
+
+def save_two_host_pod(tmp_path, with_journals=False):
+    d = str(tmp_path / 'ckpts')
+    mgrs = [PodCheckpointManager(d, rank=r, num_hosts=2, run_id='run-1',
+                                 commit_timeout_s=10,
+                                 topology={'dp': 2, 'mp': 1})
+            for r in range(2)]
+    if with_journals:
+        for r, m in enumerate(mgrs):
+            class _TS(object):
+                _journal_path = str(tmp_path / ('j%d.jsonl' % r))
+                epoch = 1
+
+                def journal_position(self):
+                    return 42 + 10 * int(self._journal_path[-7])
+            m.task_service = _TS()
+    prog = FakeProgram()
+    for r, m in enumerate(mgrs):
+        m.save(prog, scope_for(r), 4)
+    for m in mgrs:
+        m.flush()
+        m.close()
+    return d
+
+
+def test_shape_change_restore_assembles_and_reports(tmp_path):
+    """A 1-host pod restores a 2-host checkpoint: global arrays
+    reassemble from the cross-host shard manifests, the info reports
+    the old topology and EVERY old host's task-journal position (the
+    re-stride inputs)."""
+    d = save_two_host_pod(tmp_path, with_journals=True)
+    one = PodCheckpointManager(d, rank=0, num_hosts=1, run_id='run-2',
+                               commit_timeout_s=10)
+    sc = Scope()
+    reset_reshard_stats()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter('always')
+        info = one.restore(scope=sc)
+    assert any('topology-change restore' in str(x.message) for x in w)
+    assert info['step'] == 4
+    assert info['pod_num_hosts'] == 2 and info['resharded'] is True
+    np.testing.assert_array_equal(np.asarray(sc.get('w')), FULL_W)
+    np.testing.assert_array_equal(
+        np.asarray(sc.get('b')), np.full((3,), 1.5, np.float32))
+    tjs = info['task_journals']
+    assert sorted(tjs) == [0, 1]
+    assert tjs[0]['position'] == 42 and tjs[1]['position'] == 52
+    # without a program/mesh no resharding program runs — the executor
+    # reshards at first dispatch
+    assert reshard_stats['programs'] == 0
+    one.close()
+
+
+def test_same_shape_restore_stays_on_bit_exact_fast_path(tmp_path):
+    """REGRESSION PIN (ISSUE 14 satellite): same-shape restore takes
+    today's path — zero resharding programs, byte-identical params —
+    so topology-change resume can never tax the common case."""
+    d = save_two_host_pod(tmp_path)
+    reset_reshard_stats()
+    for r in range(2):
+        m = PodCheckpointManager(d, rank=r, num_hosts=2, run_id='run-2',
+                                 commit_timeout_s=10)
+        sc = Scope()
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter('always')
+            info = m.restore(scope=sc)
+        assert not any('topology-change' in str(x.message) for x in w)
+        assert info['resharded'] is False and info['pod_num_hosts'] == 2
+        got = np.asarray(sc.get('w'))
+        assert isinstance(got, np.ndarray)
+        assert got.tobytes() == FULL_W.tobytes()      # BYTE-identical
+        m.close()
+    assert reshard_stats['programs'] == 0
+    assert reshard_stats['arrays'] == 0
+
+
+def test_shape_change_restore_reshards_onto_real_mesh(tmp_path):
+    """With a program + mesh, the restore places the assembled state on
+    the NEW mesh through the resharding program (counted), values
+    intact."""
+    import paddle_tpu as fluid
+    from paddle_tpu.parallel import shard_parameter
+    from paddle_tpu.parallel.mesh import make_mesh
+    d = save_two_host_pod(tmp_path)
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup_p):
+        w = fluid.layers.create_parameter([4, 4], 'float32', name='w')
+    shard_parameter(main_p.global_block().var('w'), ('dp', None))
+    mesh = make_mesh(num_devices=2, axes={'dp': 2})
+    one = PodCheckpointManager(d, rank=0, num_hosts=1, run_id='run-2',
+                               commit_timeout_s=10)
+    sc = Scope()
+    reset_reshard_stats()
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter('always')
+        info = one.restore(program=main_p, scope=sc, mesh=mesh)
+    assert info['resharded'] is True
+    assert reshard_stats['programs'] == 1
+    assert info['reshard']['arrays'] == 1
+    got = sc.get('w')
+    import jax
+    assert isinstance(got, jax.Array)
+    assert dict(got.sharding.mesh.shape) == {'dp': 2}
+    np.testing.assert_array_equal(np.asarray(got), FULL_W)
+    one.close()
+
+
+def test_shape_change_restore_impossible_reshard_is_loud(tmp_path):
+    """The ISSUE-14 satellite: an axis that does not divide the new
+    mesh raises the actionable ReshardError instead of a bare XLA shape
+    error at first dispatch."""
+    import paddle_tpu as fluid
+    from paddle_tpu.parallel import shard_parameter
+    from paddle_tpu.parallel.mesh import make_mesh
+    d = save_two_host_pod(tmp_path)
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup_p):
+        fluid.layers.create_parameter([4, 4], 'float32', name='w')
+    shard_parameter(main_p.global_block().var('w'), ('dp', None))
+    mesh = make_mesh(num_devices=3, axes={'dp': 3})
+    one = PodCheckpointManager(d, rank=0, num_hosts=3, run_id='run-2',
+                               commit_timeout_s=10)
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter('always')
+        with pytest.raises(ReshardError) as e:
+            one.restore(program=main_p, scope=Scope(), mesh=mesh)
+    assert "'w'" in str(e.value)
+    assert '2-host checkpoint onto 3 host' in str(e.value)
+    one.close()
+
+
+def test_retention_protects_old_topology_checkpoints(tmp_path):
+    """REGRESSION PIN: after a resize, committed OLD-topology
+    checkpoints are restorable by the elastic restore() and must count
+    toward — and be protected by — the keep budget, not evicted as dead
+    partials on the first new-topology commit."""
+    from paddle_tpu.core.checkpoint import list_checkpoints
+    d = save_two_host_pod(tmp_path)              # 2-host committed ckpt-4
+    one = PodCheckpointManager(d, rank=0, num_hosts=1, run_id='run-2',
+                               commit_timeout_s=10, keep_last_n=3)
+    sc = Scope()
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter('always')
+        info = one.restore(scope=sc)
+    assert info['pod_num_hosts'] == 2
+    prog = FakeProgram(names=('b',))
+    sc2 = Scope()
+    sc2.set('b', np.arange(3, dtype=np.float32))
+    one.save(prog, sc2, 8)                       # first 1-host commit
+    one.flush()
+    steps = [s for s, _ in list_checkpoints(d)]
+    assert steps == [4, 8], steps                # old-shape ckpt-4 kept
+    pod_verify(os.path.join(d, 'ckpt-4'), None)  # still restorable
+    # and a re-save at the OLD committed step must keep the committed
+    # old-shape checkpoint (same history), not rewrite it in place
+    one.save(prog, sc2, 4)
+    one.flush()
+    pod, _m = pod_verify(os.path.join(d, 'ckpt-4'), None)
+    assert int(pod['num_hosts']) == 2            # untouched
+    one.close()
+
+
+def test_same_host_count_mesh_axes_change_engages_reshard(tmp_path):
+    """dp=2,mp=1 -> dp=1,mp=2 at the SAME host count is still a
+    topology change: the fast path would skip the divisibility gate."""
+    d = save_two_host_pod(tmp_path)     # topology '2h x dp=2,mp=1'
+    m = PodCheckpointManager(d, rank=0, num_hosts=2, run_id='run-2',
+                             commit_timeout_s=10,
+                             topology={'dp': 1, 'mp': 2})
+    sc = Scope()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter('always')
+        info = m.restore(scope=sc)
+    assert info['resharded'] is True
+    assert any('topology-change restore' in str(x.message) for x in w)
+    np.testing.assert_array_equal(np.asarray(sc.get('w')), FULL_W)
+    m.close()
+    # a manager that did NOT record axes cannot judge an axes change:
+    # host-count comparison only, today's bit-exact fast path
+    m2 = PodCheckpointManager(d, rank=1, num_hosts=2, run_id='run-3',
+                              commit_timeout_s=10)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter('always')
+        info = m2.restore(scope=Scope())
+    assert info['resharded'] is False
+    assert not any('topology-change' in str(x.message) for x in w)
+    m2.close()
+
+
+def test_pod_verify_still_strict_and_commit_consistent(tmp_path):
+    d = save_two_host_pod(tmp_path)
+    path = os.path.join(d, 'ckpt-4')
+    with pytest.raises(ValueError, match='pod shape changed'):
+        pod_verify(path, num_hosts=4)
+    pod, manifests = pod_verify(path, num_hosts=2)
+    assert pod['topology'] == '2h x dp=2,mp=1'
+    # a POD_COMMIT whose host list disagrees with num_hosts is corrupt
+    pc = os.path.join(path, 'POD_COMMIT.json')
+    rec = json.load(open(pc))
+    rec['num_hosts'] = 3
+    open(pc, 'w').write(json.dumps(rec))
+    with pytest.raises(ValueError, match='inconsistent|pod shape'):
+        pod_verify(path)
+
+
+def test_heartbeat_payload_carries_topology(tmp_path):
+    mgr = PodCheckpointManager(str(tmp_path / 'ck'), rank=0, num_hosts=2,
+                               run_id='r1', heartbeat_interval_s=0.05,
+                               topology={'dp': 2, 'mp': 2})
+    try:
+        deadline = time.time() + 5
+        beats = {}
+        while time.time() < deadline:
+            beats = read_heartbeats(mgr.dirname, 2)
+            if beats:
+                break
+            time.sleep(0.02)
+        assert beats[0]['topology'] == '2h x dp=2,mp=2'
+        from paddle_tpu import profiler
+        import io
+        import contextlib
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            profiler.pod_report()
+        text = buf.getvalue()
+        assert 'topology' in text and '2h x dp=2,mp=2' in text
+    finally:
+        mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# the real thing: 2-host composed-mesh run, killed at a committed
+# boundary, resumed on ONE host (shrink) with the journal re-strided
+# ---------------------------------------------------------------------------
+def test_resize_2_hosts_to_1_parity_and_exactly_once(tmp_path):
+    chaos = _chaos()
+    work = str(tmp_path)
+    cache = os.path.join(work, 'compile-cache')
+    data = os.path.join(work, 'data.rio')
+    r = subprocess.run([sys.executable, chaos.ELASTIC_WORKER,
+                        '--make-data', data, '64'], capture_output=True,
+                       text=True, cwd=REPO, timeout=240)
+    assert r.returncode == 0, r.stderr[-2000:]
+    dataset = [l.strip() for l in open(data + '.hashes') if l.strip()]
+    outs = lambda tag, n: [os.path.join(work, '%s-r%d.txt' % (tag, i))  # noqa: E731,E501
+                           for i in range(n)]
+
+    # uninterrupted 2-host reference
+    res = chaos.run_pod(os.path.join(work, 'ref-ck'), outs('ref', 2),
+                        total=8, every=2, cache_dir=cache, timeout=280,
+                        worker=chaos.ELASTIC_WORKER, data_file=data)
+    assert all(rc == 0 for rc, _ in res), \
+        '\n'.join(e[-1500:] for _, e in res)
+    refs = [chaos.read_elastic_out(p) for p in outs('ref', 2)]
+    assert refs[0]['losses'] == refs[1]['losses']
+    assert len(refs[0]['losses']) == 8
+
+    failures = []
+
+    def fail(msg):
+        failures.append(msg)
+        return 1
+
+    _err, ref_recs = chaos.merge_pod_recs(refs, fail)
+    assert not failures, failures
+
+    # kill the 2-host pod at the committed step-4 boundary
+    ckpt = os.path.join(work, 'ck')
+    res = chaos.run_pod(ckpt, outs('kill', 2), total=8, every=2,
+                        kill_rank=1, kill_at=4, cache_dir=cache,
+                        timeout=280, worker=chaos.ELASTIC_WORKER,
+                        data_file=data)
+    assert res[1][0] == -signal.SIGKILL
+    assert not any('WEDGED' in err for _, err in res)
+    killed = [chaos.read_elastic_out(p) for p in outs('kill', 2)]
+
+    # resume on ONE host: reshard + journal re-stride engage
+    res = chaos.run_pod(ckpt, outs('fin', 1), total=8, every=2,
+                        cache_dir=cache, timeout=280,
+                        worker=chaos.ELASTIC_WORKER, data_file=data)
+    assert all(rc == 0 for rc, _ in res), \
+        '\n'.join(e[-1500:] for _, e in res)
+    fin = chaos.read_elastic_out(outs('fin', 1)[0])
+    resume = fin['resume']
+    assert resume and resume % 2 == 0 and resume <= 4, fin
+    assert fin['topo'] == (2, 1)
+    assert fin['reshard'][0] >= 1, 'resharding path did not engage'
+    assert fin['restride'] is not None
+
+    err = chaos.check_resize_round(
+        refs[0]['losses'], ref_recs, killed, [fin], resume, 8, dataset,
+        fail, 'resize-2to1')
+    assert err is None and not failures, failures

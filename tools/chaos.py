@@ -147,12 +147,14 @@ def _free_port():
 
 
 def run_pod(ckpt_dir, out_paths, total, every, kill_rank=None, kill_at=0,
-            cache_dir=None, timeout=600):
+            cache_dir=None, timeout=600, worker=None, data_file=None):
     """One pod incarnation: len(out_paths) worker processes joined through
     a fresh coordinator + run id. Returns [(returncode, stderr)] per
     rank; a process that outlives `timeout` (wedged survivor whose
     watchdog failed) is SIGKILLed — that is itself a detection failure
-    the caller flags."""
+    the caller flags. With `data_file` the elastic worker contract is
+    used (DATA_FILE argv slot, no MIN_POD_COMMITS — the victim waits for
+    its exact boundary's POD_COMMIT)."""
     import uuid
     n = len(out_paths)
     port, run_id = _free_port(), uuid.uuid4().hex
@@ -171,10 +173,12 @@ def run_pod(ckpt_dir, out_paths, total, every, kill_rank=None, kill_at=0,
         if cache_dir:
             env['PTPU_COMPILE_CACHE'] = '1'
             env['PTPU_COMPILE_CACHE_DIR'] = cache_dir
-        argv = [sys.executable, POD_WORKER, ckpt_dir, out_paths[rank],
-                str(total), str(every)]
+        argv = [sys.executable, worker or POD_WORKER, ckpt_dir]
+        if data_file:
+            argv.append(data_file)
+        argv += [out_paths[rank], str(total), str(every)]
         if kill_rank == rank:
-            argv += [str(kill_at), '1']
+            argv += [str(kill_at)] if data_file else [str(kill_at), '1']
         procs.append(subprocess.Popen(argv, env=env, cwd=REPO,
                                       stdout=subprocess.DEVNULL,
                                       stderr=subprocess.PIPE, text=True))
@@ -335,6 +339,241 @@ def pod_main(args, rng, ckpt_mod, faults, work, fail):
     return 0
 
 
+# ---------------------------------------------------------------------------
+# resize mode (ISSUE 14): kill the pod at a COMMITTED boundary, relaunch
+# on a randomly chosen DIFFERENT host count (elastic worker: sharded
+# data journal, restore reshards to the new mesh, journal re-strides)
+# ---------------------------------------------------------------------------
+ELASTIC_WORKER = os.path.join(REPO, 'tests', 'elastic_pod_worker.py')
+GLOBAL_BS = 16        # elastic worker contract (elastic_pod_worker.py)
+RESIZE_LOSS_ATOL = 2e-3
+RESIZE_LOSS_RTOL = 1e-3
+
+
+def read_elastic_out(path):
+    """Parse one elastic worker out file -> dict with resume, topo,
+    reshard, restride, losses {step: float}, recs {step: [hash, ...]},
+    sha."""
+    out = {'resume': None, 'topo': None, 'reshard': None,
+           'restride': None, 'losses': {}, 'recs': {}, 'sha': None,
+           'stall': None}
+    if not os.path.exists(path):
+        return out
+    for line in open(path):
+        parts = line.split()
+        if not parts:
+            continue
+        if parts[0] == 'RESUME':
+            out['resume'] = int(parts[1])
+        elif parts[0] == 'TOPO':
+            out['topo'] = (int(parts[1]), int(parts[2]))
+        elif parts[0] == 'RESHARD':
+            out['reshard'] = (int(parts[1]), int(parts[2]),
+                              float(parts[3]), float(parts[4]))
+        elif parts[0] == 'RESTRIDE':
+            out['restride'] = tuple(int(x) for x in parts[1:4])
+        elif parts[0] == 'RECS':
+            out['recs'][int(parts[1])] = parts[2].split(',')
+        elif parts[0] == 'STALL':
+            out['stall'] = float(parts[1])
+        elif parts[0] == 'DONE':
+            out['sha'] = parts[1]
+        elif parts[0].lstrip('-').isdigit():
+            out['losses'][int(parts[0])] = float(parts[1])
+    return out
+
+
+def merge_pod_recs(host_outs, fail):
+    """{step: sorted record hashes across all hosts}; a duplicate hash
+    within one step means two hosts trained the same chunk — an
+    exactly-once violation caught immediately."""
+    merged = {}
+    for r, o in enumerate(host_outs):
+        for s, hs in o['recs'].items():
+            merged.setdefault(s, []).extend(hs)
+    for s, hs in merged.items():
+        if len(hs) != len(set(hs)):
+            return fail('step %d trained a chunk twice across hosts '
+                        '(exactly-once violation)' % s), None
+    return None, {s: sorted(hs) for s, hs in merged.items()}
+
+
+def check_resize_round(refs_losses, ref_recs, killed, resumed, resume_at,
+                       total, dataset_hashes, fail, label):
+    """The resize acceptance: loss-trajectory parity within
+    float-accumulation tolerance, identical per-step record SETS, and
+    exactly-once epoch digests over the effective history (killed run
+    before the resume point, resumed run after)."""
+    err, killed_recs = merge_pod_recs(killed, fail)
+    if err is not None:
+        return err
+    err, resumed_recs = merge_pod_recs(resumed, fail)
+    if err is not None:
+        return err
+    for tag, outs in (('killed', killed), ('resumed', resumed)):
+        for r, o in enumerate(outs):
+            for s, v in o['losses'].items():
+                ref = refs_losses.get(s)
+                if ref is None:
+                    return fail('%s %s host %d trained unexpected step %d'
+                                % (label, tag, r, s))
+                if abs(v - ref) > RESIZE_LOSS_ATOL \
+                        + RESIZE_LOSS_RTOL * abs(ref):
+                    return fail(
+                        '%s %s host %d: loss at step %d outside the '
+                        'float-accumulation tolerance (%r vs ref %r)'
+                        % (label, tag, r, s, v, ref))
+    effective = {}
+    for s in range(total):
+        src = killed_recs if s < resume_at else resumed_recs
+        if s not in src:
+            return fail('%s: no record accounting for step %d (%s arm)'
+                        % (label, s, 'killed' if s < resume_at
+                           else 'resumed'))
+        effective[s] = src[s]
+        if ref_recs.get(s) is not None \
+                and sorted(ref_recs[s]) != sorted(src[s]):
+            return fail('%s: step %d trained a different record SET '
+                        'than the reference (data-plane stride drift)'
+                        % (label, s))
+        if len(src[s]) != GLOBAL_BS:
+            return fail('%s: step %d trained %d records, want %d'
+                        % (label, s, len(src[s]), GLOBAL_BS))
+    steps_per_epoch = len(dataset_hashes) // GLOBAL_BS
+    for e in range(total // steps_per_epoch):
+        got = []
+        for s in range(e * steps_per_epoch, (e + 1) * steps_per_epoch):
+            got.extend(effective[s])
+        if sorted(got) != sorted(dataset_hashes):
+            return fail('%s: epoch %d digest is not exactly-once '
+                        '(%d records trained, %d unique, dataset %d)'
+                        % (label, e, len(got), len(set(got)),
+                           len(dataset_hashes)))
+    return None
+
+
+def resize_main(args, rng, work, fail):
+    """Elastic chaos: reference at --pod N, then per round kill a fresh
+    pod at a committed boundary and relaunch on a DIFFERENT host count,
+    asserting loss parity within tolerance + exactly-once digests."""
+    n0 = args.pod
+    counts = sorted({int(c) for c in args.resize_counts.split(',')})
+    for c in counts + [n0]:
+        if GLOBAL_BS % c:
+            return fail('host count %d does not divide the global '
+                        'batch %d' % (c, GLOBAL_BS))
+    # fail these BEFORE the minutes-long reference run: every round
+    # needs a host count different from the current one (rounds chain,
+    # so a 1-entry pool only survives round 1), and a kill boundary
+    # strictly INSIDE the run
+    if not [c for c in counts if c != n0] \
+            or (args.rounds > 1 and len(counts) < 2):
+        return fail('--resize-counts %r cannot supply a DIFFERENT host '
+                    'count for every one of %d round(s) starting from '
+                    '--pod %d' % (args.resize_counts, args.rounds, n0))
+    if args.total <= args.every:
+        return fail('--resize needs --total (%d) > --every (%d): the '
+                    'kill must land on a committed boundary strictly '
+                    'inside the run so the relaunch has steps left'
+                    % (args.total, args.every))
+    cache_dir = os.path.join(work, 'compile-cache')
+    data = os.path.join(work, 'elastic-data.rio')
+    num_records = GLOBAL_BS * 4            # 4 steps per epoch
+    r = subprocess.run([sys.executable, ELASTIC_WORKER, '--make-data',
+                        data, str(num_records)], capture_output=True,
+                       text=True, cwd=REPO, timeout=240)
+    if r.returncode != 0:
+        return fail('dataset build failed:\n%s' % r.stderr[-1500:])
+    dataset_hashes = [l.strip() for l in open(data + '.hashes')
+                      if l.strip()]
+    outs = lambda tag, n: [os.path.join(work, '%s-r%d.txt' % (tag, r))  # noqa: E731,E501
+                           for r in range(n)]
+
+    t0 = time.time()
+    ref_outs = outs('ref', n0)
+    res = run_pod(os.path.join(work, 'ref-ckpts'), ref_outs, args.total,
+                  args.every, cache_dir=cache_dir, worker=ELASTIC_WORKER,
+                  data_file=data)
+    if any(rc != 0 for rc, _ in res):
+        return fail('elastic reference run failed:\n%s'
+                    % '\n'.join(err[-1500:] for _, err in res))
+    refs = [read_elastic_out(p) for p in ref_outs]
+    for r_ in range(1, n0):
+        if refs[r_]['losses'] != refs[0]['losses']:
+            return fail('reference pod: replicated losses differ '
+                        'between hosts 0 and %d' % r_)
+    err, ref_recs = merge_pod_recs(refs, fail)
+    if err is not None:
+        return err
+    print('[chaos] resize reference: %d hosts, %d steps, %d records/'
+          'epoch  %.1fs' % (n0, len(refs[0]['losses']), num_records,
+                            time.time() - t0))
+
+    cur_n = n0
+    for rnd in range(1, args.rounds + 1):
+        ckpt = os.path.join(work, 'resize-ckpts-%d' % rnd)
+        victim = rng.randrange(cur_n)
+        # a committed boundary strictly inside the run, so the relaunch
+        # has steps left to train
+        kill_at = rng.randrange(args.every, args.total, args.every)
+        new_n = rng.choice([c for c in counts if c != cur_n])
+        t0 = time.time()
+        res = run_pod(ckpt, outs('rz%d-kill' % rnd, cur_n), args.total,
+                      args.every, kill_rank=victim, kill_at=kill_at,
+                      cache_dir=cache_dir, worker=ELASTIC_WORKER,
+                      data_file=data)
+        if any('WEDGED' in err for _, err in res):
+            return fail('round %d: a survivor never detected the dead '
+                        'host' % rnd)
+        if res[victim][0] != -signal.SIGKILL:
+            return fail('round %d: victim exited %s, expected SIGKILL'
+                        % (rnd, res[victim][0]))
+        killed = [read_elastic_out(p) for p in outs('rz%d-kill' % rnd,
+                                                    cur_n)]
+        res = run_pod(ckpt, outs('rz%d-new' % rnd, new_n), args.total,
+                      args.every, cache_dir=cache_dir,
+                      worker=ELASTIC_WORKER, data_file=data)
+        if any(rc != 0 for rc, _ in res):
+            return fail('round %d: resized relaunch (%d->%d hosts) '
+                        'failed:\n%s' % (rnd, cur_n, new_n,
+                                         '\n'.join(err[-1500:]
+                                                   for _, err in res)))
+        resumed = [read_elastic_out(p) for p in outs('rz%d-new' % rnd,
+                                                     new_n)]
+        # the resume point is the newest COMMITTED boundary <= kill_at
+        # (a boundary a busy writer declined commits nothing); every
+        # resumed host must agree on it and it must exist at all
+        resume_at = resumed[0]['resume']
+        for r_, o in enumerate(resumed):
+            if o['resume'] != resume_at or not resume_at \
+                    or resume_at > kill_at or resume_at % args.every:
+                return fail('round %d host %d resumed at %s, expected '
+                            'one committed boundary <= %d on every host'
+                            % (rnd, r_, o['resume'], kill_at))
+            if o['topo'] != (cur_n, new_n):
+                return fail('round %d host %d topo %r, expected (%d, %d)'
+                            % (rnd, r_, o['topo'], cur_n, new_n))
+            if o['reshard'] is None or o['reshard'][0] < 1:
+                return fail('round %d host %d: resize did not engage '
+                            'the resharding path (%r)'
+                            % (rnd, r_, o['reshard']))
+        err = check_resize_round(
+            refs[0]['losses'], ref_recs, killed, resumed, resume_at,
+            args.total, dataset_hashes, fail, 'round %d' % rnd)
+        if err is not None:
+            return err
+        print('[chaos] resize round %d: %d hosts killed@%d (victim h%d) '
+              '-> resumed on %d hosts at committed step %d, loss parity '
+              'within tolerance, epochs exactly-once  %.1fs'
+              % (rnd, cur_n, kill_at, victim, new_n, resume_at,
+                 time.time() - t0))
+        cur_n = new_n
+    print('[chaos] OK: %d resize rounds over host counts %r, loss '
+          'parity within tolerance + exactly-once epoch digests held'
+          % (args.rounds, counts))
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description='kill/corrupt/restart chaos loop over the checkpoint '
@@ -361,6 +600,16 @@ def main(argv=None):
                          'restarts the whole pod (sharded two-phase '
                          'checkpoints, heartbeat watchdog, warm compile '
                          'cache)')
+    ap.add_argument('--resize', action='store_true',
+                    help='elastic mode (with --pod N): each round kills '
+                         'the pod at a COMMITTED boundary and relaunches '
+                         'on a randomly chosen DIFFERENT host count '
+                         '(topology-change restore + journal re-stride); '
+                         'asserts loss parity within float-accumulation '
+                         'tolerance and exactly-once epoch digests')
+    ap.add_argument('--resize-counts', default='1,2,4', metavar='A,B,..',
+                    help='host-count pool --resize draws from '
+                         '(default 1,2,4)')
     args = ap.parse_args(argv)
 
     seed = args.seed if args.seed is not None else int(time.time())
@@ -378,6 +627,15 @@ def main(argv=None):
         print('[chaos] FAIL: %s' % msg)
         print('[chaos] workdir kept at %s' % work)
         return 1
+
+    if args.resize:
+        if args.pod < 2:
+            ap.error('--resize needs --pod N (N >= 2) for the initial '
+                     'topology')
+        rc = resize_main(args, rng, work, fail)
+        if rc == 0 and not args.keep and args.workdir is None:
+            shutil.rmtree(work, ignore_errors=True)
+        return rc
 
     if args.pod:
         if args.pod < 2:
