@@ -1475,6 +1475,265 @@ def bench_ctr():
         exe, main_p, feed, loss, _device_k(8)))
 
 
+# ---------------------------------------------------------------------------
+# ablation mode (ISSUE 16): PTPU_BENCH_ABLATE=googlenet|lstm runs the
+# pass-on/off arms in ONE session with the same two-point-slope device
+# timing as every other metric and emits a PERF_NOTES-ready markdown
+# table next to the per-arm JSON lines. The on/off switch is structural
+# (different pass pipeline / program attr), not an env flip, so both
+# arms share the session, the compile cache, and the init snapshot.
+# ---------------------------------------------------------------------------
+def _emit_ablation_table(title, headers, rows):
+    print('\nABLATION ' + title, flush=True)
+    print('| ' + ' | '.join(headers) + ' |')
+    print('|' + '|'.join('---' for _ in headers) + '|')
+    for r in rows:
+        print('| ' + ' | '.join(str(c) for c in r) + ' |')
+    print('', flush=True)
+
+
+def _snap_scope(scope):
+    return {k: np.asarray(v) for k, v in scope._vars.items()
+            if v is not None}
+
+
+def _arm_scope(snap):
+    import paddle_tpu as fluid
+    sc = fluid.core.Scope()
+    for k, v in snap.items():
+        sc.set(k, v)
+    return sc
+
+
+def bench_ablate_googlenet():
+    """GoogLeNet horizontal_fuse A/B: train and inference programs run
+    through the SAME pass pipeline with and without horizontal_fuse (the
+    only varying arm ingredient), same weights, same feed, same session.
+    Per arm: dispatch-inclusive ms/step, device ms/step (two-point
+    slope), derived img/s, and max|Δloss| vs the base arm (parity)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import passes
+    from models.googlenet import build_train_net, googlenet, \
+        GOOGLENET_FWD_MACS
+
+    batch = int(os.environ.get('PTPU_BENCH_ABLATE_BATCH', '8'))
+    side = int(os.environ.get('PTPU_BENCH_ABLATE_SIDE', '224'))
+    steps = int(os.environ.get('PTPU_BENCH_ABLATE_STEPS', '6'))
+    k = _device_k(int(os.environ.get('PTPU_BENCH_ABLATE_K', '4')))
+    reps = int(os.environ.get('PTPU_BENCH_ABLATE_REPS', '2'))
+    use_bf16 = os.environ.get('PTPU_BENCH_DTYPE', 'bf16') == 'bf16'
+
+    exe, dev = _device()
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    xs = jax.device_put(jnp.asarray(
+        rng.randn(batch, 3, side, side).astype(np.float32)), dev)
+    lab = jax.device_put(jnp.asarray(
+        rng.randint(0, 1000, (batch, 1)).astype(np.int32)), dev)
+
+    base_pl = [p for p in passes.OPTIMIZATION_PIPELINE
+               if p != 'horizontal_fuse']
+    infer_base_pl = [p for p in passes.INFERENCE_PIPELINE
+                     if p != 'horizontal_fuse']
+
+    # -- train program (one build, one init snapshot for every arm) --------
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup_p.random_seed = 11
+    with fluid.program_guard(main_p, startup_p):
+        images, label, loss, acc = build_train_net(
+            dshape=(3, side, side), class_dim=1000)
+    if use_bf16:
+        fluid.contrib.mixed_precision.enable_bf16(main_p)
+    scope0 = fluid.core.Scope()
+    with fluid.scope_guard(scope0):
+        exe.run(startup_p)
+    snap = _snap_scope(scope0)
+    feed = {'data': xs, 'label': lab}
+
+    # -- inference program (same weights via the shared snapshot) ----------
+    infer_p, infer_sp = fluid.Program(), fluid.Program()
+    infer_p.random_seed = infer_sp.random_seed = 11
+    with fluid.program_guard(infer_p, infer_sp):
+        iimages = fluid.layers.data(name='data', shape=[3, side, side],
+                                    dtype='float32')
+        logits = googlenet(iimages, class_dim=1000, is_train=False)
+    scope_i = fluid.core.Scope()
+    with fluid.scope_guard(scope_i):
+        exe.run(infer_sp)
+    snap_i = _snap_scope(scope_i)
+
+    def train_arm(name, pipeline):
+        prog, reports = passes.PassManager(pipeline).apply(
+            main_p, fetch_names=[loss.name])
+        hf = next((r for r in reports if r.name == 'horizontal_fuse'), None)
+        sc = _arm_scope(snap)
+        with fluid.scope_guard(sc):
+            l0 = float(np.asarray(exe.run(
+                prog, feed=feed, fetch_list=[loss.name])[0]).reshape(-1)[0])
+        sc = _arm_scope(snap)
+        with fluid.scope_guard(sc):
+            dt = _timed_steps(exe, prog, feed, loss, steps, warmup=2)
+            dev_ms, dev_k = _device_ms_scan(exe, prog, feed, loss, k,
+                                            reps=reps, scope=sc)
+        return {'arm': name, 'mode': 'train', 'batch': batch,
+                'convs_fused': hf.details.get('convs_fused')
+                if hf is not None else 0,
+                'loss0': l0,
+                'ms_step': round(dt / steps * 1e3, 2),
+                'device_ms_step': round(dev_ms, 2) if dev_ms > 0 else None,
+                'device_k': dev_k}
+
+    def infer_arm(name, pipeline):
+        prog, reports = passes.PassManager(pipeline).apply(
+            infer_p, fetch_names=[logits.name])
+        hf = next((r for r in reports if r.name == 'horizontal_fuse'), None)
+        sc = _arm_scope(snap_i)
+        with fluid.scope_guard(sc):
+            out0 = np.asarray(exe.run(prog, feed={'data': xs},
+                                      fetch_list=[logits.name])[0])
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                o = exe.run(prog, feed={'data': xs},
+                            fetch_list=[logits.name], return_numpy=False)
+            np.asarray(o[0])
+            dt = time.perf_counter() - t0
+            dev_ms, dev_k = _device_ms_scan(exe, prog, {'data': xs},
+                                            logits.name, k, reps=reps,
+                                            scope=sc)
+        return {'arm': name, 'mode': 'infer', 'batch': batch,
+                'convs_fused': hf.details.get('convs_fused')
+                if hf is not None else 0,
+                'out0': out0,
+                'ms_step': round(dt / steps * 1e3, 2),
+                'device_ms_step': round(dev_ms, 2) if dev_ms > 0 else None,
+                'device_k': dev_k}
+
+    arms = [train_arm('train_base', base_pl),
+            train_arm('train_hfuse', list(passes.OPTIMIZATION_PIPELINE)),
+            infer_arm('infer_base', infer_base_pl),
+            infer_arm('infer_hfuse', list(passes.INFERENCE_PIPELINE))]
+
+    # parity vs each mode's base arm (same snapshot, same feed, same rng
+    # stream -> bit-level comparable)
+    arms[1]['parity_dloss'] = abs(arms[1]['loss0'] - arms[0]['loss0'])
+    arms[3]['parity_dlogits'] = float(
+        np.max(np.abs(arms[3].pop('out0') - arms[2].pop('out0'))))
+    rows = []
+    for a in arms:
+        base = arms[0] if a['mode'] == 'train' else arms[2]
+        for key in ('ms_step', 'device_ms_step'):
+            a['img_s' if key == 'ms_step' else 'device_img_s'] = (
+                round(batch / a[key] * 1e3, 1) if a.get(key) else None)
+        a['speedup_vs_base'] = (
+            round(base['device_ms_step'] / a['device_ms_step'], 3)
+            if a.get('device_ms_step') and base.get('device_ms_step')
+            else None)
+        line = {'metric': 'ablate_googlenet_' + a['arm']}
+        line.update({k: v for k, v in a.items() if k not in ('out0',)})
+        line.pop('loss0', None)
+        _print_line(line)
+        rows.append([a['arm'], batch, a['convs_fused'], a['ms_step'],
+                     a['device_ms_step'], a['device_img_s'],
+                     a['speedup_vs_base'],
+                     a.get('parity_dloss', a.get('parity_dlogits', '-'))])
+    _emit_ablation_table(
+        'googlenet horizontal_fuse (side=%d, %s)'
+        % (side, 'bf16' if use_bf16 else 'fp32'),
+        ['arm', 'batch', 'convs_fused', 'ms/step', 'device ms/step',
+         'device img/s', 'speedup vs base', 'parity |d|'], rows)
+    return arms
+
+
+def bench_ablate_lstm():
+    """Stacked-LSTM fused-scan ablation over the three axes VERDICT r5
+    item 4 asked for: fuse_layers off/on x batch 64->512 x run_steps K.
+    Each (batch, fuse) arm is its own program build (fuse_layers is
+    program structure); single-step dispatch ms, K-step dispatch ms, and
+    the device slope ride in every row."""
+    import paddle_tpu as fluid
+    from models.stacked_lstm import build_stacked_lstm_train
+
+    batches = [int(b) for b in os.environ.get(
+        'PTPU_BENCH_ABLATE_BATCHES', '64,512').split(',') if b.strip()]
+    kk = int(os.environ.get('PTPU_BENCH_LSTM_K', '8'))
+    steps = int(os.environ.get('PTPU_BENCH_ABLATE_STEPS', '6'))
+    dispatches = int(os.environ.get('PTPU_BENCH_LSTM_DISPATCHES', '3'))
+    reps = int(os.environ.get('PTPU_BENCH_ABLATE_REPS', '2'))
+    use_bf16 = os.environ.get('PTPU_BENCH_DTYPE', 'bf16') == 'bf16'
+
+    exe, dev = _device()
+    import jax
+    import jax.numpy as jnp
+
+    def arm(batch, fuse):
+        main_p, startup_p = fluid.Program(), fluid.Program()
+        main_p.random_seed = startup_p.random_seed = 11
+        with fluid.program_guard(main_p, startup_p):
+            ids, label, loss, flops = build_stacked_lstm_train(
+                batch, fuse_layers=fuse)
+        if use_bf16:
+            fluid.contrib.mixed_precision.enable_bf16(main_p)
+        scope = fluid.core.Scope()
+        rng = np.random.RandomState(0)
+        feed = {'ids': jax.device_put(jnp.asarray(
+                    rng.randint(1, 30000, (batch, 100)).astype(np.int32)),
+                    dev),
+                'label': jax.device_put(jnp.asarray(
+                    rng.randint(0, 2, (batch, 1)).astype(np.int32)), dev)}
+        with fluid.scope_guard(scope):
+            exe.run(startup_p)
+            l0 = float(np.asarray(exe.run(
+                main_p, feed=feed,
+                fetch_list=[loss.name])[0]).reshape(-1)[0])
+            dt1 = _timed_steps(exe, main_p, feed, loss, steps, warmup=2)
+            dtk = _timed_multi_steps(exe, main_p, _stack_k(feed, kk), loss,
+                                     dispatches, kk, warmup=1)
+            dev_ms, dev_k = _device_ms_scan(exe, main_p, feed, loss, kk,
+                                            reps=reps, scope=scope)
+        return {'arm': 'b%d_%s' % (batch, 'fused' if fuse else 'perlayer'),
+                'batch': batch, 'fuse_layers': fuse, 'loss0': l0,
+                'ms_batch': round(dt1 / steps * 1e3, 2),
+                'ms_batch_k%d' % kk: round(dtk / (dispatches * kk) * 1e3, 2),
+                'device_ms_batch': round(dev_ms, 2) if dev_ms > 0 else None,
+                'device_k': dev_k}
+
+    arms = []
+    for batch in batches:
+        for fuse in (False, True):
+            arms.append(arm(batch, fuse))
+    rows = []
+    for a in arms:
+        base = next(b for b in arms
+                    if b['batch'] == a['batch'] and not b['fuse_layers'])
+        a['parity_dloss'] = abs(a['loss0'] - base['loss0'])
+        a['speedup_vs_perlayer'] = (
+            round(base['device_ms_batch'] / a['device_ms_batch'], 3)
+            if a.get('device_ms_batch') and base.get('device_ms_batch')
+            else None)
+        line = {'metric': 'ablate_lstm_' + a['arm']}
+        line.update(a)
+        line.pop('loss0', None)
+        _print_line(line)
+        kcol = 'ms_batch_k%d' % kk
+        rows.append([a['arm'], a['batch'],
+                     'on' if a['fuse_layers'] else 'off', a['ms_batch'],
+                     a[kcol], a['device_ms_batch'],
+                     a['speedup_vs_perlayer'],
+                     '%.3g' % a['parity_dloss']])
+    _emit_ablation_table(
+        'stacked_lstm fuse_layers (seq=100, hidden=256, %s)'
+        % ('bf16' if use_bf16 else 'fp32'),
+        ['arm', 'batch', 'fuse', 'ms/batch', 'ms/batch K=%d' % kk,
+         'device ms/batch', 'speedup vs per-layer', 'parity |dloss|'],
+        rows)
+    return arms
+
+
+_ABLATIONS = {'googlenet': bench_ablate_googlenet,
+              'lstm': bench_ablate_lstm}
+
+
 BENCHES = [
     ('resnet50_train_img_s_per_chip', bench_resnet),     # headline: FIRST
     ('transformer_base_tokens_s_per_chip', bench_transformer),
@@ -1549,6 +1808,21 @@ def main(benches=None):
     except Exception as e:
         print('bench: compile cache unavailable (%s: %s)'
               % (type(e).__name__, e), file=sys.stderr)
+    ablate = os.environ.get('PTPU_BENCH_ABLATE', '')
+    if ablate:
+        # ablation mode replaces the suite: every requested model's
+        # on/off arms run in this one session and emit a PERF_NOTES-ready
+        # table; unknown tokens are reported, never silently skipped
+        for tok in (t.strip() for t in ablate.split(',') if t.strip()):
+            fn = _ABLATIONS.get(tok)
+            if fn is None:
+                _print_line({'metric': 'ablate_' + tok,
+                             'error': 'unknown PTPU_BENCH_ABLATE token'})
+                continue
+            line = run_metric('ablate_' + tok, fn, retries=1)
+            if isinstance(line, dict) and 'error' in line:
+                _print_line(line)
+        return 0
     if benches is None:
         benches = BENCHES
         only = os.environ.get('PTPU_BENCH_ONLY', '')

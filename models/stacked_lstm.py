@@ -12,9 +12,14 @@ import paddle_tpu as fluid
 
 def build_stacked_lstm_train(batch, vocab=30000, emb_dim=256, hidden=256,
                              num_layers=2, seq_len=100, num_classes=2,
-                             lr=1e-3):
+                             lr=1e-3, fuse_layers=False):
     """Returns (ids_var, label_var, loss, flops_per_batch). Static batch:
-    the recurrent init states are program constants shaped [L, B, H]."""
+    the recurrent init states are program constants shaped [L, B, H].
+
+    `batch` is the MFU scaling knob (PERF_NOTES round 18 ablates 64->512:
+    at batch 64 the [B, H] recurrent GEMMs cannot fill the MXU);
+    `fuse_layers` selects the single-scan multi-layer LSTM body
+    (layers.lstm fuse_layers — all layers' gate GEMMs in one while-op)."""
     ids = fluid.layers.data('ids', shape=[batch, seq_len], dtype='int64',
                             append_batch_size=False)
     label = fluid.layers.data('label', shape=[batch, 1], dtype='int64',
@@ -24,7 +29,8 @@ def build_stacked_lstm_train(batch, vocab=30000, emb_dim=256, hidden=256,
     zeros = fluid.layers.fill_constant(
         shape=[num_layers, batch, hidden], dtype='float32', value=0.0)
     out, _, _ = fluid.layers.lstm(x, zeros, zeros, max_len=seq_len,
-                                  hidden_size=hidden, num_layers=num_layers)
+                                  hidden_size=hidden, num_layers=num_layers,
+                                  fuse_layers=fuse_layers)
     pooled = fluid.layers.reduce_mean(out, dim=0)          # [B, H]
     logits = fluid.layers.fc(pooled, size=num_classes)
     loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(

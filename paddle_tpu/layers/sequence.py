@@ -370,7 +370,7 @@ def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
 
 def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
          dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
-         default_initializer=None, seed=-1):
+         default_initializer=None, seed=-1, fuse_layers=False):
     """Stacked dense LSTM over [seq, batch, dim] — the reference's cudnn
     path (ref python/paddle/fluid/layers/nn.py lstm,
     operators/cudnn_lstm_op.cc:1): num_layers four-gate LSTM layers, no
@@ -383,6 +383,13 @@ def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
     accepted for API parity; shapes are static under XLA so no packing
     bound is needed. Weights are separate per (layer, direction) params
     — cudnn's packed blob was an API artifact, not semantics.
+
+    fuse_layers=True runs ONE scan over time carrying all layers' (h, c)
+    — the per-timestep loop body does num_layers packed-gate GEMMs
+    back-to-back instead of num_layers separate scans (ops/rnn_ops.py
+    _fused_layer_stack; PERF_NOTES round 18). Same math, same dropout
+    mask stream; unidirectional multi-layer programs only (others fall
+    back to the per-layer scan inside the lowering).
     """
     helper = LayerHelper('cudnn_lstm', name=name)
     dtype = input.dtype
@@ -412,5 +419,6 @@ def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
         attrs={'hidden_size': hidden_size, 'num_layers': num_layers,
                'is_bidirec': is_bidirec, 'dropout_prob': dropout_prob,
                'is_test': is_test, 'max_len': max_len,
-               'seed': 0 if seed is None or seed < 0 else int(seed)})
+               'seed': 0 if seed is None or seed < 0 else int(seed),
+               'fuse_layers': bool(fuse_layers)})
     return out, last_h, last_c

@@ -252,9 +252,20 @@ def _cudnn_lstm(ctx, ins):
                                       reverse=reverse)
         return hs, h_t, c_t
 
+    key = ctx.rng() if dropout_on else None
+    # fused multi-layer mode (attr 'fuse_layers', layers.lstm): ONE scan
+    # over time carrying every layer's (h, c), so the single XLA while-op
+    # body runs all L packed-gate GEMMs back-to-back instead of L
+    # sequential scans each re-crossing the dispatch/loop boundary per
+    # layer. Unidirectional only — a backward direction needs the whole
+    # forward-layer sequence before its first step, which no single
+    # forward scan can carry (those programs keep the per-layer path).
+    if ctx.attr('fuse_layers', False) and ndir == 1 and nlayers > 1:
+        return _fused_layer_stack(x, h0, c0, wx, wh, bias, nlayers, p,
+                                  dropout_on, key)
+
     cur = x
     last_h, last_c = [], []
-    key = ctx.rng() if dropout_on else None
     for layer in range(nlayers):
         outs = []
         for d in range(ndir):
@@ -271,6 +282,61 @@ def _cudnn_lstm(ctx, ins):
             cur = jnp.where(keep, cur / (1.0 - p), 0.0).astype(cur.dtype)
     return {'Out': [cur], 'LastH': [jnp.stack(last_h)],
             'LastC': [jnp.stack(last_c)]}
+
+
+def _fused_layer_stack(x, h0, c0, wx, wh, bias, nlayers, p, dropout_on,
+                       key):
+    """cudnn_lstm fuse_layers=True body: one lax.scan over time whose
+    carry is every layer's (h, c). Layer 0 keeps the hoisted input GEMM
+    (one [S*B, Din] x [Din, 4H] matmul outside the loop); layers above
+    compute their input projection inside the step off the layer below's
+    fresh h_t — back-to-back [B, H] x [H, 4H] MXU GEMMs in a single
+    while-op body, where the per-layer path pays L scan loops.
+
+    Dropout masks are pre-sampled OUTSIDE the scan with the exact
+    key-split order and [S, B, H] shapes of the per-layer path, so the
+    two modes draw bit-identical masks from the same op rng stream."""
+    s, b = x.shape[0], x.shape[1]
+    h = wh[0].shape[0]
+    xp0 = x @ wx[0] + bias[0]            # [S, B, 4H]
+
+    xs = (xp0,)
+    if dropout_on:
+        masks = []
+        for _ in range(nlayers - 1):
+            key, sub = jax.random.split(key)
+            masks.append(jax.random.bernoulli(sub, 1.0 - p, (s, b, h)))
+        xs = (xp0, jnp.stack(masks, axis=1))   # [S, L-1, B, H]
+
+    def step(carry, inp):
+        hs, cs = carry
+        x_t = inp[0]
+        new_h, new_c = [], []
+        cur = None
+        for layer in range(nlayers):
+            if layer == 0:
+                gates = x_t + hs[0] @ wh[0]
+            else:
+                gates = cur @ wx[layer] + bias[layer] + hs[layer] @ wh[layer]
+            g_i, g_f, g_c, g_o = jnp.split(gates, 4, axis=-1)
+            c = jax.nn.sigmoid(g_f) * cs[layer] \
+                + jax.nn.sigmoid(g_i) * jnp.tanh(g_c)
+            ht = jax.nn.sigmoid(g_o) * jnp.tanh(c)
+            # carry dtype stays fixed under bf16 AMP (see _lstm above)
+            ht = ht.astype(hs[layer].dtype)
+            new_h.append(ht)
+            new_c.append(c.astype(cs[layer].dtype))
+            cur = ht
+            if layer < nlayers - 1 and dropout_on:
+                m_t = inp[1][layer]
+                cur = jnp.where(m_t, cur / (1.0 - p), 0.0).astype(cur.dtype)
+        return (tuple(new_h), tuple(new_c)), new_h[-1]
+
+    carry0 = (tuple(h0[i] for i in range(nlayers)),
+              tuple(c0[i] for i in range(nlayers)))
+    (h_t, c_t), out = jax.lax.scan(step, carry0, xs)
+    return {'Out': [out], 'LastH': [jnp.stack(h_t)],
+            'LastC': [jnp.stack(c_t)]}
 
 
 @register('gru_unit', lod='none')

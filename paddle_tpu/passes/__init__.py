@@ -2,7 +2,7 @@
 
 The reference rewrites its graph through a registry of C++ IR passes
 ordered by build_strategy; here the same layer operates directly on
-Program/Block (framework.py is the IR). Four passes ship today:
+Program/Block (framework.py is the IR). Five passes ship today:
 
   verify_program        static lint: undefined inputs, use-before-def,
                         unregistered ops, dangling sub-blocks,
@@ -12,6 +12,9 @@ Program/Block (framework.py is the IR). Four passes ship today:
                         splice literal vars (IEEE-exact ops only)
   dead_op_elimination   backward liveness from fetch targets +
                         persistables; subsumes io.prune_program
+  horizontal_fuse       merge sibling same-input convs (the inception
+                        branch pattern) into one wider conv + split,
+                        def-use-guarded, reason-coded report
   fuse_activation       merge elementwise activations into conv/mul/
                         elementwise_add producers (tracer applies the
                         act lowering in the same expression)
@@ -53,12 +56,25 @@ from .dataflow import (DataflowAnalysis, DonationCertificate, Hazard,
 from .quantize import (CalibrationResult, QuantizeProgramPass,
                        calibrate_program, calibration_targets,
                        quantize_program, quantize_weight)
+from .horizontal_fuse import HorizontalFusePass, horizontal_fuse_program
 
 # constant_fold runs first so dead_op_elimination sweeps the literal
 # producers whose consumers folded; fuse_activation last, on the final
 # op list. verify_program leads: fail loudly before rewriting garbage.
+#
+# ORDER NOTE — horizontal_fuse before fuse_activation: widening sibling
+# convs first leaves each branch's bias+act epilogue reading its own
+# split output, so fuse_activation still folds the per-branch relu into
+# the per-branch elementwise_add afterwards (single-reader guard intact).
+# Run the other way round, an act already folded INTO a conv would have
+# to be part of the widening decision; horizontal_fuse handles that too
+# (fuse_act attrs are in its group key — elementwise acts commute with
+# the channel concat), but only the fuse-first order can fold the acts
+# that live behind the per-branch bias adds. Regression:
+# tests/test_horizontal_fuse.py::test_per_branch_act_epilogues_survive.
 OPTIMIZATION_PIPELINE = ('verify_program', 'constant_fold',
-                         'dead_op_elimination', 'fuse_activation')
+                         'dead_op_elimination', 'horizontal_fuse',
+                         'fuse_activation')
 
 # same ordered passes, but dead-op elimination roots liveness at the
 # FETCHES ONLY (keep_persistable_writers=False): an inference program has
@@ -69,7 +85,7 @@ OPTIMIZATION_PIPELINE = ('verify_program', 'constant_fold',
 # what apply_inference_pipeline runs.
 INFERENCE_PIPELINE = ('verify_program', 'constant_fold',
                       DeadOpEliminationPass(keep_persistable_writers=False),
-                      'fuse_activation')
+                      'horizontal_fuse', 'fuse_activation')
 
 
 def pipeline_names(pipeline):

@@ -175,11 +175,13 @@ class PassManager(object):
                           preserve=preserve)
         prog = program if inplace else _clone_with_metadata(program)
         reports = []
+        from .. import profiler
         for p in self.passes:
             report = PassReport(p.name)
             report.ops_before = _count_ops(prog)
             ids0, vars0 = _op_ids(prog), _var_keys(prog)
-            p.run_on_program(prog, ctx, report)
+            with profiler.record_event('pass/%s' % p.name):
+                p.run_on_program(prog, ctx, report)
             report.ops_after = _count_ops(prog)
             ids1, vars1 = _op_ids(prog), _var_keys(prog)
             report.ops_added = len(ids1 - ids0)

@@ -30,6 +30,9 @@ PTPU_PLATFORM=cpu python scripts/multi_step_smoke.py
 echo "== bulk-inference loop smoke (CPU, run_batches bit-identity + >=3x dispatch A/B) =="
 PTPU_PLATFORM=cpu python scripts/infer_loop_smoke.py
 
+echo "== mfu pass smoke (googlenet horizontal_fuse + stacked-LSTM fuse_layers A/B in one session: numeric parity asserted; CPU speedups emitted, not asserted — the MXU-padding/scan-dispatch wins are TPU-only, PERF_NOTES round 18) =="
+JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/mfu_smoke.py
+
 echo "== warm-start smoke (persistent compile cache: cold A/B warm in fresh processes, >=3x artifact cold-start cut, cache_ctl stats/prune/prewarm) =="
 JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/warm_start_smoke.py
 

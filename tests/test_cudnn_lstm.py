@@ -66,7 +66,7 @@ def _make_case(S=4, B=3, D=5, H=6, nlayers=1, ndir=1, seed=0):
 class _CudnnLstmTest(OpTest):
     op_type = 'cudnn_lstm'
 
-    def __init__(self, nlayers, ndir, **kw):
+    def __init__(self, nlayers, ndir, fuse=False, **kw):
         x, h0, c0, wx, wh, b = _make_case(nlayers=nlayers, ndir=ndir, **kw)
         out, lh, lc = np_stacked_lstm(x, wx, wh, b, h0, c0, nlayers, ndir)
         self.inputs = {
@@ -77,7 +77,7 @@ class _CudnnLstmTest(OpTest):
         }
         self.attrs = {'hidden_size': wh[0].shape[0], 'num_layers': nlayers,
                       'is_bidirec': ndir == 2, 'dropout_prob': 0.0,
-                      'is_test': False}
+                      'is_test': False, 'fuse_layers': fuse}
         self.outputs = {'Out': out.astype(np.float32),
                         'LastH': lh.astype(np.float32),
                         'LastC': lc.astype(np.float32)}
@@ -95,6 +95,116 @@ def test_grad_weights_and_input():
     t = _CudnnLstmTest(nlayers=2, ndir=2, S=3, B=2, D=4, H=3)
     t.check_grad(['Input', 'wx0', 'wh1', 'b2'], 'Out',
                  max_relative_error=1e-2)
+
+
+# ---------------------------------------------------------------------------
+# fuse_layers: the single-scan multi-layer body (PERF_NOTES round 18)
+# ---------------------------------------------------------------------------
+def test_forward_fused_stack_vs_oracle():
+    """fuse_layers=True (ONE lax.scan carrying all layers' (h, c), the
+    L gate GEMMs back-to-back per step) must match the same float64
+    oracle as the per-layer path."""
+    _CudnnLstmTest(nlayers=3, ndir=1, fuse=True).check_output(
+        atol=1e-5, rtol=1e-5)
+
+
+def test_grad_fused_stack():
+    """Analytic-vs-numeric gradients through the fused scan body."""
+    t = _CudnnLstmTest(nlayers=2, ndir=1, S=3, B=2, D=4, H=3, fuse=True)
+    t.check_grad(['Input', 'wx0', 'wh1', 'b0'], 'Out',
+                 max_relative_error=1e-2)
+
+
+def _build_fused_pair(fuse, dropout, seed, S, B, D, H, L):
+    """Identically-named/seeded net differing only in fuse_layers —
+    unique_name.guard makes param names (and so init draws and dropout
+    rng keys) line up across the two builds."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data('x', shape=[S, B, D], dtype='float32',
+                                  append_batch_size=False)
+            h0 = fluid.layers.data('h0', shape=[L, B, H], dtype='float32',
+                                   append_batch_size=False)
+            c0 = fluid.layers.data('c0', shape=[L, B, H], dtype='float32',
+                                   append_batch_size=False)
+            out, lh, lc = fluid.layers.lstm(
+                x, h0, c0, max_len=S, hidden_size=H, num_layers=L,
+                dropout_prob=dropout, fuse_layers=fuse)
+    return main, startup, (out, lh, lc)
+
+
+@pytest.mark.parametrize('dropout', [0.0, 0.3])
+def test_fused_equals_per_layer_bitwise(dropout):
+    """Fused vs per-layer stacks agree bit-for-bit, dropout included:
+    the fused body pre-samples the between-layer masks with the exact
+    key-split order the per-layer path uses."""
+    S, B, D, H, L = 5, 3, 4, 6, 3
+    rng = np.random.RandomState(1)
+    feed = {'x': rng.randn(S, B, D).astype(np.float32),
+            'h0': np.zeros((L, B, H), np.float32),
+            'c0': np.zeros((L, B, H), np.float32)}
+    got = []
+    for fuse in (False, True):
+        main, startup, fetches = _build_fused_pair(
+            fuse, dropout, 11, S, B, D, H, L)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.core.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            got.append([np.asarray(v) for v in
+                        exe.run(main, feed=feed, fetch_list=list(fetches))])
+    for a, b in zip(got[0], got[1]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_fused_training_matches_per_layer():
+    """Grad + optimizer path: a fused-stack classifier's per-step Adam
+    losses equal the per-layer stack's bit-for-bit."""
+    S, B, D, H, L = 6, 4, 5, 8, 2
+    rng = np.random.RandomState(7)
+    feed = {'x': rng.randn(S, B, D).astype(np.float32),
+            'h0': np.zeros((L, B, H), np.float32),
+            'c0': np.zeros((L, B, H), np.float32),
+            'label': rng.randint(0, 3, (B, 1)).astype(np.int64)}
+    traces = []
+    for fuse in (False, True):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 5
+        with fluid.unique_name.guard():
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data('x', shape=[S, B, D],
+                                      dtype='float32',
+                                      append_batch_size=False)
+                h0 = fluid.layers.data('h0', shape=[L, B, H],
+                                       dtype='float32',
+                                       append_batch_size=False)
+                c0 = fluid.layers.data('c0', shape=[L, B, H],
+                                       dtype='float32',
+                                       append_batch_size=False)
+                label = fluid.layers.data('label', shape=[B, 1],
+                                          dtype='int64',
+                                          append_batch_size=False)
+                out, _, _ = fluid.layers.lstm(
+                    x, h0, c0, max_len=S, hidden_size=H, num_layers=L,
+                    dropout_prob=0.3, fuse_layers=fuse)
+                logits = fluid.layers.fc(
+                    fluid.layers.reduce_mean(out, dim=0), size=3)
+                loss = fluid.layers.mean(
+                    fluid.layers.softmax_with_cross_entropy(
+                        logits=logits, label=label))
+                fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.core.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            traces.append([
+                float(np.asarray(exe.run(main, feed=feed,
+                                         fetch_list=[loss])[0])
+                      .reshape(-1)[0]) for _ in range(3)])
+    assert np.isfinite(traces[0]).all()
+    assert traces[0] == traces[1], traces
 
 
 def test_cross_check_vs_dynamic_lstm():

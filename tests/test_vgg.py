@@ -11,15 +11,20 @@ def test_vgg16_trains_one_batch():
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = startup.random_seed = 5
     with fluid.program_guard(main, startup):
+        # lr=0.01 overshoots to NaN by step 3 on a 2-sample random batch
+        # (1.31 -> 0.50 -> nan); at 1e-3 the two dropout(0.5) head layers
+        # make per-step loss noisy (1.31 -> 1.15 -> 1.36 under the test
+        # env's 8-device virtual CPU platform) but it is reliably below
+        # start by step 6 (0.91) -- measure over 6 steps, not 3
         images, label, loss, acc = build_train_net(
-            dshape=(3, 32, 32), class_dim=10, depth=16, lr=0.01)
+            dshape=(3, 32, 32), class_dim=10, depth=16, lr=0.001)
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(startup)
     r = np.random.RandomState(0)
     feed = {'data': r.randn(2, 3, 32, 32).astype(np.float32),
             'label': r.randint(0, 10, (2, 1)).astype(np.int64)}
     vals = []
-    for _ in range(3):
+    for _ in range(6):
         l, = exe.run(main, feed=feed, fetch_list=[loss])
         vals.append(float(np.asarray(l).reshape(-1)[0]))
     assert np.isfinite(vals).all(), vals

@@ -53,6 +53,25 @@ def _quantized_infer(build_logits, feed_shape, batch=2):
     return logits
 
 
+def _hfused_googlenet():
+    """Zoo builder for the horizontally-fused googlenet variant (ISSUE
+    16): build the train net, then widen the inception sibling convs IN
+    PLACE — the doctor/linter then examines the program the optimized
+    pipelines (CompiledProgram, export, bench ablation) actually run."""
+    import paddle_tpu as fluid
+    from paddle_tpu.passes.horizontal_fuse import horizontal_fuse_program
+    import models.googlenet
+    fetches = models.googlenet.build_train_net()[2:]
+    _, report = horizontal_fuse_program(
+        fluid.default_main_program(), fetch_names=_fetch_names(fetches),
+        inplace=True)
+    if not report.details.get('convs_fused'):
+        raise RuntimeError("horizontal_fuse found no inception sibling "
+                           "groups in googlenet: %s"
+                           % report.details.get('skip_reasons'))
+    return fetches
+
+
 def _model_builders():
     import models.alexnet
     import models.bert
@@ -81,6 +100,8 @@ def _model_builders():
         'alexnet': lambda: models.alexnet.build_train_net()[2:],
         'vgg': lambda: models.vgg.build_train_net(depth=16)[2:],
         'googlenet': lambda: models.googlenet.build_train_net()[2:],
+        # the horizontal_fuse rewrite of the same net (ISSUE 16)
+        'googlenet_hfused': _hfused_googlenet,
         'resnet': lambda: models.resnet.build_train_net(
             dshape=(3, 224, 224), class_dim=1000, depth=50,
             imagenet=True)[2:],
