@@ -210,7 +210,8 @@ def _pe_table(max_len, d_model):
 def build_decode_spec(vocab=67, d_model=32, n_head=4, n_layer=2, d_ff=64,
                       max_slots=8, max_cache_len=48, prompt_buckets=(8, 16),
                       eos_id=1, kv_cache_dtype='float32', block_size=None,
-                      num_blocks=None, chunk_sizes=None, mp_shard=0):
+                      num_blocks=None, chunk_sizes=None, mp_shard=0,
+                      draft_k=0):
     """Build the decode-serving program set for a decoder-only transformer
     LM. Returns the spec dict `inference.export_decode` consumes:
 
@@ -250,12 +251,26 @@ def build_decode_spec(vocab=67, d_model=32, n_head=4, n_layer=2, d_ff=64,
     every reduction stays full-width — export_decode traces the
     programs over the mesh and the sharded artifact's transcripts are
     BIT-IDENTICAL to the single-chip one. Requires k | n_head, k | d_ff.
+
+    draft_k=K (ISSUE 17): add a third, VERIFY program for speculative
+    decoding — [S, K+1] token/position rows score in ONE dispatch over
+    the same paged cache (KV written speculatively for every fed row,
+    row i attending j <= pos[s, i], so row i's logits match the plain
+    step's at the same accepted prefix). The verify program is built
+    LAST and shares every weight by name, so the step/prefill programs
+    (and the weights the per-op rng streams draw) are byte-for-byte
+    what a draft_k=0 build produces. Works in all four tier
+    combinations (slot/block x fp/int8). The serving tier drafts
+    host-side and rolls rejected rows back (inference/decoding.py).
     """
     import numpy as np
     PA = fluid.ParamAttr
     if kv_cache_dtype not in ('float32', 'int8'):
         raise ValueError("kv_cache_dtype must be 'float32' or 'int8', "
                          "got %r" % (kv_cache_dtype,))
+    if not 0 <= int(draft_k) <= int(max_cache_len) - 2:
+        raise ValueError('draft_k must be in [0, max_cache_len - 2], '
+                         'got %r' % (draft_k,))
     if block_size is not None:
         return _build_block_decode_spec(
             vocab=vocab, d_model=d_model, n_head=n_head, n_layer=n_layer,
@@ -263,7 +278,7 @@ def build_decode_spec(vocab=67, d_model=32, n_head=4, n_layer=2, d_ff=64,
             chunk_sizes=tuple(chunk_sizes or prompt_buckets),
             eos_id=eos_id, kv_cache_dtype=kv_cache_dtype,
             block_size=int(block_size), num_blocks=num_blocks,
-            mp_shard=int(mp_shard or 0))
+            mp_shard=int(mp_shard or 0), draft_k=int(draft_k))
     if mp_shard:
         raise ValueError(
             'mp_shard requires the block-paged layout — pass '
@@ -448,7 +463,63 @@ def build_decode_spec(vocab=67, d_model=32, n_head=4, n_layer=2, d_ff=64,
                         'slot': np.zeros((1, 1), np.int32)},
             'fetches': [pre_logits.name]}
 
-    return {'startup': startup,
+    # ---- verify program (ISSUE 17, built LAST so the op-creation rng
+    # order of step/prefill — and thus the weights — is untouched):
+    # [S, R] rows (R = draft_k + 1) score in one dispatch; pad rows
+    # carry pos = T (out-of-bounds scatter writes drop) -----------------
+    verify = None
+    if draft_k:
+        R = int(draft_k) + 1
+        vp = fluid.Program()
+        with fluid.program_guard(vp, startup):
+            vtok = fluid.layers.data(name='tokens', shape=[S, R],
+                                     append_batch_size=False,
+                                     dtype='int64')
+            vpos = fluid.layers.data(name='pos', shape=[S, R],
+                                     append_batch_size=False,
+                                     dtype='int32')
+            table = pe_param()
+            x = embed(vtok)                                 # [S, R, D]
+            # pad rows carry pos = T, past the PE table: clamp the
+            # GATHER index only (write positions keep the pad encoding
+            # — the OOB scatter is what drops them). An unclamped OOB
+            # gather is NaN-filled under jnp.take, and a NaN row would
+            # poison the whole batch through 0 * NaN in masked
+            # attention if it ever reached the cache
+            pe_idx = fluid.layers.clip(vpos, 0, T - 1)
+            pe_r = fluid.layers.gather(table, pe_idx)       # [S*R, D]
+            x = fluid.layers.elementwise_add(
+                x, fluid.layers.reshape(pe_r, shape=[S, R, D]))
+            for i in range(n_layer):
+                if kv_int8:
+                    kcache, vcache, kscale, vscale = caches(i)
+                    q, k, v = qkv(x, i, 2)
+                    kcache, kscale = \
+                        fluid.layers.kv_cache_verify_write_quant(
+                            kcache, kscale, k, vpos)
+                    vcache, vscale = \
+                        fluid.layers.kv_cache_verify_write_quant(
+                            vcache, vscale, v, vpos)
+                    a = fluid.layers.kv_cache_verify_attention_quant(
+                        q, kcache, kscale, vcache, vscale, vpos, n_head)
+                else:
+                    kcache, vcache = caches(i)
+                    q, k, v = qkv(x, i, 2)
+                    kcache = fluid.layers.kv_cache_verify_write(
+                        kcache, k, vpos)
+                    vcache = fluid.layers.kv_cache_verify_write(
+                        vcache, v, vpos)
+                    a = fluid.layers.kv_cache_verify_attention(
+                        q, kcache, vcache, vpos, n_head)
+                x = block_tail(x, a, i, 2)
+            verify_logits = out_logits(x, nfd=2)            # [S, R, V]
+        verify = {'program': vp,
+                  'feeds': ['tokens', 'pos'],
+                  'samples': {'tokens': np.zeros((S, R), np.int64),
+                              'pos': np.full((S, R), T, np.int32)},
+                  'fetches': [verify_logits.name]}
+
+    spec = {'startup': startup,
             'step': {'program': step_p,
                      'feeds': ['tokens', 'pos'],
                      'samples': {'tokens': np.zeros((S, 1), np.int64),
@@ -459,12 +530,16 @@ def build_decode_spec(vocab=67, d_model=32, n_head=4, n_layer=2, d_ff=64,
             'max_slots': S, 'max_cache_len': T,
             'eos_id': int(eos_id), 'vocab': int(vocab),
             'kv_cache_dtype': kv_cache_dtype}
+    if verify is not None:
+        spec['verify'] = verify
+        spec['draft_k'] = int(draft_k)
+    return spec
 
 
 def _build_block_decode_spec(vocab, d_model, n_head, n_layer, d_ff,
                              max_slots, max_cache_len, chunk_sizes,
                              eos_id, kv_cache_dtype, block_size,
-                             num_blocks, mp_shard):
+                             num_blocks, mp_shard, draft_k=0):
     """Block-paged decode spec (ISSUE 13; see build_decode_spec): the
     KV cache is a pool [num_blocks, block_size, D] addressed through
     block tables fed at dispatch time, prefill is CHUNKED (one program
@@ -720,6 +795,70 @@ def _build_block_decode_spec(vocab, d_model, n_head, n_layer, d_ff,
                         'block_table': np.zeros((1, MAXB), np.int32)},
             'fetches': [chunk_logits.name]}
 
+    # ---- verify program (ISSUE 17, built LAST — see the slot builder;
+    # pad rows carry pos = MAXB * BS, the span guard's trash route, so
+    # a pad row can never land in a SHARED full prefix block the way
+    # pos = T could when T is not block-aligned) -----------------------
+    verify = None
+    if draft_k:
+        R = int(draft_k) + 1
+        vp = fluid.Program()
+        with fluid.program_guard(vp, startup):
+            vtok = fluid.layers.data(name='tokens', shape=[S, R],
+                                     append_batch_size=False,
+                                     dtype='int64')
+            vpos = fluid.layers.data(name='pos', shape=[S, R],
+                                     append_batch_size=False,
+                                     dtype='int32')
+            vtab = fluid.layers.data(name='block_tables',
+                                     shape=[S, MAXB],
+                                     append_batch_size=False,
+                                     dtype='int32')
+            table = pe_param()
+            x = embed(vtok)                                 # [S, R, D]
+            # clamp the PE GATHER index only (pad rows carry
+            # pos = MAXB * BS, past the PE table): an unclamped OOB
+            # gather is NaN-filled under jnp.take, the pad rows' NaN
+            # k/v would land in the TRASH BLOCK, and 0 * NaN in every
+            # real row's masked attention would poison the whole batch
+            pe_idx = fluid.layers.clip(vpos, 0, T - 1)
+            pe_r = fluid.layers.gather(table, pe_idx)       # [S*R, D]
+            x = fluid.layers.elementwise_add(
+                x, fluid.layers.reshape(pe_r, shape=[S, R, D]))
+            x = _hint(x)
+            for i in range(n_layer):
+                if kv_int8:
+                    kcache, vcache, kscale, vscale = caches(i)
+                    q, k, v = qkv(x, i, 2)
+                    kcache, kscale = \
+                        fluid.layers.kv_block_verify_write_quant(
+                            kcache, kscale, k, vpos, vtab)
+                    vcache, vscale = \
+                        fluid.layers.kv_block_verify_write_quant(
+                            vcache, vscale, v, vpos, vtab)
+                    a = fluid.layers.kv_block_verify_attention_quant(
+                        q, kcache, kscale, vcache, vscale, vpos, vtab,
+                        n_head)
+                else:
+                    kcache, vcache = caches(i)
+                    q, k, v = qkv(x, i, 2)
+                    kcache = fluid.layers.kv_block_verify_write(
+                        kcache, k, vpos, vtab)
+                    vcache = fluid.layers.kv_block_verify_write(
+                        vcache, v, vpos, vtab)
+                    a = fluid.layers.kv_block_verify_attention(
+                        q, kcache, vcache, vpos, vtab, n_head)
+                x = block_tail(x, a, i, 2)
+            verify_logits = out_logits(x, nfd=2)            # [S, R, V]
+        verify = {'program': vp,
+                  'feeds': ['tokens', 'pos', 'block_tables'],
+                  'samples': {'tokens': np.zeros((S, R), np.int64),
+                              'pos': np.full((S, R), MAXB * BS,
+                                             np.int32),
+                              'block_tables': np.zeros((S, MAXB),
+                                                       np.int32)},
+                  'fetches': [verify_logits.name]}
+
     spec = {'startup': startup,
             'layout': 'block',
             'block_size': BS, 'num_blocks': NB,
@@ -736,6 +875,9 @@ def _build_block_decode_spec(vocab, d_model, n_head, n_layer, d_ff,
             'max_slots': S, 'max_cache_len': T,
             'eos_id': int(eos_id), 'vocab': int(vocab),
             'kv_cache_dtype': kv_cache_dtype}
+    if verify is not None:
+        spec['verify'] = verify
+        spec['draft_k'] = int(draft_k)
     if mp:
         spec['mesh_axes'] = {'mp': mp}
         spec['param_shardings'] = dict(param_shardings)

@@ -17,7 +17,9 @@
 - decoding: DecodingPredictor — continuous in-flight batching for
   autoregressive decode over export_decode's two-program artifact
   (prompt-bucketed prefill + fixed-slot decode step over a paged,
-  donated KV cache; token-streaming futures).
+  donated KV cache; token-streaming futures); speculative decoding
+  rides an optional third verify program with host-side drafters
+  (NgramDrafter / DraftModelDrafter).
 - fleet: FleetRouter — the replica-fleet control plane over any of the
   predictors above (subprocess workers via fleet_worker.py,
   least-outstanding-work routing with deadline propagation,
@@ -36,7 +38,8 @@ from .serve import (CompiledPredictor, load_compiled,
 from .batching import (BatchingPredictor, ServingStats, load_batching,
                        ServerOverloaded, DeadlineExceeded)
 from .decoding import (DecodingPredictor, DecodeStats, TokenStream,
-                       MidStreamEvicted, load_decoding)
+                       MidStreamEvicted, load_decoding,
+                       NgramDrafter, DraftModelDrafter)
 from .fleet import (FleetRouter, FleetStats, Autoscaler, RollingRollout,
                     ReplicaFailed, FleetUnavailable, RolloutRolledBack,
                     load_fleet)
@@ -49,6 +52,7 @@ __all__ = ['Config', 'Predictor', 'create_predictor',
            'export_train_step', 'CompiledTrainer', 'load_trainer',
            'export_decode', 'DecodingPredictor', 'DecodeStats',
            'TokenStream', 'MidStreamEvicted', 'load_decoding',
+           'NgramDrafter', 'DraftModelDrafter',
            'BatchingPredictor', 'ServingStats', 'load_batching',
            'ServerOverloaded', 'DeadlineExceeded',
            'FleetRouter', 'FleetStats', 'Autoscaler', 'RollingRollout',

@@ -35,6 +35,21 @@ computation is row-independent and masked rows carry exactly-zero
 attention weight (ops/decode_ops.py). Greedy and fixed-width beam search
 run host-side over the fetched logits with deterministic tie-breaking.
 
+Speculative decoding (ISSUE 17): artifacts exported with a VERIFY
+program (build_decode_spec(draft_k=K)) can serve greedy streams
+draft-and-verify — `DecodingPredictor(draft='ngram')` (or any object
+with a `draft(tokens, k)` method, e.g. `DraftModelDrafter`) proposes up
+to K tokens per slot host-side, ONE verify dispatch scores all K+1 rows
+per slot, and longest-prefix acceptance against the target argmax keeps
+greedy transcripts BIT-IDENTICAL to plain decode while advancing up to
+K+1 tokens per dispatch. Slots without drafts ride the plain step in
+the same scheduler tick; beams never draft. Rejected speculative cache
+rows sit strictly above each slot's accepted frontier (rolled-back
+`pos` masks them; the block layout also trims over-extended tables), so
+they are overwritten before any attention window admits them. Zero
+steady-state recompiles: variable per-slot acceptance lives inside the
+fixed [max_slots, K+1] compiled shape as masked pad rows.
+
 Framework-free: imports only stdlib + numpy + jax (+ sibling serve.py /
 batching.py for the artifact AOT helpers and the shedding exceptions).
 """
@@ -86,6 +101,9 @@ _REORDER_DIR = 'decode_reorder'
 # block-copy program (beam CoW moves diverged BLOCKS, not slot rows)
 _CHUNK_DIR = 'prefill_chunk_%05d'   # % chunk size
 _BLOCKCOPY_DIR = 'decode_blockcopy'
+# speculative decoding (ISSUE 17): the [S, K+1] -> [S, K+1, V] verify
+# program, present iff the spec was built with draft_k > 0
+_VERIFY_DIR = 'decode_verify'
 
 
 def _decode_mesh(axes, platform=None):
@@ -176,6 +194,15 @@ class DecodeStats(object):
         self.cow_blocks = 0      # blocks copied for beam copy-on-write
         self.blockcopies = 0     # block-copy dispatches
         self.chunk_slices = 0    # chunked-prefill slice dispatches
+        # speculative decoding (ISSUE 17). adv_* meter tokens delivered
+        # per request-advancing dispatch (prefill first token, plain
+        # step, beam step, verify tick) — tokens_per_dispatch is
+        # exactly 1.0 for non-speculative serving
+        self.verify_steps = 0    # verify-program dispatches
+        self.drafted = 0         # draft tokens proposed to the verifier
+        self.accepted = 0        # draft tokens accepted (prefix match)
+        self.adv_tokens = 0
+        self.adv_events = 0
 
     def reset(self):
         """Zero counters and latency windows (queue_depth is a live gauge
@@ -197,6 +224,11 @@ class DecodeStats(object):
             self.cow_blocks = 0
             self.blockcopies = 0
             self.chunk_slices = 0
+            self.verify_steps = 0
+            self.drafted = 0
+            self.accepted = 0
+            self.adv_tokens = 0
+            self.adv_events = 0
             if self.block_reset is not None:
                 # the BlockManager-sourced counters merge into
                 # snapshot(): a reset-then-measure window must not
@@ -224,7 +256,17 @@ class DecodeStats(object):
                     'expired': int(self.expired),
                     'drained': int(self.drained),
                     'ttft_p50_ms': ttft50, 'ttft_p99_ms': ttft99,
-                    'itl_p50_ms': itl50, 'itl_p99_ms': itl99}
+                    'itl_p50_ms': itl50, 'itl_p99_ms': itl99,
+                    # speculative decoding (ISSUE 17): both ratios are
+                    # identically 1.0 for plain (non-drafting) serving
+                    'verify_steps': int(self.verify_steps),
+                    'drafted': int(self.drafted),
+                    'accepted': int(self.accepted),
+                    'acc_rate': round(self.accepted / self.drafted, 4)
+                    if self.drafted else 1.0,
+                    'tokens_per_dispatch':
+                        round(self.adv_tokens / self.adv_events, 4)
+                        if self.adv_events else 1.0}
             if self.block_source is None:
                 return snap
             snap['cow_blocks'] = int(self.cow_blocks)
@@ -248,7 +290,13 @@ class TokenStream(object):
     `result()` for the full generated id list (eos included when
     emitted). Beam requests: `result()` -> (ids [beam, n_tokens] int64,
     scores [beam] float64), hypotheses sorted best-first; iteration
-    yields nothing until completion (beams reorder mid-flight)."""
+    yields nothing until completion (beams reorder mid-flight).
+
+    A speculative verify tick can deliver SEVERAL tokens at once; they
+    are queued as ONE batch. `__iter__` still yields token-at-a-time
+    (order preserved), `batches()` yields one list per delivery event —
+    the fleet wire protocol iterates batches so a verify tick costs one
+    frame, not K+1."""
 
     def __init__(self, beam=None):
         self.beam = beam
@@ -258,9 +306,19 @@ class TokenStream(object):
 
     # -- consumer side ----------------------------------------------------
     def __iter__(self):
+        for batch in self.batches():
+            for tok in batch:
+                yield tok
+
+    def batches(self):
+        """Yield token DELIVERY BATCHES: one list per producer push — a
+        plain decode step's singleton, or every token a speculative
+        verify tick advanced at once (ISSUE 17)."""
         while True:
             kind, payload = self._q.get()
             if kind == 'tok':
+                yield [payload]
+            elif kind == 'toks':
                 yield payload
             elif kind == 'end':
                 return
@@ -285,6 +343,11 @@ class TokenStream(object):
     def _push(self, tok):
         self._q.put(('tok', int(tok)))
 
+    def _push_many(self, toks):
+        """One queue entry for a whole verify-tick advance: consumers
+        see the multi-token delivery as a single batch (ISSUE 17)."""
+        self._q.put(('toks', [int(t) for t in toks]))
+
     def _finish(self, result):
         try:
             self._fut.set_result(result)
@@ -300,12 +363,93 @@ class TokenStream(object):
         self._q.put(('err', exc))
 
 
+class NgramDrafter(object):
+    """Host-side n-gram / prompt-lookup drafter (ISSUE 17): propose the
+    continuation that followed the most recent matching suffix of the
+    request's own transcript (prompt + generated tokens). Deterministic,
+    no device work, no extra artifact — the CPU-proxy-testable default
+    (`DecodingPredictor(draft='ngram')`). Shines on self-repetitive
+    text (code, structured output, retrieval-grounded answers); on
+    non-repetitive text it simply proposes nothing and the slot rides
+    the plain step.
+
+    `max_ngram` is the longest suffix length tried (longest first —
+    more context wins ties), `min_ngram` the shortest worth trusting."""
+
+    def __init__(self, max_ngram=3, min_ngram=1):
+        if not 1 <= int(min_ngram) <= int(max_ngram):
+            raise ValueError('need 1 <= min_ngram <= max_ngram')
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+
+    def draft(self, tokens, k):
+        """tokens: 1-D int array, full transcript so far. Returns up to
+        `k` proposed next tokens (possibly empty)."""
+        toks = np.asarray(tokens, np.int64).reshape(-1)
+        n = toks.size
+        if n < 2 or k < 1:
+            return []
+        for ng in range(min(self.max_ngram, n - 1),
+                        self.min_ngram - 1, -1):
+            suffix = toks[n - ng:]
+            # vectorized window compare (this runs on the scheduler
+            # thread every tick): hit[s] <=> toks[s:s+ng] == suffix,
+            # for every window start strictly before the suffix's own
+            hit = toks[:n - ng] == suffix[0]
+            for j in range(1, ng):
+                hit = hit & (toks[j:n - ng + j] == suffix[j])
+            starts = np.flatnonzero(hit)
+            if starts.size:
+                # the MOST RECENT earlier occurrence of the suffix
+                # predicts the continuation; past the transcript's end
+                # the proposal extends periodically (a transcript in an
+                # attractor cycle yields full-k proposals even when the
+                # match sits near the end)
+                s = int(starts[-1])
+                d = (n - ng) - s
+                out = []
+                for i in range(k):
+                    j = n + i - d
+                    out.append(int(toks[j]) if j < n else out[i - d])
+                return out
+        return []
+
+
+class DraftModelDrafter(object):
+    """Draft-model drafter (ISSUE 17): propose continuations by greedy
+    decode on a SECOND, smaller decode artifact. Wrap an already-warm
+    `DecodingPredictor` (typically a narrower/shallower model with the
+    same tokenizer — proposals are fed verbatim to the target's verify
+    program, so the vocabularies must agree; out-of-vocab proposals are
+    truncated by the scheduler).
+
+    `draft()` runs synchronously on the target's scheduler thread; keep
+    the draft artifact small enough that a k-token greedy decode costs
+    less than the step it replaces."""
+
+    def __init__(self, predictor):
+        if not callable(getattr(predictor, 'generate', None)):
+            raise ValueError('DraftModelDrafter wraps a '
+                             'DecodingPredictor-like object with '
+                             'generate(prompt, max_new_tokens)')
+        self._pred = predictor
+
+    def draft(self, tokens, k):
+        toks = np.asarray(tokens, np.int64)
+        T = getattr(self._pred, '_T', None)
+        if T is not None and toks.size >= int(T):
+            # keep the most recent window the draft artifact can hold
+            toks = toks[toks.size - int(T) + 1:]
+        out = self._pred.generate(toks, max_new_tokens=int(k))
+        return [int(t) for t in np.asarray(out).reshape(-1)[:k]]
+
+
 class _Request(object):
     __slots__ = ('prompt', 'max_new', 'beam', 'stream', 't_submit',
                  'deadline', 'slots', 'produced', 'tokens', 'last_tokens',
                  'scores', 'finished', 'hyps', 't_first', 't_last',
                  'tables', 'next_start', 'prefilling', 'match',
-                 'match_epoch')
+                 'match_epoch', 'draft_strikes', 'draft_cooldown')
 
     def __init__(self, prompt, max_new, beam, stream, deadline_ms):
         self.prompt = prompt
@@ -330,6 +474,9 @@ class _Request(object):
         self.prefilling = False           # still admitting via chunks
         self.match = None                 # cached (shared blocks, covered)
         self.match_epoch = -1             # prefix_epoch the match saw
+        # speculative decoding (ISSUE 17): acceptance-aware backoff
+        self.draft_strikes = 0            # consecutive all-rejected ticks
+        self.draft_cooldown = 0           # plain ticks before re-drafting
 
 
 class _DecodeModule(object):
@@ -461,6 +608,12 @@ def precompile_decode_artifact(artifact_dir, platform=None):
 
     written = [dir_(_STEP_DIR, [feed_specs(sig['step']['feeds'])],
                     donate=True)]
+    if sig.get('verify') is not None:
+        # speculative artifacts (ISSUE 17): the verify program warm-
+        # starts exactly like the step it rides beside
+        written.append(dir_(_VERIFY_DIR,
+                            [feed_specs(sig['verify']['feeds'])],
+                            donate=True))
     if sig.get('layout', 'slot') == 'block':
         for c in sig['chunk_buckets']:
             written.append(dir_(
@@ -502,11 +655,21 @@ class DecodingPredictor(object):
     bucket. `beam=` runs fixed-width beam search (the request occupies
     `beam` slots); default greedy. Admission is strict FIFO: a beam
     request at the head waits for enough free slots.
+
+    Speculative decoding (ISSUE 17): on an artifact exported with
+    `build_decode_spec(draft_k=K)`, pass `draft='ngram'` (host-side
+    prompt-lookup NgramDrafter) or any object with a
+    `draft(tokens, k) -> proposal list` method (e.g. DraftModelDrafter)
+    to serve greedy requests draft-and-verify: transcripts stay
+    bit-identical to plain decode, but an accepted draft advances up to
+    K+1 tokens in one dispatch. `draft_k=` narrows the per-tick draft
+    length below the exported K (the compiled shape is unchanged —
+    unused rows ride as masked pads). Beam requests ignore the drafter.
     """
 
     def __init__(self, artifact_dir, platform=None, max_queue=None,
                  default_max_new_tokens=32, stats_window=8192,
-                 tier=None):
+                 tier=None, draft=None, draft_k=None):
         import jax
         # tier resolution (ISSUE 12 satellite): `tier='int8'` serves a
         # quantized decode tier exported under <artifact>/int8/ — the
@@ -542,6 +705,36 @@ class DecodingPredictor(object):
             os.path.join(artifact_dir, _REORDER_DIR), donate_state=False,
             device=self._device, aot_tag=aot_tag)
         self._step_feeds = [e['name'] for e in self._sig['step']['feeds']]
+        # speculative decoding (ISSUE 17): load the verify program when
+        # the artifact carries one; attach a drafter only on request
+        self._verify_mod = None
+        self._drafter = None
+        self._draft_k = 0
+        vsig = self._sig.get('verify')
+        if vsig is not None:
+            self._verify_mod = _DecodeModule(
+                os.path.join(artifact_dir, _VERIFY_DIR),
+                donate_state=True, device=self._device, aot_tag=aot_tag)
+            self._verify_feeds = [e['name'] for e in vsig['feeds']]
+            self._K = int(vsig['draft_k'])
+        if draft is not None:
+            if vsig is None:
+                raise ValueError(
+                    "draft= needs an artifact exported with a verify "
+                    "program (build_decode_spec(draft_k=K)); this "
+                    "artifact carries none")
+            self._drafter = NgramDrafter() if draft == 'ngram' else draft
+            if not callable(getattr(self._drafter, 'draft', None)):
+                raise ValueError(
+                    "draft= must be 'ngram' or an object with a "
+                    "draft(tokens, k) method")
+            self._draft_k = self._K
+            if draft_k is not None:
+                if not 1 <= int(draft_k) <= self._K:
+                    raise ValueError(
+                        'draft_k must be in [1, %d] (the exported '
+                        'verify width)' % self._K)
+                self._draft_k = int(draft_k)
         if self._layout == 'block':
             blk = self._sig['block']
             self._bs = int(blk['block_size'])
@@ -719,9 +912,10 @@ class DecodingPredictor(object):
 
     def warmup(self):
         """Compile every program ahead of traffic (a no-op dispatch per
-        prefill bucket, one decode step, one reorder); state is re-zeroed
-        afterwards. With AOT sidecars loaded this costs three dispatches
-        and zero compiles. Must run BEFORE any submit(): it dispatches on
+        prefill bucket, one decode step, one all-pad verify tick on
+        speculative artifacts, one reorder); state is re-zeroed
+        afterwards. With AOT sidecars loaded this costs a handful of
+        dispatches and zero compiles. Must run BEFORE any submit(): it dispatches on
         the scheduler's donated state from this thread, so it refuses
         loudly once traffic has started."""
         if self.stats.queue_depth or any(s is not None
@@ -745,6 +939,19 @@ class DecodingPredictor(object):
                 self._dispatch_prefill(b, np.zeros((1, b), np.int64), 1, 0)
             self._dispatch_step(np.zeros((self._S, 1), np.int64),
                                 np.zeros((self._S, 1), np.int32))
+        if self._verify_mod is not None:
+            # all-pad verify dispatch (ISSUE 17): every row at the pad
+            # position, so the scatter drops (slot) / routes to the
+            # trash block (block) and the dispatch is pure compile-warm
+            R = self._K + 1
+            pad = (self._maxb * self._bs if self._layout == 'block'
+                   else self._T)
+            self._dispatch_verify(
+                np.zeros((self._S, R), np.int64),
+                np.full((self._S, R), pad, np.int32),
+                tables=(np.full((self._S, self._maxb), self._trash,
+                                np.int32)
+                        if self._layout == 'block' else None))
         self._reset_state()
         self.stats.reset()   # warmup dispatches must not count as traffic
         return self
@@ -850,6 +1057,23 @@ class DecodingPredictor(object):
         with self.stats._lock:
             self.stats.steps += 1
         return np.asarray(fetches[0])                      # [S, V] sync
+
+    def _dispatch_verify(self, tokens, pos, tables=None):
+        """One speculative verify dispatch (ISSUE 17): tokens/pos are
+        [S, K+1] (row 0 the slot's pending last token, rows 1..k its
+        draft; pad rows/slots at the layout's pad position), logits come
+        back [S, K+1, V]. KV for all fed positions is written inside the
+        program; acceptance and rollback happen host-side after."""
+        feed = {'tokens': tokens, 'pos': pos}
+        if tables is not None:
+            feed['block_tables'] = tables
+        args = [self._feed(feed[n]) for n in self._verify_feeds]
+        with self._dev_ctx():
+            fetches, new_state = self._verify_mod.call(self._state, args)
+        self._state = list(new_state)
+        with self.stats._lock:
+            self.stats.verify_steps += 1
+        return np.asarray(fetches[0])                   # [S, K+1, V] sync
 
     def _dispatch_prefill(self, bucket, padded, plen, slot):
         feed = {'prompt_ids': padded,
@@ -1232,14 +1456,16 @@ class DecodingPredictor(object):
             self._blocks.register_prefix(req.prompt, req.tables[0])
             self._first_token(req, logits)
 
-    def _live_rows(self):
+    def _live_rows(self, skip=()):
         """(request, beam index, write position) for every slot that
         writes this step: decoding requests' unfinished beams. Finished
         beams idle (trash row) — their frozen candidate needs no cache
-        writes, and skipping them avoids spurious CoW/extension."""
+        writes, and skipping them avoids spurious CoW/extension.
+        Requests in `skip` (this tick's drafted set — they advance via
+        the verify dispatch instead) are excluded."""
         rows = []
         for req in self._active_requests():
-            if req.prefilling:
+            if req.prefilling or req in skip:
                 continue
             for bi in range(len(req.slots)):
                 if req.beam is not None and req.finished[bi]:
@@ -1248,27 +1474,36 @@ class DecodingPredictor(object):
                 rows.append((req, bi, p))
         return rows
 
-    def _preflight_blocks(self, waiting=()):
-        """Reserve this step's exact fresh-block demand (one per row
-        whose write block must extend or copy-on-write) BEFORE building
-        the dispatch. Pressure resolves in severity order: first
-        un-pin WAITING requests' cached prefix matches (their refs can
-        make prefix entries non-evictable; a queued request simply
-        re-matches at its next admission attempt), only then shed the
-        YOUNGEST decoding request — never kill an in-flight stream for
-        a pin a queued request can re-acquire. All-or-nothing, so row
-        building never unwinds a half-planned step."""
+    def _preflight_blocks(self, waiting=(), rows_fn=None):
+        """Reserve this step's exact fresh-block demand (one per block
+        that must extend or copy-on-write across each row's write SPAN)
+        BEFORE building the dispatch. Pressure resolves in severity
+        order: first un-pin WAITING requests' cached prefix matches
+        (their refs can make prefix entries non-evictable; a queued
+        request simply re-matches at its next admission attempt), only
+        then shed the YOUNGEST decoding request — never kill an
+        in-flight stream for a pin a queued request can re-acquire.
+        All-or-nothing, so row building never unwinds a half-planned
+        step. `rows_fn` yields (req, bi, p, span) rows — the default is
+        this step's live rows with span 1; the speculative verify tick
+        passes its drafted rows with span draft+1 (ISSUE 17). It is a
+        CALLABLE because shedding a victim must drop its rows from the
+        re-count."""
+        if rows_fn is None:
+            rows_fn = lambda: [(r, b, p, 1)
+                               for r, b, p in self._live_rows()]
         while True:
             need = 0
             shared = {}
-            for req, bi, p in self._live_rows():
+            for req, bi, p, span in rows_fn():
                 table = req.tables[bi]
-                lblk = p // self._bs
-                if lblk >= len(table):
-                    need += 1            # extension: always a fresh block
-                elif not self._blocks.writable(table[lblk]):
-                    b = table[lblk]
-                    shared[b] = shared.get(b, 0) + 1
+                for lblk in range(p // self._bs,
+                                  (p + span - 1) // self._bs + 1):
+                    if lblk >= len(table):
+                        need += 1        # extension: always a fresh block
+                    elif not self._blocks.writable(table[lblk]):
+                        b = table[lblk]
+                        shared[b] = shared.get(b, 0) + 1
             for b, k in shared.items():
                 # k rows CoW the same block in table order; each CoW
                 # decrefs it, so the LAST sharer writes in place when no
@@ -1319,14 +1554,23 @@ class DecodingPredictor(object):
         blocks), then every live slot advances one token through the
         fixed-shape step; beam reorder afterwards is pure block-table
         permutation (incref/decref, zero device work until the next
-        write diverges a shared tail block)."""
+        write diverges a shared tail block). With a drafter attached,
+        slots holding drafts ride ONE verify dispatch first (ISSUE 17)
+        and the plain step below covers only the undrafted remainder —
+        a fully-drafted batch skips the plain dispatch entirely."""
+        drafted = self._collect_drafts()
+        if drafted:
+            self._verify_block(drafted, waiting)
         tokens = np.zeros((self._S, 1), np.int64)
         pos = np.zeros((self._S, 1), np.int32)
         tables = np.full((self._S, self._maxb), self._trash, np.int32)
-        self._preflight_blocks(waiting)
+        self._preflight_blocks(
+            waiting,
+            rows_fn=lambda: [(r, b, p, 1) for r, b, p
+                             in self._live_rows(skip=drafted)])
         cow = []
         active = 0
-        for req, bi, p in self._live_rows():
+        for req, bi, p in self._live_rows(skip=drafted):
             self._ensure_writable(req, bi, p, cow)
             s = req.slots[bi]
             active += 1
@@ -1335,7 +1579,7 @@ class DecodingPredictor(object):
             table = req.tables[bi]
             tables[s, :len(table)] = table
         if not active:
-            return   # preflight shed every live stream: nothing to step
+            return   # every live stream drafted (or shed): no plain step
         with self.stats._lock:
             self.stats.active_slot_steps += active
             self.stats.slot_steps += self._S
@@ -1344,7 +1588,7 @@ class DecodingPredictor(object):
         logits = self._dispatch_step(tokens, pos, tables=tables)
         now = time.perf_counter()
         for req in self._active_requests():
-            if req.prefilling:
+            if req.prefilling or req in drafted:
                 continue
             if req.beam is None:
                 self._advance_greedy(req, logits, now)
@@ -1380,6 +1624,181 @@ class DecodingPredictor(object):
         if tok == self._eos or req.produced >= req.max_new:
             self._finish_greedy(req)
 
+    # -- speculative decoding (ISSUE 17) -----------------------------------
+    def _collect_drafts(self):
+        """Host-side draft collection at the tick boundary: every
+        greedy, fully-prefilled request asks the drafter for up to
+        min(draft_k, remaining max_new budget - 1, cache headroom)
+        proposal tokens. Returns {request: draft token list}. Empty or
+        failed drafts simply ride the plain step — a broken drafter can
+        cost speed, never correctness or the serving loop."""
+        if self._drafter is None:
+            return {}
+        drafted = {}
+        for req in self._active_requests():
+            if req.beam is not None or req.prefilling:
+                continue
+            if req.draft_cooldown > 0:
+                # acceptance-aware backoff: a request whose drafts keep
+                # getting fully rejected rides plain steps for
+                # exponentially longer stretches, so a hostile context
+                # (or drafter) costs ~log(max_new) verify ticks total
+                # instead of one per tick
+                req.draft_cooldown -= 1
+                continue
+            p = int(req.prompt.size) + req.produced - 1
+            # verify rows write positions p..p+k: k is bounded by the
+            # cache (p + k <= T-1) and by the emission budget (a draft
+            # of k can emit k+1 tokens, so k <= max_new - produced - 1;
+            # the final token always comes from a plain step or the
+            # verify bonus row)
+            k_max = min(self._draft_k, req.max_new - req.produced - 1,
+                        self._T - 1 - p)
+            if k_max < 1:
+                continue
+            ctx = np.concatenate(
+                [req.prompt, np.asarray(req.tokens, np.int64)])
+            try:
+                d = self._drafter.draft(ctx, k_max)
+            except Exception:
+                d = None
+            if d is None or len(d) == 0:
+                continue
+            toks = []
+            for t in list(d)[:k_max]:
+                t = int(t)
+                if not 0 <= t < self._vocab:
+                    break   # an out-of-vocab proposal cannot be fed
+                toks.append(t)
+            if toks:
+                drafted[req] = toks
+        return drafted
+
+    def _advance_spec(self, req, draft, row_logits, now):
+        """Longest-prefix acceptance against the target argmax: row i
+        of `row_logits` [K+1, V] was computed with rows < i's tokens in
+        context, so its logits equal the plain step's EXACTLY while the
+        draft prefix matches. Emitting greedily row by row until the
+        draft diverges (the diverging row still contributes its
+        CORRECTED token; full acceptance adds the K+1'th bonus token),
+        or eos / max_new truncates, reproduces the plain greedy
+        transcript bit-for-bit. Returns the emitted token list."""
+        k = len(draft)
+        emitted = []
+        for i in range(k + 1):
+            g = int(np.argmax(row_logits[i]))
+            emitted.append(g)
+            if g == self._eos \
+                    or req.produced + len(emitted) >= req.max_new:
+                break   # transcript truncates exactly as plain decode
+            if i == k or draft[i] != g:
+                break   # row i+1 was fed a token != true continuation
+        accepted = sum(1 for i in range(min(len(emitted), k))
+                       if draft[i] == emitted[i])
+        if accepted == 0:
+            req.draft_strikes += 1
+            req.draft_cooldown = 1 << min(req.draft_strikes, 6)
+        else:
+            req.draft_strikes = 0
+        req.last_tokens[0] = emitted[-1]
+        req.tokens.extend(emitted)
+        req.produced += len(emitted)
+        with self.stats._lock:
+            self.stats.drafted += k
+            self.stats.accepted += accepted
+        self._record_emit(req, now, count=len(emitted), events=1)
+        req.stream._push_many(emitted)
+        if emitted[-1] == self._eos or req.produced >= req.max_new:
+            self._finish_greedy(req)
+        return emitted
+
+    def _verify_slot(self, drafted):
+        """Verify tick, slot layout: ONE [S, K+1] dispatch scores every
+        drafted slot's pending token + draft. Undrafted rows ride at
+        pos = max_cache_len — the cache scatter DROPS out-of-bounds
+        rows, so they neither write nor perturb anyone. Rejected
+        speculative rows land strictly above the accepted frontier
+        (req.produced rolls the next write position back), where the
+        write-before-attend program order overwrites them before any
+        mask admits them."""
+        R = self._K + 1
+        tokens = np.zeros((self._S, R), np.int64)
+        pos = np.full((self._S, R), self._T, np.int32)
+        live = self._active_requests()
+        rows = [(req, d) for req, d in drafted.items() if req in live]
+        if not rows:
+            return
+        for req, draft in rows:
+            s = req.slots[0]
+            p = int(req.prompt.size) + req.produced - 1
+            k = len(draft)
+            tokens[s, 0] = req.last_tokens[0]
+            tokens[s, 1:1 + k] = draft
+            pos[s, :k + 1] = p + np.arange(k + 1, dtype=np.int32)
+        with self.stats._lock:
+            self.stats.active_slot_steps += len(rows)
+            self.stats.slot_steps += self._S
+        logits = self._dispatch_verify(tokens, pos)
+        now = time.perf_counter()
+        for req, draft in rows:
+            self._advance_spec(req, draft, logits[req.slots[0]], now)
+
+    def _verify_block(self, drafted, waiting):
+        """Verify tick, block layout: preflight/extend/CoW every block
+        in each drafted slot's speculative span, dispatch ONE verify
+        program (undrafted rows ride as all-pad trash-table rows), then
+        ROLL each table BACK to the accepted frontier — blocks covering
+        only rejected speculative positions free immediately, and the
+        trimmed table re-extends on demand next tick."""
+        R = self._K + 1
+        pad_pos = self._maxb * self._bs
+
+        def rows_fn():
+            live = self._active_requests()
+            return [(req, 0,
+                     int(req.prompt.size) + req.produced - 1,
+                     len(d) + 1)
+                    for req, d in drafted.items() if req in live]
+
+        self._preflight_blocks(waiting, rows_fn=rows_fn)
+        rows = rows_fn()
+        if not rows:
+            return   # preflight shed every drafted stream
+        cow = []
+        tokens = np.zeros((self._S, R), np.int64)
+        pos = np.full((self._S, R), pad_pos, np.int32)
+        tables = np.full((self._S, self._maxb), self._trash, np.int32)
+        for req, bi, p, span in rows:
+            for q in range(p, p + span):
+                self._ensure_writable(req, bi, q, cow)
+            draft = drafted[req]
+            s = req.slots[0]
+            k = len(draft)
+            tokens[s, 0] = req.last_tokens[0]
+            tokens[s, 1:1 + k] = draft
+            pos[s, :k + 1] = p + np.arange(k + 1, dtype=np.int32)
+            table = req.tables[0]
+            tables[s, :len(table)] = table
+        with self.stats._lock:
+            self.stats.active_slot_steps += len(rows)
+            self.stats.slot_steps += self._S
+        # a speculative span can CoW/extend more blocks than one
+        # blockcopy dispatch's S pairs: chunk
+        for i in range(0, len(cow), self._S):
+            self._dispatch_blockcopy(cow[i:i + self._S])
+        logits = self._dispatch_verify(tokens, pos, tables=tables)
+        now = time.perf_counter()
+        for req, bi, p, span in rows:
+            s = req.slots[0]
+            self._advance_spec(req, drafted[req], logits[s], now)
+            if self._slots[s] is not None and self._slots[s][0] is req:
+                # still decoding: positions 0..plen+produced-2 hold real
+                # KV (the newest emitted token writes NEXT tick); drop
+                # the wholly-speculative tail blocks
+                self._blocks.rollback(
+                    req.tables[0],
+                    int(req.prompt.size) + req.produced - 1)
+
     def _score_beam(self, req, logits):
         """Fixed-width beam candidate scoring (finished beams
         contribute one frozen eos candidate — ops/decode_ops.py
@@ -1407,9 +1826,17 @@ class DecodingPredictor(object):
         req.last_tokens = [int(t) for t in toks]
         return parents
 
-    def _record_emit(self, req, now, count=1):
+    def _record_emit(self, req, now, count=1, events=None):
         with self.stats._lock:
             self.stats.tokens += count
+            # advance accounting (ISSUE 17): `events` defaults to
+            # `count` (greedy step / beam step / prefill first token
+            # all deliver count tokens over count per-row advances), so
+            # plain serving meters tokens_per_dispatch exactly 1.0; a
+            # verify tick passes events=1 for its multi-token advance
+            self.stats.adv_tokens += count
+            self.stats.adv_events += (count if events is None
+                                      else events)
             if req.t_first is None:
                 req.t_first = now
                 self.stats._ttft.append(now - req.t_submit)
@@ -1433,7 +1860,16 @@ class DecodingPredictor(object):
 
     def _step(self):
         """One iteration of the continuous batch: every active slot
-        advances one token through ONE fixed-shape dispatch."""
+        advances one token through ONE fixed-shape dispatch. With a
+        drafter attached, slots holding drafts ride ONE verify dispatch
+        first (ISSUE 17); in the plain step they idle at the TOP cache
+        position — always strictly above an active slot's frontier, so
+        the garbage row is overwritten by a real write before any
+        attention mask admits it — and a fully-drafted batch skips the
+        plain dispatch entirely."""
+        drafted = self._collect_drafts()
+        if drafted:
+            self._verify_slot(drafted)
         tokens = np.zeros((self._S, 1), np.int64)
         pos = np.zeros((self._S, 1), np.int32)
         active = 0
@@ -1441,10 +1877,15 @@ class DecodingPredictor(object):
             if entry is None:
                 continue
             req, bi = entry
+            if req in drafted:
+                pos[s, 0] = self._T - 1   # advanced via verify this tick
+                continue
             active += 1
             tokens[s, 0] = req.last_tokens[bi]
             # this token writes at position len(prompt) + produced - 1
             pos[s, 0] = req.prompt.size + req.produced - 1
+        if not active:
+            return   # every live stream drafted: no plain step
         with self.stats._lock:
             self.stats.active_slot_steps += active
             self.stats.slot_steps += self._S
@@ -1452,6 +1893,8 @@ class DecodingPredictor(object):
         now = time.perf_counter()
         src = np.arange(self._S, dtype=np.int32)
         for req in self._active_requests():
+            if req in drafted:
+                continue
             if req.beam is None:
                 self._advance_greedy(req, logits, now)
                 continue

@@ -411,6 +411,14 @@ def export_decode(spec, out_dir, scope=None, precompile=None,
     devices. Sharded artifacts are single-platform (the exporting
     backend).
 
+    Speculative-decode specs (ISSUE 17, build_decode_spec(draft_k=K))
+    export a THIRD program, decode_verify/: [max_slots, K+1] token and
+    position rows score in one dispatch over the same donated cache
+    state, with its own AOT warm-start sidecar. The signature bumps to
+    version 3 and gains an optional 'verify' block ({feeds, fetches,
+    draft_k}); version-2 artifacts keep loading (speculative decode
+    simply unavailable).
+
     Load with inference/decoding.py DecodingPredictor (framework-free).
     Returns out_dir.
     """
@@ -448,6 +456,21 @@ def export_decode(spec, out_dir, scope=None, precompile=None,
     step_feeds = _export_decode_program(
         step, state_names, state0, scope,
         os.path.join(out_dir, _decoding._STEP_DIR), shard=shard)
+    verify_sig = None
+    verify = spec.get('verify')
+    if verify is not None:
+        # ISSUE 17: third program — same feed NAMES as the step (the
+        # verify tick is a step with R = draft_k + 1 rows per slot)
+        if sorted(verify['feeds']) != step_want:
+            raise ValueError("decode-verify feeds must be %r, got %r"
+                             % (step_want, verify['feeds']))
+        verify_sig = {
+            'feeds': _export_decode_program(
+                verify, state_names, state0, scope,
+                os.path.join(out_dir, _decoding._VERIFY_DIR),
+                shard=shard),
+            'fetches': list(verify['fetches']),
+            'draft_k': int(spec['draft_k'])}
     prefill_sig = {}
     chunk_sig = {}
     if layout == 'block':
@@ -494,7 +517,7 @@ def export_decode(spec, out_dir, scope=None, precompile=None,
                            os.path.join(out_dir, _decoding._REORDER_DIR),
                            shard=shard)
 
-    sig = {'version': 2, 'kind': 'decode',
+    sig = {'version': 3, 'kind': 'decode',
            'layout': layout,
            'max_slots': int(spec['max_slots']),
            'max_cache_len': int(spec['max_cache_len']),
@@ -507,6 +530,8 @@ def export_decode(spec, out_dir, scope=None, precompile=None,
                       'dtype': a.dtype.name}
                      for n, a in zip(state_names, state0)],
            'step': {'feeds': step_feeds, 'fetches': list(step['fetches'])}}
+    if verify_sig is not None:
+        sig['verify'] = verify_sig
     if layout == 'block':
         sig['block'] = {'block_size': int(spec['block_size']),
                         'num_blocks': int(spec['num_blocks']),

@@ -572,7 +572,18 @@ class FleetRouter(object):
         future resolves to the transcript (greedy: token list, beam:
         (ids, scores)); `on_token(tok)` streams greedy tokens as they
         decode. `deadline_ms` propagates: router queue time counts
-        against the same budget the replica enforces."""
+        against the same budget the replica enforces.
+
+        `on_token` contract: called once per token, in transcript
+        order, from the router's reader thread. Delivery granularity
+        follows the replica's advance granularity — a speculatively
+        decoding replica (ISSUE 17) coalesces each verify tick's whole
+        multi-token advance into ONE wire frame, and the router then
+        fires `on_token` for each token of the batch back-to-back, so
+        several calls may land with no network round-trip between them.
+        Callbacks must not assume one frame (or one decode step) per
+        call; exceptions are swallowed (a streaming callback can never
+        kill the reader)."""
         if self._closed:
             raise RuntimeError('FleetRouter is closed')
         header, arrays = self._encode_request(inputs, max_new_tokens,
@@ -763,6 +774,10 @@ class FleetRouter(object):
                 self._on_result(rep, hdr, arrays)
             elif op == 'tok':
                 self._on_tok(rep, hdr)
+            elif op == 'toks':
+                # coalesced multi-token frame (ISSUE 17): one frame per
+                # speculative verify tick, on_token fired per token
+                self._on_toks(rep, hdr)
             elif op == 'drained':
                 rep.drained_evt.set()
             # 'bye' and unknown ops: nothing to do
@@ -779,6 +794,23 @@ class FleetRouter(object):
                 req.on_token(int(hdr['tok']))
             except Exception:
                 pass  # a streaming callback must never kill the reader
+
+    def _on_toks(self, rep, hdr):
+        """One coalesced frame per speculative verify tick (ISSUE 17):
+        `on_token` fires per token, in order — the callback contract is
+        unchanged, only the framing is batched."""
+        req = rep.outstanding.get(hdr.get('id'))
+        if req is None:
+            return
+        now = time.perf_counter()
+        if req.t_first is None:
+            req.t_first = now
+        if req.on_token is not None:
+            for t in hdr.get('toks', ()):
+                try:
+                    req.on_token(int(t))
+                except Exception:
+                    pass  # a streaming callback must never kill the reader
 
     def _on_result(self, rep, hdr, arrays):
         with self._lock:

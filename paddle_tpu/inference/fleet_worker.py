@@ -153,7 +153,10 @@ class _DecodingEndpoint(object):
 
     def __init__(self, artifact, opts):
         kw = {}
-        for k in ('tier', 'platform', 'max_queue'):
+        # 'draft' (ISSUE 17): 'ngram' attaches the host-side prompt-
+        # lookup drafter — the only drafter expressible in a spawn
+        # config; 'draft_k' narrows the per-tick draft length
+        for k in ('tier', 'platform', 'max_queue', 'draft', 'draft_k'):
             if opts.get(k) is not None:
                 kw[k] = opts[k]
         if opts.get('default_max_new') is not None:
@@ -188,9 +191,17 @@ class _DecodingEndpoint(object):
     def _pump(self, req_id, hdr, stream, conn):
         try:
             if stream.beam is None and hdr.get('stream'):
-                for tok in stream:  # tokens stream as steps complete
-                    conn.send({'op': 'tok', 'id': req_id,
-                               'tok': int(tok)})
+                # one frame per DELIVERY BATCH (ISSUE 17): a plain step
+                # sends the singleton 'tok' frame, a speculative verify
+                # tick coalesces its whole multi-token advance into one
+                # 'toks' frame instead of K+1 round-trips
+                for batch in stream.batches():
+                    if len(batch) == 1:
+                        conn.send({'op': 'tok', 'id': req_id,
+                                   'tok': int(batch[0])})
+                    else:
+                        conn.send({'op': 'toks', 'id': req_id,
+                                   'toks': [int(t) for t in batch]})
             res = stream.result(600)
         except Exception as e:
             # stream-side failure: the request may have decoded tokens

@@ -226,6 +226,22 @@ class BlockManager(object):
                     self._free.append(b)
                     self.frees += 1
 
+    def rollback(self, table, n_tokens):
+        """Truncate `table` (in place) to the blocks an n_tokens span
+        occupies, releasing the tail. The speculative verify tick
+        (ISSUE 17) extends a table to cover its whole draft span BEFORE
+        dispatch; after host-side acceptance, blocks covering ONLY
+        rejected positions are dead weight — rolling back returns them
+        to the pool immediately instead of stranding them until the
+        request finishes. Returns the number of blocks released."""
+        keep = self.blocks_for(n_tokens)
+        if len(table) <= keep:
+            return 0
+        tail = list(table[keep:])
+        del table[keep:]
+        self.decref(tail)
+        return len(tail)
+
     def refcount(self, block):
         with self._lock:
             return self._ref[block]

@@ -1824,6 +1824,134 @@ def kv_block_chunk_attention_quant(query, k_cache, k_scale, v_cache,
     return out
 
 
+def kv_cache_verify_write(cache, kv, pos):
+    """Speculative-decode primitive (ISSUE 17): write R = draft_k + 1
+    speculative K or V rows per slot ([max_slots, R, d]) into the
+    slot-paged `cache` at per-row positions `pos` [max_slots, R] int32.
+    Pad rows carry pos = max_cache_len (out-of-bounds scatter rows
+    drop — no write). In-place on `cache`, like kv_cache_write."""
+    helper = LayerHelper('kv_cache_verify_write')
+    helper.append_op(type='kv_cache_verify_write',
+                     inputs={'Cache': cache, 'KV': kv, 'Pos': pos},
+                     outputs={'Out': cache}, attrs={})
+    return cache
+
+
+def kv_cache_verify_attention(query, k_cache, v_cache, pos, n_head,
+                              scale=None):
+    """Verify attention over the slot-paged cache: `query`
+    [max_slots, R, d] row i attends its slot's cache rows
+    j <= pos[s, i] — a per-row frontier, so one dispatch scores every
+    drafted continuation length at once. Row-wise the body is exactly
+    kv_cache_attention's expression (bit-comparable to the plain step;
+    ops/decode_ops.py)."""
+    helper = LayerHelper('kv_cache_verify_attention')
+    out = helper.create_variable_for_type_inference(query.dtype)
+    helper.append_op(type='kv_cache_verify_attention',
+                     inputs={'Q': query, 'KCache': k_cache,
+                             'VCache': v_cache, 'Pos': pos},
+                     outputs={'Out': out},
+                     attrs={'n_head': int(n_head),
+                            'scale': float(scale or 0.0)})
+    out.stop_gradient = True
+    return out
+
+
+def kv_cache_verify_write_quant(cache, cache_scale, kv, pos):
+    """kv_cache_verify_write over the INT8 paged cache: each
+    speculative row quantizes at its own abs-max page scale; pad rows
+    drop both row and scale. In-place on the (cache, scale) pair."""
+    helper = LayerHelper('kv_cache_verify_write_quant')
+    helper.append_op(type='kv_cache_verify_write_quant',
+                     inputs={'Cache': cache, 'Scale': cache_scale,
+                             'KV': kv, 'Pos': pos},
+                     outputs={'Out': cache, 'OutScale': cache_scale},
+                     attrs={})
+    return cache, cache_scale
+
+
+def kv_cache_verify_attention_quant(query, k_cache, k_scale, v_cache,
+                                    v_scale, pos, n_head, scale=None):
+    """kv_cache_verify_attention over the INT8 paged cache: K/V rows
+    dequantize inside the body, then the exact fp verify expression."""
+    helper = LayerHelper('kv_cache_verify_attention_quant')
+    out = helper.create_variable_for_type_inference(query.dtype)
+    helper.append_op(type='kv_cache_verify_attention_quant',
+                     inputs={'Q': query, 'KCache': k_cache,
+                             'KScale': k_scale, 'VCache': v_cache,
+                             'VScale': v_scale, 'Pos': pos},
+                     outputs={'Out': out},
+                     attrs={'n_head': int(n_head),
+                            'scale': float(scale or 0.0)})
+    out.stop_gradient = True
+    return out
+
+
+def kv_block_verify_write(cache, kv, pos, block_table):
+    """kv_cache_verify_write over the BLOCK pool: R speculative rows
+    per slot scatter through the slot's `block_table` row (broadcast
+    over its R rows). Pad rows carry pos = max_blocks * block_size,
+    which the scatter's span guard forces to the trash block — never a
+    shared prefix block. In-place on `cache`."""
+    helper = LayerHelper('kv_block_verify_write')
+    helper.append_op(type='kv_block_verify_write',
+                     inputs={'Cache': cache, 'KV': kv, 'Pos': pos,
+                             'BlockTable': block_table},
+                     outputs={'Out': cache}, attrs={})
+    return cache
+
+
+def kv_block_verify_attention(query, k_cache, v_cache, pos, block_table,
+                              n_head, scale=None):
+    """kv_cache_verify_attention over the block pool: per-slot logical
+    views gather through `block_table`, row i masks at j <= pos[s, i].
+    Foreign blocks and trash garbage get exactly-zero weight."""
+    helper = LayerHelper('kv_block_verify_attention')
+    out = helper.create_variable_for_type_inference(query.dtype)
+    helper.append_op(type='kv_block_verify_attention',
+                     inputs={'Q': query, 'KCache': k_cache,
+                             'VCache': v_cache, 'Pos': pos,
+                             'BlockTable': block_table},
+                     outputs={'Out': out},
+                     attrs={'n_head': int(n_head),
+                            'scale': float(scale or 0.0)})
+    out.stop_gradient = True
+    return out
+
+
+def kv_block_verify_write_quant(cache, cache_scale, kv, pos, block_table):
+    """kv_block_verify_write over the INT8 block pool: speculative rows
+    quantize per page position and scatter with their scales through
+    the broadcast tables. In-place on the pair."""
+    helper = LayerHelper('kv_block_verify_write_quant')
+    helper.append_op(type='kv_block_verify_write_quant',
+                     inputs={'Cache': cache, 'Scale': cache_scale,
+                             'KV': kv, 'Pos': pos,
+                             'BlockTable': block_table},
+                     outputs={'Out': cache, 'OutScale': cache_scale},
+                     attrs={})
+    return cache, cache_scale
+
+
+def kv_block_verify_attention_quant(query, k_cache, k_scale, v_cache,
+                                    v_scale, pos, block_table, n_head,
+                                    scale=None):
+    """kv_block_verify_attention over the INT8 block pool: per-slot
+    views dequantize inside the body, then the fp verify expression."""
+    helper = LayerHelper('kv_block_verify_attention_quant')
+    out = helper.create_variable_for_type_inference(query.dtype)
+    helper.append_op(type='kv_block_verify_attention_quant',
+                     inputs={'Q': query, 'KCache': k_cache,
+                             'KScale': k_scale, 'VCache': v_cache,
+                             'VScale': v_scale, 'Pos': pos,
+                             'BlockTable': block_table},
+                     outputs={'Out': out},
+                     attrs={'n_head': int(n_head),
+                            'scale': float(scale or 0.0)})
+    out.stop_gradient = True
+    return out
+
+
 def fused_multihead_attention(q, k, v, causal=False, scale=1.0,
                               sequence_parallel=False, name=None):
     """Fused [B, H, S, D] attention: Pallas flash attention on TPU where

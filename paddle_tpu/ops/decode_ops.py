@@ -853,6 +853,203 @@ def _kv_block_chunk_attention_quant(ctx, ins):
 
 
 # ---------------------------------------------------------------------------
+# Speculative-decode VERIFY ops (ISSUE 17): one dispatch scores R = K+1
+# token rows per slot over the paged cache — row 0 is the slot's last
+# emitted token at its current write position p, rows 1..K are drafted
+# tokens at p+1..p+K. KV is written speculatively for every fed row
+# BEFORE attention runs (the step program's write-then-attend order),
+# and row i attends j <= pos[s, i], so row i's logits see exactly the
+# prefix a plain decode step would see after accepting rows < i — the
+# bit-identity hinge of draft-and-verify. Rejection is a HOST decision:
+# the scheduler rolls each slot's `pos` back to the accepted length, so
+# rejected rows' cache garbage sits strictly above the attended
+# frontier and is overwritten by the next real write before any mask
+# ever admits it. Per-row positions encode the variable part inside the
+# fixed [S, R] shape: slot-layout pad rows carry pos = T (out-of-bounds
+# scatter rows DROP — no write at all), block-layout pad rows carry
+# pos = MAXB * BS (forced to the trash block by _block_scatter_idx's
+# span guard — pos = T would hit a SHARED full prefix block at offset
+# T % BS when T is not block-aligned). Either way an unfed row writes
+# nothing an attention mask can reach and its logits row is garbage the
+# host never reads.
+# ---------------------------------------------------------------------------
+
+def _verify_attention_body(ctx, q, kc, vc, pos):
+    """Multi-row masked attention for the verify program: Q [S, R, D]
+    attends its slot's cache view with a PER-ROW frontier — row i sees
+    j <= pos[s, i]. Row-wise it is exactly _paged_attention_body's
+    expression (same einsum contraction order, same -inf mask, same
+    softmax), which is what makes a verify row's output bit-comparable
+    to the plain step's output at the same prefix."""
+    n_head = int(ctx.attr('n_head', 1))
+    s, t, d = kc.shape
+    r = q.shape[1]
+    dh = d // n_head
+    scale = float(ctx.attr('scale', 0.0) or 0.0) or dh ** -0.5
+    qh = q.reshape(s, r, n_head, dh)
+    kh = kc.reshape(s, t, n_head, dh)
+    vh = vc.reshape(s, t, n_head, dh)
+    scores = jnp.einsum('srhd,sthd->srht', qh, kh) * scale
+    valid = (jnp.arange(t, dtype=jnp.int32)[None, None, :]
+             <= pos[:, :, None])
+    scores = jnp.where(valid[:, :, None, :], scores, -jnp.inf)
+    w = jax.nn.softmax(scores, axis=-1)
+    ctxv = jnp.einsum('srht,sthd->srhd', w, vh)
+    return ctxv.reshape(s, r, d).astype(q.dtype)
+
+
+@register('kv_cache_verify_write', no_grad=True, lod='none')
+def _kv_cache_verify_write(ctx, ins):
+    """Write R = K+1 speculative K or V rows per slot into the
+    slot-paged cache: Cache [S, T, D], KV [S, R, D], Pos [S, R] int32.
+    Row (s, i) scatters to cache[s, pos[s, i]]; pad rows carry
+    pos = T, an out-of-bounds scatter index XLA DROPS — a pad row
+    writes nothing. Real rows of one slot have distinct consecutive
+    positions, so indices never collide. Out aliases Cache."""
+    cache = ins['Cache'][0]
+    kv = ins['KV'][0]
+    pos = ins['Pos'][0].astype(jnp.int32)
+    s, r = pos.shape
+    sidx = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[:, None],
+                            (s, r)).reshape(-1)
+    pflat = pos.reshape(-1)
+    return {'Out': [cache.at[sidx, pflat].set(
+        kv.reshape(s * r, -1).astype(cache.dtype))]}
+
+
+@register('kv_cache_verify_attention', no_grad=True, lod='none')
+def _kv_cache_verify_attention(ctx, ins):
+    """Verify attention over the slot-paged cache: Q [S, R, D],
+    KCache/VCache [S, T, D], Pos [S, R] int32. Row i of a slot attends
+    its own cache rows j <= pos[s, i] — the speculative rows written
+    this dispatch included, so row i's window is exactly the plain
+    step's window after accepting the i drafted tokens before it."""
+    q = ins['Q'][0]
+    kc = ins['KCache'][0]
+    vc = ins['VCache'][0]
+    pos = ins['Pos'][0].astype(jnp.int32)
+    return {'Out': [_verify_attention_body(ctx, q, kc, vc, pos)]}
+
+
+@register('kv_cache_verify_write_quant', no_grad=True, lod='none')
+def _kv_cache_verify_write_quant(ctx, ins):
+    """kv_cache_verify_write over the int8 cache: each speculative row
+    quantizes at its own abs-max page scale (the write-time contract of
+    kv_cache_write_quant); pad rows (pos = T) drop both the row and its
+    scale scatter. Out/OutScale alias Cache/Scale."""
+    cache = ins['Cache'][0]
+    cscale = ins['Scale'][0]
+    kv = ins['KV'][0]
+    pos = ins['Pos'][0].astype(jnp.int32)
+    s, r = pos.shape
+    q, sc = _quantize_kv_rows(kv.astype(jnp.float32))   # [S,R,D], [S,R]
+    sidx = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[:, None],
+                            (s, r)).reshape(-1)
+    pflat = pos.reshape(-1)
+    return {'Out': [cache.at[sidx, pflat].set(q.reshape(s * r, -1))],
+            'OutScale': [cscale.at[sidx, pflat].set(sc.reshape(-1))]}
+
+
+@register('kv_cache_verify_attention_quant', no_grad=True, lod='none')
+def _kv_cache_verify_attention_quant(ctx, ins):
+    """kv_cache_verify_attention over the int8 cache: dequantize inside
+    the body (int8 row x its page scale), then the exact fp verify
+    expression."""
+    q = ins['Q'][0]
+    kc = ins['KCache'][0]
+    ks = ins['KScale'][0]
+    vc = ins['VCache'][0]
+    vs = ins['VScale'][0]
+    pos = ins['Pos'][0].astype(jnp.int32)
+    kf = kc.astype(jnp.float32) * ks[:, :, None]
+    vf = vc.astype(jnp.float32) * vs[:, :, None]
+    return {'Out': [_verify_attention_body(ctx, q, kf, vf, pos)]}
+
+
+@register('kv_block_verify_write', no_grad=True, lod='none')
+def _kv_block_verify_write(ctx, ins):
+    """kv_cache_verify_write over the BLOCK pool: Cache [NB, BS, D],
+    KV [S, R, D], Pos [S, R] int32, BlockTable [S, MAXB] int32. Each
+    slot's table broadcasts over its R rows; pad rows carry
+    pos = MAXB * BS, which _block_scatter_idx forces to the trash block
+    (colliding trash scatters are write-racy but never read — the
+    existing idle-row contract). The scheduler CoW/extends every block
+    in the speculative span first, so real indices land only in
+    uniquely-owned blocks. Out aliases Cache."""
+    cache = ins['Cache'][0]
+    kv = ins['KV'][0]
+    pos = ins['Pos'][0].astype(jnp.int32)
+    table = ins['BlockTable'][0]
+    s, r = pos.shape
+    wide = jnp.broadcast_to(table[:, None, :],
+                            (s, r, table.shape[1])).reshape(s * r, -1)
+    bidx, boff = _block_scatter_idx(wide, pos.reshape(-1),
+                                    cache.shape[1])
+    return {'Out': [cache.at[bidx, boff].set(
+        kv.reshape(s * r, -1).astype(cache.dtype))]}
+
+
+@register('kv_block_verify_attention', no_grad=True, lod='none')
+def _kv_block_verify_attention(ctx, ins):
+    """kv_cache_verify_attention over the block pool: per-slot logical
+    views gather through the table, then the shared verify body masks
+    row i at j <= pos[s, i]. Masked rows get exactly-zero weight, so
+    foreign blocks, trash garbage, and rejected speculative rows above
+    a frontier can never perturb an accepted row's output."""
+    q = ins['Q'][0]
+    kc = ins['KCache'][0]
+    vc = ins['VCache'][0]
+    pos = ins['Pos'][0].astype(jnp.int32)
+    table = ins['BlockTable'][0].astype(jnp.int32)
+    kview = jax.vmap(lambda rw: _block_view(kc, rw))(table)
+    vview = jax.vmap(lambda rw: _block_view(vc, rw))(table)
+    return {'Out': [_verify_attention_body(ctx, q, kview, vview, pos)]}
+
+
+@register('kv_block_verify_write_quant', no_grad=True, lod='none')
+def _kv_block_verify_write_quant(ctx, ins):
+    """kv_block_verify_write over the int8 block pool: speculative rows
+    quantize at their own abs-max page scale and scatter with their
+    scales through the broadcast tables (pad rows to the trash
+    block)."""
+    cache = ins['Cache'][0]
+    cscale = ins['Scale'][0]
+    kv = ins['KV'][0]
+    pos = ins['Pos'][0].astype(jnp.int32)
+    table = ins['BlockTable'][0]
+    s, r = pos.shape
+    q, sc = _quantize_kv_rows(kv.astype(jnp.float32))
+    wide = jnp.broadcast_to(table[:, None, :],
+                            (s, r, table.shape[1])).reshape(s * r, -1)
+    bidx, boff = _block_scatter_idx(wide, pos.reshape(-1),
+                                    cache.shape[1])
+    return {'Out': [cache.at[bidx, boff].set(q.reshape(s * r, -1))],
+            'OutScale': [cscale.at[bidx, boff].set(sc.reshape(-1))]}
+
+
+@register('kv_block_verify_attention_quant', no_grad=True, lod='none')
+def _kv_block_verify_attention_quant(ctx, ins):
+    """kv_block_verify_attention over the int8 block pool: per-slot
+    views dequantize (int8 page x its f32 scale) inside the body, then
+    the exact fp verify expression runs."""
+    q = ins['Q'][0]
+    kc = ins['KCache'][0]
+    ks = ins['KScale'][0]
+    vc = ins['VCache'][0]
+    vs = ins['VScale'][0]
+    pos = ins['Pos'][0].astype(jnp.int32)
+    table = ins['BlockTable'][0].astype(jnp.int32)
+
+    def view(cache, scale, rw):
+        return (_block_view(cache, rw).astype(jnp.float32)
+                * _block_view(scale, rw)[:, None])
+
+    kview = jax.vmap(lambda rw: view(kc, ks, rw))(table)
+    vview = jax.vmap(lambda rw: view(vc, vs, rw))(table)
+    return {'Out': [_verify_attention_body(ctx, q, kview, vview, pos)]}
+
+
+# ---------------------------------------------------------------------------
 # beam search (fixed-width; see module docstring)
 # ---------------------------------------------------------------------------
 

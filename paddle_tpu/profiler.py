@@ -149,7 +149,11 @@ def serving_report():
     carrying blocks_in_use) grow block-cache columns: blocks in use /
     total, prefix-share hit rate, copy-on-write block copies, and
     chunked-prefill slices — the capacity-vs-sharing picture per
-    replica."""
+    replica. The speculative-decode columns (ISSUE 17) render for every
+    decode source: `acc` is the draft acceptance rate and `tok/d` the
+    tokens delivered per request-advancing dispatch — both identically
+    1.00 for plain (non-drafting) decode, so mixed spec/non-spec fleets
+    line up in one table."""
     out = {}
     rows = []
     decode_rows = []
@@ -183,24 +187,27 @@ def serving_report():
         # block-cache columns render only when some source serves the
         # block-paged layout; slot-layout-only fleets keep the old width
         blocks = any('blocks_in_use' in s for _, s in decode_rows)
-        hdr = ("%-26s %5s %5s %6s %7s %8s %8s %6s %5s %5s %10s %10s %9s "
-               "%9s" %
+        hdr = ("%-26s %5s %5s %6s %7s %8s %8s %6s %5s %5s %5s %6s %10s "
+               "%10s %9s %9s" %
                ('Decode source', 'tier', 'queue', 'reqs', 'tokens',
                 'tok/s', 'prefills', 'steps', 'occ', 'shed',
+                'acc', 'tok/d',
                 'ttftp50(ms)', 'ttftp99(ms)', 'itlp50(ms)', 'itlp99(ms)'))
         if blocks:
             hdr += " %11s %6s %6s %6s" % ('blocks', 'pfxhit', 'cow',
                                           'slices')
         print(hdr)
         for name, s in decode_rows:
-            row = ("%-26s %5s %5d %6d %7d %8.1f %8d %6d %5.2f %5d %10.2f "
-                   "%10.2f %9.2f %9.2f" %
+            row = ("%-26s %5s %5d %6d %7d %8.1f %8d %6d %5.2f %5d %5.2f "
+                   "%6.2f %10.2f %10.2f %9.2f %9.2f" %
                    (name[:26], s.get('tier', 'bf16'),
                     s.get('queue_depth', 0),
                     s.get('requests', 0), s.get('tokens', 0),
                     s.get('tokens_s', 0.0), s.get('prefills', 0),
                     s.get('steps', 0), s.get('occupancy', 0.0),
                     s.get('shed', 0) + s.get('expired', 0),
+                    s.get('acc_rate', 1.0),
+                    s.get('tokens_per_dispatch', 1.0),
                     s.get('ttft_p50_ms', 0.0), s.get('ttft_p99_ms', 0.0),
                     s.get('itl_p50_ms', 0.0), s.get('itl_p99_ms', 0.0)))
             if blocks:

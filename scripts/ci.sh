@@ -65,6 +65,9 @@ python scripts/serve_bench_smoke.py
 echo "== decode serving smoke (continuous in-flight batching: Poisson A/B >=3x tokens/s vs sequential decode, bit-identical transcripts, 0-compile warm replica; block tier: prefix-share A/B >=1.5x effective capacity at fixed cache HBM, beam reorder >=10x fewer dispatch bytes block-level, chunked prefill >=2x below the monolithic-prefill stall) =="
 JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/decode_serve_smoke.py
 
+echo "== speculative decode smoke (draft-and-verify over the block-paged cache: bit-identical transcripts across plain/ngram/adversarial arms, >=1.5x tokens/s on the screened repetitive-suffix workload, zero-acceptance arm <=1.15x via acceptance-aware backoff) =="
+JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/spec_decode_smoke.py
+
 echo "== quantized serving smoke (int8 tier: calibrate -> export both tiers, top-1 parity, 0-compile warm int8 replica, >=1.3x fixed-cache-HBM decode throughput via 2x max_slots) =="
 JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/quant_smoke.py
 

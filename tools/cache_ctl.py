@@ -16,8 +16,9 @@ traffic, and CompiledPredictor/BatchingPredictor/CompiledTrainer load
 with zero traces and zero XLA compiles. Continuous-decode artifacts
 (export_decode's two-program layout, decode_signature.json) prewarm BOTH
 tiers: every prompt-length prefill bucket plus the decode-step and
-reorder programs, so DecodingPredictor replicas answer their first token
-with zero compiles.
+reorder programs — and, on speculative-decode artifacts, the verify
+program (see below) — so DecodingPredictor replicas answer their first
+token with zero compiles.
 
 Quantized artifact tiers (ISSUE 11, export_compiled(quantize='int8')):
 an artifact carrying an int8/ tier subdir (its own bucket tree +
@@ -41,8 +42,19 @@ unsharded serve or a different mesh shape. A --platform that contradicts
 a sharded artifact's recorded platform is refused (sharded executables
 are single-platform).
 
-Exit codes (all subcommands, including the decode, quantized-tier, and
-sharded/block-paged prewarm paths):
+Speculative-decode artifacts (ISSUE 17, build_decode_spec(draft_k=K)):
+a decode artifact whose signature carries a `verify` block (signature
+version 3) ships a THIRD program, decode_verify/ — the [S, K+1] ->
+[S, K+1, V] draft-scoring dispatch. Prewarm learns it exactly like the
+step program it rides beside, across every tier and mesh tag the
+artifact carries: slot and block layouts, bf16 and int8/ KV tiers, and
+mesh-tagged sidecars for mp-sharded artifacts. A replica serving with a
+drafter attached (DecodingPredictor(draft=...)) then reaches its first
+verify tick — not just its first token — with zero compiles.
+Version-2 artifacts (no verify block) prewarm unchanged.
+
+Exit codes (all subcommands, including the decode, quantized-tier,
+sharded/block-paged, and speculative verify-program prewarm paths):
   0  success (prewarm: at least one sidecar written)
   1  operation failed (compile error, unreadable module, no sidecar
      written, sharded artifact on a host without the full mesh's
@@ -135,8 +147,8 @@ def _cmd_prewarm(args):
 
 def main(argv=None):
     # --help carries the full contract: the artifact layouts prewarm
-    # understands (multi-bucket, decode two-program, quantized int8/
-    # tier) and the exit codes automation keys on
+    # understands (multi-bucket, decode two/three-program, quantized
+    # int8/ tier) and the exit codes automation keys on
     ap = argparse.ArgumentParser(
         prog='cache_ctl.py', description=__doc__.split('\n')[0],
         epilog=__doc__[__doc__.index('Quantized artifact tiers'):],
