@@ -153,13 +153,38 @@ def _static_fields(program, fetch, batch=None):
     try:
         from paddle_tpu.passes import dataflow
         name = fetch if isinstance(fetch, str) else fetch.name
-        est = dataflow.analyze_program(
-            program, fetch_names=[name]).peak_memory(
-                batch=batch or 1, top=0)
+        dfa = dataflow.analyze_program(program, fetch_names=[name])
+        est = dfa.peak_memory(batch=batch or 1, top=0)
         fields['peak_bytes_est'] = int(est.peak_bytes)
+        if dfa.remat_interiors()[0]:
+            remat = dfa.peak_memory(batch=batch or 1, top=0,
+                                    remat_aware=True)
+            fields['remat_segments'] = int(remat.remat_segments)
+            fields['peak_bytes_remat'] = int(remat.peak_bytes)
     except Exception:
         pass
     return fields
+
+
+def _memory_fields(program, feed, fetch, exe, scope=None):
+    """Measured HLO memory column (PTPU_BENCH_MEMORY=1): XLA's
+    buffer-assignment temp/peak bytes for this bench's compiled step via
+    Executor.compiled_memory_stats — the number the recompute pass
+    (ISSUE 18) actually moves. Opt-in: the extra lower+compile is cached
+    but not free; omitted (and never fatal) otherwise."""
+    if os.environ.get('PTPU_BENCH_MEMORY', '0') != '1':
+        return {}
+    try:
+        from paddle_tpu.executor import compiled_memory_stats
+        stats = compiled_memory_stats(program, feed=feed,
+                                      fetch_list=[fetch], scope=scope,
+                                      exe=exe)
+        if not stats:
+            return {}
+        return {'hlo_temp_bytes': int(stats['temp_bytes']),
+                'hlo_peak_bytes': int(stats['peak_bytes'])}
+    except Exception:
+        return {}
 
 
 def is_transient(exc):
@@ -427,17 +452,21 @@ def bench_transformer():
     batch = int(os.environ.get('PTPU_BENCH_TRANS_BATCH', '64'))
     seq_len = int(os.environ.get('PTPU_BENCH_TRANS_SEQ', '256'))
     steps = int(os.environ.get('PTPU_BENCH_TRANS_STEPS', '20'))
-    # ablation knobs (PERF_NOTES.md dropout-tax section)
+    # ablation knobs (PERF_NOTES.md dropout-tax section); remat:
+    # ''=off, 'layers'=per-layer checkpoints, 'auto'=pass-chosen cuts
     dropout = float(os.environ.get('PTPU_BENCH_TRANS_DROPOUT', '0.1'))
     ad_env = os.environ.get('PTPU_BENCH_TRANS_ATTN_DROPOUT', '')
     attn_dropout = float(ad_env) if ad_env else None
+    remat = os.environ.get('PTPU_BENCH_TRANS_REMAT', '')
+    cps = {'': None, 'layers': True, 'auto': 'auto'}.get(remat, None)
 
     main_p, startup_p = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_p, startup_p):
         feeds, loss, flops_per_tok = build_transformer_train(
             src_vocab=32000, trg_vocab=32000, max_len=seq_len,
             d_model=512, d_ff=2048, n_head=8, n_layer=6,
-            dropout=dropout, attn_dropout=attn_dropout)
+            dropout=dropout, attn_dropout=attn_dropout,
+            checkpoints=cps)
     fluid.contrib.mixed_precision.enable_bf16(main_p)
 
     exe, dev = _device()
@@ -467,6 +496,7 @@ def bench_transformer():
                  mfu=round(mfu, 4) if mfu is not None else None, dtype='bf16',
                  batch=batch, seq_len=seq_len, baseline_ref='flops_eq_xeon',
                  **_static_fields(main_p, loss, batch))
+    line.update(_memory_fields(main_p, feed, loss, exe))
     return _attach_device_time(line, lambda: _device_ms_scan(
         exe, main_p, feed, loss, _device_k(8)))
 
@@ -479,13 +509,16 @@ def bench_bert():
     seq_len = int(os.environ.get('PTPU_BENCH_BERT_SEQ', '128'))
     steps = int(os.environ.get('PTPU_BENCH_BERT_STEPS', '20'))
     k_merge = int(os.environ.get('PTPU_BENCH_BERT_GA', '2'))
+    # remat ablation knob: ''=off, 'layers'=per-layer, 'auto'=pass-chosen
+    remat = os.environ.get('PTPU_BENCH_BERT_REMAT', '')
+    cps = {'': None, 'layers': True, 'auto': 'auto'}.get(remat, None)
 
     vocab, d_model, d_ff, n_head, n_layer = 30522, 768, 3072, 12, 12
     main_p, startup_p = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_p, startup_p):
         feeds, loss = build_bert_pretrain(
             vocab=vocab, max_len=seq_len, d_model=d_model, d_ff=d_ff,
-            n_head=n_head, n_layer=n_layer)
+            n_head=n_head, n_layer=n_layer, checkpoints=cps)
     fluid.contrib.mixed_precision.enable_bf16(main_p)
     if k_merge > 1:
         fluid.contrib.gradient_merge.enable(k_merge, main_p)
@@ -526,6 +559,7 @@ def bench_bert():
                  batch=batch, seq_len=seq_len, grad_merge_k=k_merge,
                  baseline_ref='flops_eq_xeon',
                  **_static_fields(main_p, loss, batch))
+    line.update(_memory_fields(main_p, feed, loss, exe))
     return _attach_device_time(line, lambda: _device_ms_scan(
         exe, main_p, feed, loss, _device_k(8)))
 

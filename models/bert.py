@@ -16,8 +16,13 @@ from models.transformer import encoder_layer
 
 def build_bert_pretrain(vocab=30522, max_len=128, d_model=768, d_ff=3072,
                         n_head=12, n_layer=12, type_vocab=2, dropout=0.1,
-                        lr=1e-4):
-    """Returns (feeds, avg_mlm_loss). feeds = [(name, shape, dtype)]."""
+                        lr=1e-4, checkpoints=None):
+    """Returns (feeds, avg_mlm_loss). feeds = [(name, shape, dtype)].
+
+    checkpoints: activation rematerialization (ISSUE 18). True wraps
+    each encoder layer's output as a recompute boundary (the flagship
+    per-layer config), 'auto' lets the pass pick √N segments, None
+    trains without recompute."""
     S = max_len
     tok = fluid.layers.data(name='tok_ids', shape=[S], dtype='int64')
     seg = fluid.layers.data(name='seg_ids', shape=[S], dtype='int64')
@@ -46,8 +51,10 @@ def build_bert_pretrain(vocab=30522, max_len=128, d_model=768, d_ff=3072,
         x = fluid.layers.dropout(x, dropout_prob=dropout,
                                  dropout_implementation='upscale_in_train')
 
+    layer_outs = []
     for _ in range(n_layer):
         x = encoder_layer(x, n_head, d_model, d_ff, S, dropout)
+        layer_outs.append(x)
 
     # MLM head: transform + vocab projection
     h = fluid.layers.fc(x, size=d_model, num_flatten_dims=2, act='relu')
@@ -62,7 +69,14 @@ def build_bert_pretrain(vocab=30522, max_len=128, d_model=768, d_ff=3072,
     # masked mean: only the masked positions contribute
     avg_loss = fluid.layers.reduce_sum(loss * w) / (
         fluid.layers.reduce_sum(w) + 1e-6)
-    fluid.optimizer.Adam(learning_rate=lr).minimize(avg_loss)
+    cps = None
+    if checkpoints == 'auto':
+        cps = 'auto'
+    elif checkpoints:
+        cps = checkpoints if isinstance(checkpoints, (list, tuple)) \
+            else layer_outs
+    fluid.optimizer.Adam(learning_rate=lr).minimize(avg_loss,
+                                                    checkpoints=cps)
 
     feeds = [('tok_ids', (S,), 'int64'), ('seg_ids', (S,), 'int64'),
              ('mlm_labels', (S,), 'int64'), ('mlm_weights', (S,), 'float32')]

@@ -116,12 +116,17 @@ def _embed(ids, vocab, d_model, seq, name):
 
 def build_transformer_train(src_vocab=32000, trg_vocab=32000, max_len=256,
                             d_model=512, d_ff=2048, n_head=8, n_layer=6,
-                            dropout=0.1, attn_dropout=None, lr=None):
+                            dropout=0.1, attn_dropout=None, lr=None,
+                            checkpoints=None):
     """Returns (feeds, avg_loss, train_flops_per_token).
 
     feeds = [(name, per-sample shape, dtype)]; sequences arrive padded to
     max_len (the bench feeds full-length synthetic batches — variable-length
     data rides the bucketing reader instead).
+
+    checkpoints: activation rematerialization (ISSUE 18). True wraps
+    each encoder/decoder layer's output as a recompute boundary, 'auto'
+    lets the pass pick √N segments, None trains without recompute.
     """
     S = max_len
     src = fluid.layers.data(name='src_ids', shape=[S], dtype='int64')
@@ -139,9 +144,11 @@ def build_transformer_train(src_vocab=32000, trg_vocab=32000, max_len=256,
     if dropout:
         enc = fluid.layers.dropout(enc, dropout_prob=dropout,
                                    dropout_implementation='upscale_in_train')
+    layer_outs = []
     for _ in range(n_layer):
         enc = encoder_layer(enc, n_head, d_model, d_ff, S, dropout,
                             attn_dropout=attn_dropout)
+        layer_outs.append(enc)
 
     dec = _embed(trg, trg_vocab, d_model, S, 'trg_emb')
     if dropout:
@@ -151,6 +158,7 @@ def build_transformer_train(src_vocab=32000, trg_vocab=32000, max_len=256,
         dec = decoder_layer(dec, enc, n_head, d_model, d_ff, S, S,
                             causal_mask, dropout,
                             attn_dropout=attn_dropout)
+        layer_outs.append(dec)
 
     logits = fluid.layers.fc(dec, size=trg_vocab, num_flatten_dims=2,
                              bias_attr=False)
@@ -165,7 +173,13 @@ def build_transformer_train(src_vocab=32000, trg_vocab=32000, max_len=256,
         lr = fluid.layers.noam_decay(d_model, 4000) * 2.0
     opt = fluid.optimizer.Adam(learning_rate=lr, beta1=0.9, beta2=0.997,
                                epsilon=1e-9)
-    opt.minimize(avg_loss)
+    cps = None
+    if checkpoints == 'auto':
+        cps = 'auto'
+    elif checkpoints:
+        cps = checkpoints if isinstance(checkpoints, (list, tuple)) \
+            else layer_outs
+    opt.minimize(avg_loss, checkpoints=cps)
 
     # analytic training FLOPs per TARGET token (fwd 2*MACs, train = 3x):
     # enc layer 4d^2+2*d*dff, dec layer 8d^2+2*d*dff, attention scores

@@ -11,6 +11,22 @@ Import surface mirrors `paddle.fluid`:
     exe.run(fluid.default_startup_program())
     loss_val, = exe.run(feed={...}, fetch_list=[loss])
 """
+import os as _os
+
+# XLA:CPU runs its optimization-barrier expander BEFORE HLO CSE, which
+# silently CSEs jax.checkpoint's rematerialized forward back into the
+# original — activation recompute (passes/recompute.py) would be a no-op
+# on the CPU proxy and memory_analysis() could never show the savings.
+# Keep the barriers alive on CPU (TPU handles them natively); opt out
+# with PTPU_KEEP_CSE_BARRIERS=0. Must run before jax initializes.
+if _os.environ.get('PTPU_KEEP_CSE_BARRIERS', '1') != '0' \
+        and 'cpu' in (_os.environ.get('PTPU_PLATFORM')
+                      or _os.environ.get('JAX_PLATFORMS', '')):
+    _flags = _os.environ.get('XLA_FLAGS', '')
+    if 'cse_barrier_expander' not in _flags:
+        _os.environ['XLA_FLAGS'] = (
+            _flags + ' --xla_disable_hlo_passes=cse_barrier_expander').strip()
+
 from . import ops as _ops  # registers all op lowerings
 
 from .framework import (Program, Block, Operator, Variable, Parameter,

@@ -72,10 +72,24 @@ def _eligible_input(block, name, no_grad):
 
 def append_backward(loss, parameter_list=None, no_grad_set=None,
                     callbacks=None, checkpoints=None):
-    """Append grad ops for `loss`; returns [(param, grad_var), ...]."""
+    """Append grad ops for `loss`; returns [(param, grad_var), ...].
+
+    checkpoints: activation-rematerialization boundaries (the reference
+    RecomputeOptimizer hook). 'auto' picks √N segments from live
+    intervals; a list of Variables/names closes a segment at each def
+    site. The forward is rewritten IN PLACE around remat_segment
+    sub-blocks (passes/recompute.py) before grad ops are emitted, so
+    the backward recomputes segment interiors under jax.checkpoint
+    instead of keeping them live. None (default) leaves the program
+    untouched.
+    """
     block = loss.block
     program = block.program
     assert block.idx == 0, "append_backward currently supports block 0"
+
+    if checkpoints is not None:
+        from .passes.recompute import apply_recompute_for_backward
+        apply_recompute_for_backward(program, loss, checkpoints)
 
     no_grad = set(no_grad_set or ())
     for v in program.list_vars():

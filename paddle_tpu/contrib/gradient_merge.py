@@ -29,11 +29,12 @@ class GradientMergeOptimizer(object):
         return getattr(self._optimizer, name)
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
-                 no_grad_set=None):
+                 no_grad_set=None, checkpoints=None):
         loss.block.program._grad_accum_k = self._k
         return self._optimizer.minimize(
             loss, startup_program=startup_program,
-            parameter_list=parameter_list, no_grad_set=no_grad_set)
+            parameter_list=parameter_list, no_grad_set=no_grad_set,
+            checkpoints=checkpoints)
 
 
 def decorate(optimizer, k_steps):

@@ -25,12 +25,13 @@ class OptimizerWithMixedPrecision(object):
         return getattr(self._optimizer, name)
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
-                 no_grad_set=None):
+                 no_grad_set=None, checkpoints=None):
         program = loss.block.program
         program._amp_bf16 = True
         return self._optimizer.minimize(
             loss, startup_program=startup_program,
-            parameter_list=parameter_list, no_grad_set=no_grad_set)
+            parameter_list=parameter_list, no_grad_set=no_grad_set,
+            checkpoints=checkpoints)
 
 
 def decorate(optimizer):

@@ -22,19 +22,47 @@ class QuantizeTranspiler(object):
     def __init__(self, weight_bits=8, activation_bits=8,
                  activation_quantize_type='abs_max',
                  weight_quantize_type='abs_max', window_size=10000):
-        if activation_quantize_type != 'abs_max' or \
-                weight_quantize_type != 'abs_max':
+        if activation_quantize_type not in ('abs_max', 'range_abs_max'):
             raise NotImplementedError(
-                "only abs_max quantization is supported (the reference's "
-                "range_abs_max window statistics add state without "
-                "changing the quantized math)")
+                "activation_quantize_type %r (supported: abs_max, "
+                "range_abs_max)" % activation_quantize_type)
+        if weight_quantize_type != 'abs_max':
+            raise NotImplementedError(
+                "weight_quantize_type %r (supported: abs_max — weights "
+                "are re-quantized from scratch every step, so a sliding "
+                "window adds state without changing their math)"
+                % weight_quantize_type)
         self.weight_bits = weight_bits
         self.activation_bits = activation_bits
+        self.activation_quantize_type = activation_quantize_type
+        self.window_size = int(window_size)
+
+    def _range_state(self, block, startup_block, qn):
+        """Create the range_abs_max window state for one quantized
+        activation: Scales [window_size] + Iter [1], persistable in the
+        main program (the op threads them through under the same names)
+        and zero-filled by the startup program."""
+        names = (qn + '.scales', qn + '.iter')
+        for name, shape, dtype in ((names[0], [self.window_size],
+                                    'float32'),
+                                   (names[1], [1], 'int64')):
+            block.create_var(name=name, shape=shape, dtype=dtype,
+                             persistable=True, stop_gradient=True)
+            startup_block.create_var(name=name, shape=shape, dtype=dtype,
+                                     persistable=True)
+            startup_block.append_op(
+                type='fill_constant', outputs={'Out': [name]},
+                attrs={'shape': list(shape), 'dtype': dtype,
+                       'value': 0.0}, infer_shape=False)
+        return names
 
     def training_transpile(self, program=None, startup_program=None):
         """Insert fake-quant ops before every quantizable op's X/W inputs."""
+        from ..framework import default_startup_program
         program = program or default_main_program()
+        startup_program = startup_program or default_startup_program()
         block = program.global_block()
+        startup_block = startup_program.global_block()
         new_ops = []
         quant_cache = {}
         for op in block.ops:
@@ -43,8 +71,12 @@ class QuantizeTranspiler(object):
                     names = op.inputs.get(slot)
                     if not names:
                         continue
-                    bits = self.weight_bits if slot in ('Filter', 'Y') \
+                    is_weight = slot in ('Filter', 'Y')
+                    bits = self.weight_bits if is_weight \
                         else self.activation_bits
+                    ranged = (not is_weight
+                              and self.activation_quantize_type
+                              == 'range_abs_max')
                     qnames = []
                     for n in names:
                         key = (n, bits)
@@ -58,12 +90,27 @@ class QuantizeTranspiler(object):
                                 shape=v.shape if v is not None else None,
                                 dtype=v.dtype if v is not None
                                 else 'float32', stop_gradient=False)
-                            new_ops.append(dict(
-                                type='fake_quantize_abs_max',
-                                inputs={'X': [n]},
-                                outputs={'Out': [qn],
-                                         'OutScale': [qn + '.scale']},
-                                attrs={'bit_length': bits}))
+                            if ranged:
+                                scales, itn = self._range_state(
+                                    block, startup_block, qn)
+                                new_ops.append(dict(
+                                    type='fake_quantize_range_abs_max',
+                                    inputs={'X': [n], 'Scales': [scales],
+                                            'Iter': [itn]},
+                                    outputs={'Out': [qn],
+                                             'OutScale': [qn + '.scale'],
+                                             'OutScales': [scales],
+                                             'OutIter': [itn]},
+                                    attrs={'bit_length': bits,
+                                           'window_size': self.window_size,
+                                           'is_test': False}))
+                            else:
+                                new_ops.append(dict(
+                                    type='fake_quantize_abs_max',
+                                    inputs={'X': [n]},
+                                    outputs={'Out': [qn],
+                                             'OutScale': [qn + '.scale']},
+                                    attrs={'bit_length': bits}))
                             block.create_var(name=qn + '.scale',
                                              dtype='float32',
                                              stop_gradient=True)
@@ -115,7 +162,12 @@ class QuantizeTranspiler(object):
         """Inference freeze: with abs_max fake-quant already in the graph,
         executing it IS the quantized inference numerics (weights round
         through the int grid each run); fold is a no-op on TPU where int8
-        storage wins nothing over bf16 compute. Kept for API parity."""
+        storage wins nothing over bf16 compute. range_abs_max ops flip to
+        is_test so the trained window is frozen (read, never advanced)."""
+        for op in program.global_block().ops:
+            if op.type == 'fake_quantize_range_abs_max':
+                op.attrs['is_test'] = True
+        program._build_epoch += 1
         return program
 
 

@@ -19,7 +19,10 @@ Gradient ops: append_backward emits `<type>_grad` OpDescs. If no explicit
 lowering is registered for a grad op, `_lower_generic_grad` re-lowers the
 forward op under jax.vjp and applies the output cotangents — per-op autodiff
 parity (ref GradOpDescMaker) without per-op grad code. The recomputed
-forward is CSE'd by XLA against the original (same trace, same inputs).
+forward is CSE'd by XLA against the original (same trace, same inputs) —
+EXCEPT inside remat_segment sub-blocks, whose lowering wraps the trace in
+jax.checkpoint (optimization-barrier-guarded), so segment interiors really
+recompute in the backward instead of staying live (passes/recompute.py).
 """
 from __future__ import annotations
 

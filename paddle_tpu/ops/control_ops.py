@@ -122,6 +122,50 @@ def _conditional_block(ctx, ins):
 
 
 # ---------------------------------------------------------------------------
+# Activation rematerialization: remat_segment → jax.checkpoint over the
+# sub-block (passes/recompute.py owns the rewrite; ISSUE 18 tentpole).
+# ---------------------------------------------------------------------------
+
+def _remat_infer_shape(op, block):
+    # The recompute rewrite moves ops verbatim AFTER their outputs were
+    # shape-inferred at build time; boundary var metadata is already
+    # correct, and the abstract ShapeCtx cannot run sub-blocks anyway.
+    return
+
+
+@register('remat_segment', lod='aware', infer_shape=_remat_infer_shape)
+def _remat_segment(ctx, ins):
+    """Run the segment sub-block under jax.checkpoint: only the boundary
+    values (X in, Out out) survive the forward; when append_backward
+    differentiates this op through the generic vjp path, the interior
+    recomputes inside the checkpoint's rematerialized trace — XLA's CSE
+    cannot merge it back into the original forward (prevent_cse
+    barriers), which is the whole point. Seeded interior ops (dropout)
+    replay bit-identical draws: the rewrite preserved their ``_op_uid``
+    attrs, so the (program seed, step, op seed) rng fold is unchanged.
+
+    At grad-replay time ``ctx.op`` is the remat_segment_grad op, whose
+    inputs/outputs are the grad maps — the forward boundary names ride
+    its ``_fwd_inputs``/``_fwd_outputs`` attrs instead."""
+    op = ctx.op
+    if op.type == 'remat_segment':
+        in_names = list(op.inputs.get('X', ()))
+        out_names = list(op.outputs.get('Out', ()))
+    else:
+        in_names = list(op.attrs['_fwd_inputs']['X'])
+        out_names = list(op.attrs['_fwd_outputs']['Out'])
+    sub_idx = int(ctx.attr('sub_block'))
+
+    def seg(*vals):
+        env = dict(zip(in_names, vals))
+        ctx.run_block(sub_idx, env)
+        return tuple(env[n] for n in out_names)
+
+    outs = jax.checkpoint(seg)(*ins['X'])
+    return {'Out': list(outs)}
+
+
+# ---------------------------------------------------------------------------
 # Recurrent sub-block ops: StaticRNN / DynamicRNN → lax.scan
 # (ref: operators/recurrent_op.cc, python/paddle/fluid/layers/
 # control_flow.py StaticRNN:278, DynamicRNN:1395).

@@ -343,11 +343,20 @@ def _chunk_bounds(tags, scheme, num_chunk_types, excluded):
         is_start = valid & (prev != tags)
         is_end = valid & (nxt != tags)
         return is_start, is_end, ctype, valid
-    if scheme != 'IOB':
+    # positional schemes (ref chunk_eval_op.h:118-136 GetSegments): tag =
+    # chunk_type * num_tag_types + tag_type; per-scheme tag-type codes
+    # (absent roles are None, dropping their predicate terms):
+    #   IOB   — B=0 I=1            IOE   — I=0 E=1
+    #   IOBES — B=0 I=1 E=2 S=3
+    try:
+        B, I, E, S, ntt = {'IOB': (0, 1, None, None, 2),
+                           'IOE': (None, 0, 1, None, 2),
+                           'IOBES': (0, 1, 2, 3, 4)}[scheme]
+    except KeyError:
         raise NotImplementedError("chunk_eval scheme %r (supported: plain, "
-                                  "IOB)" % scheme)
-    ttype = tags % 2          # 0 = B, 1 = I
-    ctype = tags // 2
+                                  "IOB, IOE, IOBES)" % scheme)
+    ttype = tags % ntt
+    ctype = tags // ntt
     # O tags (value num_chunk_types * num_tag_types) decode to
     # ctype == num_chunk_types: not part of any chunk (ref chunk_eval_op.h:145)
     valid = (tags >= 0) & (ctype != num_chunk_types)
@@ -359,9 +368,21 @@ def _chunk_bounds(tags, scheme, num_chunk_types, excluded):
     nxt_ct = jnp.concatenate([ctype[1:], jnp.full((1,), -2, ctype.dtype)])
     nxt_tt = jnp.concatenate([ttype[1:], jnp.full((1,), -2, ttype.dtype)])
     nxt_valid = jnp.concatenate([valid[1:], jnp.zeros((1,), bool)])
-    is_start = valid & ((ttype == 0) | ~prev_valid | (prev_ct != ctype))
-    is_end = valid & (~nxt_valid | (nxt_tt == 0) | (nxt_ct != ctype))
-    return is_start, is_end, ctype, valid
+    # a chunk starts at t when the chunk run cannot continue through t:
+    # no valid predecessor / type switch, an explicit B/S tag here, or the
+    # predecessor closed its chunk (E/S). Symmetrically for ends.
+    is_start = ~prev_valid | (prev_ct != ctype)
+    is_end = ~nxt_valid | (nxt_ct != ctype)
+    if B is not None:
+        is_start |= ttype == B
+        is_end |= nxt_tt == B
+    if S is not None:
+        is_start |= (ttype == S) | (prev_tt == S)
+        is_end |= (ttype == S) | (nxt_tt == S)
+    if E is not None:
+        is_start |= prev_tt == E
+        is_end |= ttype == E
+    return valid & is_start, valid & is_end, ctype, valid
 
 
 @register('chunk_eval', no_grad=True, lod='aware')

@@ -771,6 +771,37 @@ def _fake_quantize_abs_max(ctx, ins):
     return {'Out': [out], 'OutScale': [scale.reshape(1)]}
 
 
+@register('fake_quantize_range_abs_max', diff_inputs=('X',))
+def _fake_quantize_range_abs_max(ctx, ins):
+    """ref fake_quantize_op.cc FakeQuantizeRangeAbsMax: the activation
+    scale is the max of a sliding window of per-step abs-max statistics
+    instead of this batch's alone. The window (`Scales`, [window_size])
+    and the step counter (`Iter`, [1]) are persistable state threaded
+    through the op UNDER THE SAME NAMES (OutScales/OutIter rebind them),
+    so the scope commit persists them across steps. Train: window[iter %
+    W] = max|x|, scale = max(window), iter += 1; is_test: the window is
+    frozen and only read. Straight-through estimator for the gradient,
+    same as abs_max."""
+    x = X(ins)
+    bits = int(ctx.attr('bit_length', 8))
+    levels = float((1 << (bits - 1)) - 1)
+    window = ins['Scales'][0]
+    it = ins['Iter'][0].reshape(())
+    if bool(ctx.attr('is_test', False)):
+        scale = jnp.maximum(jnp.max(window), 1e-8)
+        new_window, new_it = window, it
+    else:
+        cur = jnp.max(jnp.abs(x))
+        slot = (it % window.shape[0]).astype(jnp.int32)
+        new_window = window.at[slot].set(cur)
+        scale = jnp.maximum(jnp.max(new_window), 1e-8)
+        new_it = it + 1
+    q = jnp.round(x / scale * levels) / levels * scale
+    out = x + jax.lax.stop_gradient(q - x)   # STE
+    return {'Out': [out], 'OutScale': [scale.reshape(1)],
+            'OutScales': [new_window], 'OutIter': [new_it.reshape(1)]}
+
+
 @register('fake_dequantize_max_abs', diff_inputs=('X',))
 def _fake_dequantize_max_abs(ctx, ins):
     x = X(ins)

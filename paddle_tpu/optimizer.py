@@ -110,8 +110,9 @@ class Optimizer(object):
         return optimize_ops
 
     def backward(self, loss, startup_program=None, parameter_list=None,
-                 no_grad_set=None, callbacks=None):
-        return append_backward(loss, parameter_list, no_grad_set)
+                 no_grad_set=None, callbacks=None, checkpoints=None):
+        return append_backward(loss, parameter_list, no_grad_set,
+                               callbacks, checkpoints=checkpoints)
 
     def apply_gradients(self, params_grads):
         loss = None
@@ -125,9 +126,12 @@ class Optimizer(object):
         return self._create_optimization_pass(params_grads, _L())
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
-                 no_grad_set=None):
+                 no_grad_set=None, checkpoints=None):
+        """checkpoints: activation-rematerialization boundaries ('auto'
+        or a list of Variables/names) — see append_backward; the
+        reference RecomputeOptimizer folded into minimize."""
         params_grads = self.backward(loss, startup_program, parameter_list,
-                                     no_grad_set)
+                                     no_grad_set, checkpoints=checkpoints)
         params_grads = append_gradient_clip_ops(params_grads)
         params_grads = append_regularization_ops(params_grads,
                                                  self.regularization)

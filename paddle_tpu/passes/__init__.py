@@ -57,6 +57,7 @@ from .quantize import (CalibrationResult, QuantizeProgramPass,
                        calibrate_program, calibration_targets,
                        quantize_program, quantize_weight)
 from .horizontal_fuse import HorizontalFusePass, horizontal_fuse_program
+from .recompute import RecomputePass, recompute_program
 
 # constant_fold runs first so dead_op_elimination sweeps the literal
 # producers whose consumers folded; fuse_activation last, on the final

@@ -129,7 +129,8 @@ class MemoryEstimate(object):
     __slots__ = ('peak_bytes', 'peak_op_index', 'peak_op_type',
                  'resident_bytes', 'params_bytes', 'feeds_bytes',
                  'temps_peak_bytes', 'temps_total_bytes', 'n_temps',
-                 'unknown_shape_vars', 'dynamic_vars', 'batch', 'top')
+                 'unknown_shape_vars', 'dynamic_vars', 'batch', 'top',
+                 'remat_aware', 'remat_segments', 'remat_interior_bytes')
 
     def as_dict(self):
         return {k: getattr(self, k) for k in self.__slots__}
@@ -217,6 +218,7 @@ class DataflowAnalysis(object):
 
         self.written = set(self.defs)
         self._intervals = None
+        self._remat = None
 
     # -- def-use ---------------------------------------------------------
     def def_use(self, name):
@@ -318,6 +320,12 @@ class DataflowAnalysis(object):
                                      max(u for u in us
                                          if prev < u <= cur)),
                         var=name, op_index=cur))
+                elif self.ops[prev].type == 'remat_segment' \
+                        and self.ops[cur].type == 'remat_segment_grad':
+                    # a recompute interior: the grad replay re-derives
+                    # the forward segment's value by design — the first
+                    # write is exactly the one remat chose NOT to keep
+                    continue
                 else:
                     out.append(Hazard(
                         'warn', 'double-write',
@@ -328,11 +336,43 @@ class DataflowAnalysis(object):
         return out
 
     # -- memory ----------------------------------------------------------
-    def peak_memory(self, batch=1, top=8):
+    def remat_interiors(self):
+        """(n_segments, {interior name}) of the program's recompute
+        segments (passes/recompute.py): names a `remat_segment` sub-block
+        writes but does NOT expose through its `Out` boundary. The folded
+        def/use view charges each of them from the forward op to its grad
+        replay — exactly the span rematerialization exists to NOT pay —
+        so `peak_memory(remat_aware=True)` converts them to point
+        charges at each def/use site instead."""
+        if self._remat is not None:
+            return self._remat
+        n_seg, interiors = 0, set()
+        for op in self.ops:
+            if op.type != 'remat_segment':
+                continue
+            n_seg += 1
+            sub = int(op.attrs.get('sub_block', -1))
+            if not 0 < sub < len(self.program.blocks):
+                continue
+            outs = set(op.outputs.get('Out', ()))
+            for sop in self.program.block(sub).ops:
+                for n in op_writes(sop, self.program):
+                    if n and n not in outs:
+                        interiors.add(n)
+        self._remat = (n_seg, interiors)
+        return self._remat
+
+    def peak_memory(self, batch=1, top=8, remat_aware=False):
         """Static peak-bytes estimate at one batch bucket (every -1 dim
         substitutes `batch`). Resident = persistables + feed/data vars
         (alive across the whole dispatch); temporaries charge over their
-        live interval; peak is the worst program point."""
+        live interval; peak is the worst program point.
+
+        remat_aware=True models activation recompute: a var interior to a
+        `remat_segment` is materialized only WHILE its segment (forward
+        or grad replay) runs, so it charges a point interval at each of
+        its def/use op indices instead of the fwd..grad span. Without
+        segments the two modes agree."""
         batch = max(int(batch), 1)
         est = MemoryEstimate()
         est.batch = batch
@@ -357,6 +397,11 @@ class DataflowAnalysis(object):
         est.feeds_bytes = sum(sizes[n] for n in feedlike)
         est.resident_bytes = est.params_bytes + est.feeds_bytes
 
+        n_seg, interiors = self.remat_interiors()
+        est.remat_aware = bool(remat_aware)
+        est.remat_segments = n_seg
+        est.remat_interior_bytes = sum(sizes.get(n, 0) for n in interiors)
+
         # temporaries: defined by some op, not resident
         delta = [0] * (n_ops + 2)
         temps = []
@@ -367,6 +412,14 @@ class DataflowAnalysis(object):
             if not b:
                 continue
             temps.append((name, b, start, end))
+            if remat_aware and name in interiors:
+                # alive only while a segment executes: point charges at
+                # each touching op, not the fwd..grad span
+                for i in sorted(set(self.defs.get(name, ()))
+                                | set(self.uses.get(name, ()))):
+                    delta[max(i, 0)] += b
+                    delta[min(i, n_ops) + 1] -= b
+                continue
             delta[max(start, 0)] += b
             delta[min(end, n_ops) + 1] -= b
         est.n_temps = len(temps)

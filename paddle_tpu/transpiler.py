@@ -74,43 +74,71 @@ class DistributeTranspiler(object):
 
 
 def memory_optimize(input_program, skip_opt_set=None, print_log=False,
-                    level=0, skip_grads=False, fetch_list=None, batch=1):
-    """Dead-op elimination + a REAL liveness report over `input_program`
-    (sweep happens in place).
+                    level=0, skip_grads=False, fetch_list=None, batch=1,
+                    checkpoints=None):
+    """DEPRECATED front door to the pass API — prefer calling the passes
+    directly: ``paddle_tpu.passes.recompute_program`` for activation
+    rematerialization, ``PassManager(['dead_op_elimination'])`` for the
+    sweep, ``passes.dataflow.analyze_program`` for the liveness report.
+    This wrapper routes to that pipeline (in place) and keeps the
+    reference call signature alive.
+
+    What runs: (1) with `checkpoints` (a list of checkpoint var names or
+    'auto', pre-backward programs only) the recompute pass segments the
+    forward and splices remat_segment ops — the real peak-memory lever;
+    (2) the dead-op sweep; (3) the dataflow engine over the result,
+    returning a MemoryOptimizeReport — per-var live ranges, reuse
+    opportunities, and the remat-aware static peak before/after (at
+    `batch` for -1 dims).
 
     Buffer REUSE stays with XLA: its liveness-based buffer assignment
     subsumes the reference's var-reuse rewrite
     (memory_optimization_transpiler.py:491), so no var renaming happens
-    here. What this call does: run the passes subsystem's
-    dead_op_elimination, then the dataflow engine (passes/dataflow.py)
-    over the swept program, and return a MemoryOptimizeReport carrying
-    what the reference printed while rewriting — per-var live ranges,
-    the reuse opportunities a liveness allocator sees, and the static
-    peak-bytes estimate before/after the sweep (at `batch` for -1 dims).
+    here.
 
     fetch_list: optional fetch Variables/names. Without it only vars
     feeding literally nothing are prunable (any terminal var is a
     potential fetch target); with it, liveness roots at the fetches, the
     reference's skip_opt_set discipline.
     """
+    import warnings
     from .framework import Variable
     from .passes import PassManager
     from .passes import dataflow as _dataflow
+    warnings.warn(
+        "transpiler.memory_optimize is deprecated: use the pass API — "
+        "paddle_tpu.passes.recompute_program(program, checkpoints=...) "
+        "for activation recompute, PassManager(['dead_op_elimination']) "
+        "for the sweep, passes.dataflow.analyze_program for the report",
+        DeprecationWarning, stacklevel=2)
     fetch_names = None
     if fetch_list is not None:
         fetch_names = [f.name if isinstance(f, Variable) else str(f)
                        for f in fetch_list]
     peak_before = _dataflow.analyze_program(
         input_program, fetch_names=fetch_names).peak_memory(
-            batch=batch, top=0).peak_bytes
+            batch=batch, top=0, remat_aware=True).peak_bytes
+    recompute_details = None
+    if checkpoints is not None:
+        from .passes.recompute import recompute_program
+        _, rrep = recompute_program(
+            input_program, checkpoints=checkpoints,
+            fetch_names=fetch_names, preserve=skip_opt_set or (),
+            batch=batch, inplace=True)
+        recompute_details = {
+            'segments': len(rrep.details.get('segments', ())),
+            'skip_reasons': dict(rrep.details.get('skip_reasons', {}))}
     _, reports = PassManager(['dead_op_elimination']).apply(
         input_program, fetch_names=fetch_names,
         preserve=skip_opt_set, inplace=True)
     dfa = _dataflow.analyze_program(input_program, fetch_names=fetch_names)
     report = _dataflow.MemoryOptimizeReport(
         reports[0], dfa.live_intervals(),
-        peak_before, dfa.peak_memory(batch=batch, top=0).peak_bytes,
+        peak_before,
+        dfa.peak_memory(batch=batch, top=0, remat_aware=True).peak_bytes,
         dfa.reuse_report(batch=batch), batch)
+    if recompute_details is not None:
+        report.details['recompute'] = recompute_details
     if print_log:
         print(report)
     return report

@@ -39,6 +39,9 @@ JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/warm_start_smoke.py
 echo "== donation smoke (certified warm-path state donation: 0 compiles, in-place state update recovered, bit-identity across donated/undonated/uncached arms) =="
 JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/donation_smoke.py
 
+echo "== remat smoke (activation recompute A/B on BERT-tiny: bitwise loss parity with dropout on + >=30% measured XLA temp-bytes reduction for the compiled train step) =="
+JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/remat_smoke.py
+
 echo "== crash-resume smoke (SIGKILL mid-epoch -> seconds-scale resume with bit/loss parity; chaos kill+corrupt rounds; checkpoint stall < 2%) =="
 JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/crash_resume_smoke.py
 

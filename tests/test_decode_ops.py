@@ -243,6 +243,117 @@ def test_chunk_eval_plain_other_tag():
     assert outs[5][0] == 1
 
 
+def _oracle_chunks(tags, scheme, num_chunk_types):
+    """Independent chunk extractor (a forward state machine, not the
+    op's boundary predicates): {(start, end, chunk_type)} spans per the
+    reference tag semantics — B begins, E ends, S is a singleton, I
+    continues a same-type chunk or opens one when none is open."""
+    ntt = 4 if scheme == 'IOBES' else 2
+    roles = {'IOB': 'BI', 'IOE': 'IE', 'IOBES': 'BIES'}[scheme]
+    chunks, state = [], [None, None]   # [start index, chunk type]
+
+    def close(end):
+        if state[0] is not None:
+            chunks.append((state[0], end, state[1]))
+        state[0] = state[1] = None
+
+    for i, t in enumerate(tags):
+        ct, role = t // ntt, roles[t % ntt]
+        if ct == num_chunk_types:      # the Other tag: never a chunk
+            close(i - 1)
+            continue
+        if role == 'S':
+            close(i - 1)
+            chunks.append((i, i, ct))
+            continue
+        if role == 'B':
+            close(i - 1)
+            state[:] = [i, ct]
+            continue
+        if state[0] is None or state[1] != ct:   # I/E with no open chunk
+            close(i - 1)
+            state[:] = [i, ct]
+        if role == 'E':
+            close(i)
+    close(len(tags) - 1)
+    return set(chunks)
+
+
+@pytest.mark.parametrize('scheme', ['IOB', 'IOE', 'IOBES'])
+def test_chunk_eval_schemes_vs_oracle(scheme):
+    """Randomized numeric check of every positional scheme against the
+    pure-python span extractor: chunk counts and correct-chunk counts
+    must match exactly, per sequence boundaries (lod)."""
+    layers = fluid.layers
+    nct = 3
+    ntt = 4 if scheme == 'IOBES' else 2
+    inf = layers.data(name='i_' + scheme, shape=[1], dtype='int64',
+                      lod_level=1)
+    lab = layers.data(name='l_' + scheme, shape=[1], dtype='int64',
+                      lod_level=1)
+    prec, rec, f1, n_inf, n_lab, n_cor = layers.chunk_eval(
+        input=inf, label=lab, chunk_scheme=scheme, num_chunk_types=nct)
+    rng = np.random.RandomState(hash(scheme) % 2 ** 31)
+    lens = [7, 5, 9]
+    # tag vocabulary includes the Other tag (value nct * ntt)
+    gold = rng.randint(0, nct * ntt + 1, (sum(lens), 1)).astype(np.int64)
+    pred = rng.randint(0, nct * ntt + 1, (sum(lens), 1)).astype(np.int64)
+    outs = _run([prec, rec, f1, n_inf, n_lab, n_cor],
+                feed={'i_' + scheme: _lod(pred, lens),
+                      'l_' + scheme: _lod(gold, lens)},
+                startup=False)
+    want_inf = want_lab = want_cor = 0
+    off = 0
+    for L in lens:
+        pc = _oracle_chunks(pred[off:off + L, 0], scheme, nct)
+        gc = _oracle_chunks(gold[off:off + L, 0], scheme, nct)
+        want_inf += len(pc)
+        want_lab += len(gc)
+        want_cor += len(pc & gc)
+        off += L
+    assert outs[3][0] == want_inf
+    assert outs[4][0] == want_lab
+    assert outs[5][0] == want_cor
+    assert outs[0][0] == pytest.approx(
+        want_cor / want_inf if want_inf else 0.0)
+    assert outs[1][0] == pytest.approx(
+        want_cor / want_lab if want_lab else 0.0)
+
+
+def test_chunk_eval_ioe_iobes_exact():
+    """Hand-checked IOE and IOBES cases (ref chunk_eval_op.h tag tables:
+    IOE I=0 E=1; IOBES B=0 I=1 E=2 S=3)."""
+    layers = fluid.layers
+    inf = layers.data(name='ix', shape=[1], dtype='int64', lod_level=1)
+    lab = layers.data(name='lx', shape=[1], dtype='int64', lod_level=1)
+    # IOE, 2 types: I-0=0 E-0=1 I-1=2 E-1=3 O=4
+    outs_ioe = layers.chunk_eval(input=inf, label=lab, chunk_scheme='IOE',
+                                 num_chunk_types=2)
+    # gold: [I0 E0 | I1 E1 | O]  → chunks (0,1,t0), (2,3,t1)
+    gold = np.array([0, 1, 2, 3, 4], np.int64).reshape(-1, 1)
+    # pred: [I0 E0 | E1 | O O]   → chunks (0,1,t0), (2,2,t1)
+    pred = np.array([0, 1, 3, 4, 4], np.int64).reshape(-1, 1)
+    outs = _run(list(outs_ioe), feed={'ix': _lod(pred, [5]),
+                                      'lx': _lod(gold, [5])},
+                startup=False)
+    assert outs[3][0] == 2 and outs[4][0] == 2 and outs[5][0] == 1
+
+    inf2 = layers.data(name='iy', shape=[1], dtype='int64', lod_level=1)
+    lab2 = layers.data(name='ly', shape=[1], dtype='int64', lod_level=1)
+    # IOBES, 1 type: B=0 I=1 E=2 S=3 O=4
+    outs_iobes = layers.chunk_eval(input=inf2, label=lab2,
+                                   chunk_scheme='IOBES', num_chunk_types=1)
+    # gold: [B I E | S | O] → chunks (0,2), (3,3)
+    gold2 = np.array([0, 1, 2, 3, 4], np.int64).reshape(-1, 1)
+    # pred: [B I E | O | S] → chunks (0,2), (4,4)
+    pred2 = np.array([0, 1, 2, 4, 3], np.int64).reshape(-1, 1)
+    outs2 = _run(list(outs_iobes),
+                 feed={'ix': _lod(pred, [5]), 'lx': _lod(gold, [5]),
+                       'iy': _lod(pred2, [5]), 'ly': _lod(gold2, [5])},
+                 startup=False)
+    assert outs2[3][0] == 2 and outs2[4][0] == 2 and outs2[5][0] == 1
+
+
 # ---------------------------------------------------------------------------
 # beam search
 # ---------------------------------------------------------------------------

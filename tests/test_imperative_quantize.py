@@ -179,6 +179,72 @@ def test_quantize_transpiler_trains_and_quantizes():
     assert losses[-1] < losses[0] * 0.6
 
 
+def test_quantize_transpiler_range_abs_max():
+    """range_abs_max activations (ref quantize_transpiler.py:105): the
+    scale comes from a sliding window of per-step abs-max stats held as
+    in-graph persistable state (Scales[window] + Iter), while weights
+    keep plain abs_max — both quant types live in one program and QAT
+    still converges through the STE."""
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup_p.random_seed = 5
+    with fluid.program_guard(main_p, startup_p):
+        x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+        lab = fluid.layers.data(name='lab', shape=[1], dtype='int64')
+        h = fluid.layers.fc(x, size=16, act='relu')
+        logits = fluid.layers.fc(h, size=4)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            logits=logits, label=lab))
+        fluid.optimizer.Adam(5e-2).minimize(loss)
+    t = fluid.contrib.quantize.QuantizeTranspiler(
+        activation_quantize_type='range_abs_max', window_size=4)
+    t.training_transpile(main_p, startup_p)
+    ops = [op for op in main_p.global_block().ops]
+    range_ops = [op for op in ops
+                 if op.type == 'fake_quantize_range_abs_max']
+    assert range_ops, 'no range_abs_max op inserted for activations'
+    # weights still quantize via plain abs_max
+    assert any(op.type == 'fake_quantize_abs_max' for op in ops)
+    # the window state threads through under the same names
+    for op in range_ops:
+        assert op.inputs['Scales'] == op.outputs['OutScales']
+        assert op.inputs['Iter'] == op.outputs['OutIter']
+        assert int(op.attrs['window_size']) == 4
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    rng = np.random.RandomState(2)
+    xs = rng.randn(32, 8).astype(np.float32)
+    labs = rng.randint(0, 4, (32, 1))
+    steps = 3
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        losses = []
+        for _ in range(steps):
+            l, = exe.run(main_p, feed={'x': xs, 'lab': labs},
+                         fetch_list=[loss])
+            losses.append(float(np.asarray(l).reshape(-1)[0]))
+        scales_name = range_ops[0].inputs['Scales'][0]
+        iter_name = range_ops[0].inputs['Iter'][0]
+        window = np.asarray(scope.get(scales_name))
+        it = np.asarray(scope.get(iter_name))
+        # the counter advanced once per step; 3 of 4 slots are filled
+        assert int(it.reshape(-1)[0]) == steps
+        assert window.shape == (4,)
+        assert np.count_nonzero(window) == steps
+        # 'x' is fed verbatim every step: its per-step abs-max stats are
+        # identical, and the published scale is the window max
+        x_scale = [op for op in range_ops if op.inputs['X'] == ['x']]
+        if x_scale:
+            w = np.asarray(scope.get(x_scale[0].inputs['Scales'][0]))
+            assert w.max() == pytest.approx(np.abs(xs).max(), rel=1e-5)
+        # freeze flips the window to read-only (is_test)
+        t.freeze_program(main_p)
+        exe.run(main_p, feed={'x': xs, 'lab': labs}, fetch_list=[loss])
+        it2 = np.asarray(scope.get(iter_name))
+        assert int(it2.reshape(-1)[0]) == steps   # frozen: no advance
+    assert losses[-1] < losses[0]
+
+
 def test_fake_quant_grid():
     x = fluid.layers.data(name='x', shape=[4], dtype='float32')
     helper_out = fluid.default_main_program().global_block().create_var(

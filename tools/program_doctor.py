@@ -66,6 +66,7 @@ def examine_program(program, name, batch=32, level='full',
                                    fetch_names=fetch_names)
     hazards = dfa.hazards()
     est = dfa.peak_memory(batch=batch)
+    est_remat = dfa.peak_memory(batch=batch, remat_aware=True)
     reuse = dfa.reuse_report(batch=batch)
     plan = dataflow.donation_plan(program, feed_names=feed_names,
                                   fetch_names=fetch_names, analysis=dfa)
@@ -104,6 +105,7 @@ def examine_program(program, name, batch=32, level='full',
                         for n, s, e in temps[:5]],
         },
         'peak': est.as_dict(),
+        'peak_remat': est_remat.as_dict(),
         'reuse': {k: reuse[k] for k in ('temps_total_bytes',
                                         'temps_peak_bytes',
                                         'reusable_bytes', 'n_temps')},
@@ -138,6 +140,21 @@ def print_report(rep, out=print):
            _fmt_bytes(p['params_bytes']), _fmt_bytes(p['feeds_bytes']),
            _fmt_bytes(p['temps_peak_bytes']), p['peak_op_index'],
            p['peak_op_type']))
+    pr = rep.get('peak_remat') or {}
+    if pr.get('remat_segments'):
+        out("  remat: %d segment(s), interiors %s — remat-aware peak %s "
+            "(span model %s)"
+            % (pr['remat_segments'],
+               _fmt_bytes(pr['remat_interior_bytes']),
+               _fmt_bytes(pr['peak_bytes']), _fmt_bytes(p['peak_bytes'])))
+    hm = rep.get('hlo_memory')
+    if hm:
+        out("  hlo memory (compiled, batch=%d): temps %s, args %s, "
+            "outputs %s, aliased %s"
+            % (p['batch'], _fmt_bytes(hm['temp_bytes']),
+               _fmt_bytes(hm['argument_bytes']),
+               _fmt_bytes(hm['output_bytes']),
+               _fmt_bytes(hm['alias_bytes'])))
     lr = rep['live_ranges']
     longest = ', '.join('%s [%d, %d]' % (e['name'], e['start'], e['end'])
                         for e in lr['longest'][:2])
@@ -158,7 +175,42 @@ def print_report(rep, out=print):
 # ---------------------------------------------------------------------------
 # inputs: the zoo and serialized programs
 # ---------------------------------------------------------------------------
-def doctor_models(names, batch, level, out=print):
+# models small enough that an opt-in HLO compile on the CPU proxy stays
+# CI-friendly; everything else reports static numbers only
+_HLO_FAST = ('smallnet', 'bert', 'bert_remat', 'transformer')
+
+
+def _synth_feeds(program, batch):
+    """Zero-filled feed arrays for every data var (—1 dims -> batch):
+    enough to lower+compile the step for memory_analysis(); the program
+    is never executed."""
+    import numpy as np
+    from paddle_tpu.framework import convert_dtype
+    feeds = {}
+    for v in program.list_vars():
+        if not getattr(v, 'is_data', False) \
+                or getattr(v, 'shape', None) is None:
+            continue
+        shape = tuple(int(batch) if d in (-1, None) else int(d)
+                      for d in v.shape)
+        feeds[v.name] = np.zeros(shape,
+                                 dtype=convert_dtype(v.dtype) or 'float32')
+    return feeds
+
+
+def _hlo_memory(main, startup, fetches, batch, out):
+    import paddle_tpu as fluid
+    from paddle_tpu.executor import compiled_memory_stats
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        return compiled_memory_stats(
+            main, feed=_synth_feeds(main, batch), fetch_list=list(fetches),
+            scope=scope, exe=exe)
+
+
+def doctor_models(names, batch, level, out=print, hlo_memory=False):
     import paddle_tpu as fluid
     from paddle_tpu import unique_name
     lint = _lint_mod()
@@ -178,9 +230,17 @@ def doctor_models(names, batch, level, out=print):
             failed.append({'name': name, 'build_failed': True,
                            'error': '%s: %s' % (type(e).__name__, e)})
             continue
-        reports.append(examine_program(
-            main, name, batch=batch, level=level,
-            fetch_names=lint._fetch_names(fetches)))
+        fetch_names = lint._fetch_names(fetches)
+        rep = examine_program(main, name, batch=batch, level=level,
+                              fetch_names=fetch_names)
+        if hlo_memory and name in _HLO_FAST:
+            try:
+                rep['hlo_memory'] = _hlo_memory(main, startup,
+                                                fetch_names, batch, out)
+            except Exception as e:
+                out("%s: hlo-memory failed: %s: %s"
+                    % (name, type(e).__name__, e))
+        reports.append(rep)
     return reports, failed
 
 
@@ -220,6 +280,8 @@ def baseline_entry(rep):
         'donation_vars': len(rep['donation']['donate']),
         'peak_bytes': rep['peak']['peak_bytes'],
         'peak_batch': rep['peak']['batch'],
+        'remat_segments': rep['peak_remat']['remat_segments'],
+        'peak_bytes_remat': rep['peak_remat']['peak_bytes'],
     }
 
 
@@ -253,6 +315,18 @@ def check_baseline(reports, baseline, out=print):
             out("%s: note: peak estimate drifted %s -> %s (not gating)"
                 % (rep['name'], b.get('peak_bytes'),
                    rep['peak']['peak_bytes']))
+        segs = rep['peak_remat']['remat_segments']
+        if segs < int(b.get('remat_segments', 0)):
+            out("%s: REGRESSION: recompute segments dropped %d -> %d — "
+                "the remat pass stopped applying"
+                % (rep['name'], int(b['remat_segments']), segs))
+            regressions += 1
+        base_remat = int(b.get('peak_bytes_remat', 0))
+        cur_remat = rep['peak_remat']['peak_bytes']
+        if base_remat and cur_remat > base_remat * 1.25:
+            out("%s: REGRESSION: remat-aware peak grew >25%%: %d -> %d"
+                % (rep['name'], base_remat, cur_remat))
+            regressions += 1
     return regressions
 
 
@@ -279,6 +353,10 @@ def main(argv=None):
     ap.add_argument('--fast', action='store_true',
                     help="structural verifier only (skip the registry "
                          "shape/dtype sweep)")
+    ap.add_argument('--hlo-memory', action='store_true',
+                    help="also compile the step for the fast zoo subset "
+                         "(%s) and report XLA memory_analysis() numbers"
+                         % ', '.join(_HLO_FAST))
     ap.add_argument('--write-baseline', metavar='FILE',
                     help="write the stable per-program fingerprint JSON")
     ap.add_argument('--check-baseline', metavar='FILE',
@@ -292,7 +370,8 @@ def main(argv=None):
     if args.models or not args.paths:
         reports, failed = doctor_models(args.paths if args.models
                                         else [], args.batch, level,
-                                        out=say)
+                                        out=say,
+                                        hlo_memory=args.hlo_memory)
     else:
         for path in args.paths:
             try:

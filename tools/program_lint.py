@@ -72,6 +72,28 @@ def _hfused_googlenet():
     return fetches
 
 
+def _bert_remat():
+    """Zoo builder for the rematerialized BERT variant (ISSUE 18): build
+    the pretrain net with per-layer recompute checkpoints — minimize()
+    runs passes/recompute.py before append_backward, so the doctor
+    examines the remat_segment program the trainer actually compiles.
+    Fails loudly if the pass declined every segment: a silent no-op here
+    would un-gate the whole recompute tier."""
+    import paddle_tpu as fluid
+    import models.bert
+    fetches = models.bert.build_bert_pretrain(
+        vocab=1000, max_len=16, d_model=32, d_ff=64, n_head=2,
+        n_layer=2, checkpoints=True)[1:]
+    report = getattr(fluid.default_main_program(),
+                     '_recompute_report', None)
+    if report is None or not report.details.get('segments'):
+        raise RuntimeError(
+            "recompute pass applied no segments to bert_remat: %s"
+            % (report.details.get('skip_reasons') if report else
+               'no report attached'))
+    return fetches
+
+
 def _model_builders():
     import models.alexnet
     import models.bert
@@ -116,6 +138,8 @@ def _model_builders():
         'bert': lambda: models.bert.build_bert_pretrain(
             vocab=1000, max_len=16, d_model=32, d_ff=64, n_head=2,
             n_layer=2)[1:],
+        # the activation-recompute rewrite of the same net (ISSUE 18)
+        'bert_remat': _bert_remat,
     }
 
 
