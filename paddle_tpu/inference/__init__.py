@@ -25,6 +25,11 @@
   least-outstanding-work routing with deadline propagation,
   heartbeat-watchdog failover, Autoscaler, RollingRollout canary/
   promote/rollback).
+- gateway: Gateway — the HTTP/1.1 network front door over a FleetRouter
+  or single predictor (JSON + base64-npz codec, SSE token streaming,
+  per-tenant API keys with token-bucket rate limits and inflight
+  quotas, deadline propagation from the HTTP door, request_id tracing,
+  /healthz //stats.json //metrics, graceful drain).
 The reference's analysis/TensorRT/MKLDNN pass zoo is subsumed by XLA:
 clone(for_test) freezes BN/dropout, XLA does the fusion.
 """
@@ -43,6 +48,8 @@ from .decoding import (DecodingPredictor, DecodeStats, TokenStream,
 from .fleet import (FleetRouter, FleetStats, Autoscaler, RollingRollout,
                     ReplicaFailed, FleetUnavailable, RolloutRolledBack,
                     load_fleet)
+from .gateway import (Gateway, GatewayStats, TenantConfig,
+                      tenants_from_json, render_metrics)
 
 __all__ = ['Config', 'Predictor', 'create_predictor',
            'load_reference_inference_model',
@@ -57,4 +64,6 @@ __all__ = ['Config', 'Predictor', 'create_predictor',
            'ServerOverloaded', 'DeadlineExceeded',
            'FleetRouter', 'FleetStats', 'Autoscaler', 'RollingRollout',
            'ReplicaFailed', 'FleetUnavailable', 'RolloutRolledBack',
-           'load_fleet']
+           'load_fleet',
+           'Gateway', 'GatewayStats', 'TenantConfig',
+           'tenants_from_json', 'render_metrics']

@@ -88,18 +88,26 @@ def _resolve(future, result=None, exc=None):
         pass
 
 
-def shed_if_overloaded(stats, max_queue, fail):
+def shed_if_overloaded(stats, max_queue, fail, request_id=None):
     """Load-shedding check shared by BatchingPredictor and
     decoding.DecodingPredictor. The CALLER must hold stats._lock: the
     depth check and the enqueue increment form one critical section, or
     N concurrent submits at depth max_queue-1 would ALL pass and
     overshoot the bound by the submitter concurrency. Returns True when
-    the request was shed (fail(exc) already called)."""
+    the request was shed (fail(exc) already called). `request_id` (a
+    caller trace id) is named in the shed message and — on stats that
+    keep one — appended to the tagged-failure trace (under the lock
+    the caller already holds)."""
     if max_queue is not None and stats.queue_depth >= max_queue:
         stats.shed += 1
+        if request_id is not None and hasattr(stats, '_failures'):
+            stats._failures.append({'request_id': str(request_id),
+                                    'kind': 'shed',
+                                    'time': time.time()})
         fail(ServerOverloaded(
-            'queue depth %d >= max_queue %d — request shed'
-            % (stats.queue_depth, max_queue)))
+            'queue depth %d >= max_queue %d — request shed%s'
+            % (stats.queue_depth, max_queue,
+               ' (request %s)' % request_id if request_id else '')))
         return True
     return False
 
@@ -327,7 +335,7 @@ class BatchingPredictor(object):
     def buckets(self):
         return list(self._buckets)
 
-    def submit(self, inputs, deadline_ms=None):
+    def submit(self, inputs, deadline_ms=None, request_id=None):
         """Enqueue one request; returns a Future resolving to the list of
         per-fetch numpy arrays sliced to this request's rows. Validation
         errors fail THIS future only (a bad request never poisons a
@@ -335,14 +343,16 @@ class BatchingPredictor(object):
         deadline elapses resolves to DeadlineExceeded instead of being
         dispatched late. When the queue is beyond `max_queue`, the future
         resolves to ServerOverloaded immediately — load is shed at the
-        door, before any padding or device work."""
+        door, before any padding or device work. `request_id` is an
+        optional caller trace id named in the shed message."""
         if self._closed:
             raise RuntimeError('BatchingPredictor is closed')
         fut = Future()
 
         def _shed_locked():
             return shed_if_overloaded(self.stats, self._max_queue,
-                                      fut.set_exception)
+                                      fut.set_exception,
+                                      request_id=request_id)
 
         with self.stats._lock:     # fast-fail before validation work
             if _shed_locked():

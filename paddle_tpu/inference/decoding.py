@@ -172,6 +172,9 @@ class DecodeStats(object):
         self._lock = threading.Lock()
         self._ttft = deque(maxlen=window)
         self._itl = deque(maxlen=window)
+        # tagged-request failure trace (shed/expired for requests that
+        # carried a request_id): what a gateway/operator correlates
+        self._failures = deque(maxlen=16)
         self.tier = 'bf16'   # KV-cache tier (bf16, or int8 paged cache)
         self.queue_depth = 0
         self.requests = 0        # completed requests
@@ -210,6 +213,7 @@ class DecodeStats(object):
         with self._lock:
             self._ttft.clear()
             self._itl.clear()
+            self._failures.clear()
             self.requests = 0
             self.tokens = 0
             self.prefills = 0
@@ -234,6 +238,16 @@ class DecodeStats(object):
                 # snapshot(): a reset-then-measure window must not
                 # report pre-reset prefix hits / peaks
                 self.block_reset()
+
+    def record_failure(self, request_id, kind):
+        """One tagged request's shed/expiry: lands in the bounded
+        `recent_failures` snapshot list for wire-level correlation."""
+        if request_id is None:
+            return
+        with self._lock:
+            self._failures.append({'request_id': str(request_id),
+                                   'kind': kind,
+                                   'time': time.time()})
 
     def snapshot(self):
         with self._lock:
@@ -266,7 +280,8 @@ class DecodeStats(object):
                     if self.drafted else 1.0,
                     'tokens_per_dispatch':
                         round(self.adv_tokens / self.adv_events, 4)
-                        if self.adv_events else 1.0}
+                        if self.adv_events else 1.0,
+                    'recent_failures': list(self._failures)}
             if self.block_source is None:
                 return snap
             snap['cow_blocks'] = int(self.cow_blocks)
@@ -449,10 +464,13 @@ class _Request(object):
                  'deadline', 'slots', 'produced', 'tokens', 'last_tokens',
                  'scores', 'finished', 'hyps', 't_first', 't_last',
                  'tables', 'next_start', 'prefilling', 'match',
-                 'match_epoch', 'draft_strikes', 'draft_cooldown')
+                 'match_epoch', 'draft_strikes', 'draft_cooldown',
+                 'request_id')
 
-    def __init__(self, prompt, max_new, beam, stream, deadline_ms):
+    def __init__(self, prompt, max_new, beam, stream, deadline_ms,
+                 request_id=None):
         self.prompt = prompt
+        self.request_id = request_id      # caller trace id (gateway)
         self.max_new = max_new
         self.beam = beam                  # None = greedy
         self.stream = stream
@@ -832,30 +850,36 @@ class DecodingPredictor(object):
         return self._blocks if self._layout == 'block' else None
 
     def submit(self, prompt_ids, max_new_tokens=None, beam=None,
-               deadline_ms=None):
+               deadline_ms=None, request_id=None):
         """Enqueue one decode request; returns a TokenStream. Validation
         errors fail THIS stream only. With `deadline_ms`, a request still
         queued — or still DECODING — when the deadline elapses resolves
         to DeadlineExceeded at the next step boundary and frees its
         slot(s). Beyond `max_queue` waiting requests, new submissions
-        shed with ServerOverloaded before any device work."""
+        shed with ServerOverloaded before any device work. `request_id`
+        is an optional caller trace id, named in every shed/expiry
+        message and surfaced in stats `recent_failures`."""
         if self._closed:
             raise RuntimeError('DecodingPredictor is closed')
         beam = int(beam) if beam else None
         stream = TokenStream(beam=beam)
+        rid_sfx = (' (request %s)' % request_id) if request_id else ''
         if self._draining:
             # draining for scale-in: stop admitting; shed loudly (the
             # request never cost device work — a fleet router re-routes)
             with self.stats._lock:
                 self.stats.shed += 1
                 self.stats.drained += 1
+            self.stats.record_failure(request_id, 'drained')
             stream._fail(ServerOverloaded(
-                'request shed: endpoint draining for scale-in'))
+                'request shed: endpoint draining for scale-in%s'
+                % rid_sfx))
             return stream
 
         def _shed_locked():
             return _batching.shed_if_overloaded(
-                self.stats, self._max_queue, stream._fail)
+                self.stats, self._max_queue, stream._fail,
+                request_id=request_id)
 
         with self.stats._lock:          # fast-fail before validation work
             if _shed_locked():
@@ -885,7 +909,8 @@ class DecodingPredictor(object):
         except Exception as e:
             stream._fail(e)
             return stream
-        req = _Request(prompt, max_new, beam, stream, deadline_ms)
+        req = _Request(prompt, max_new, beam, stream, deadline_ms,
+                       request_id=request_id)
         with self._lifecycle:
             if self._closed:
                 raise RuntimeError('DecodingPredictor is closed')
@@ -893,8 +918,10 @@ class DecodingPredictor(object):
                 with self.stats._lock:
                     self.stats.shed += 1
                     self.stats.drained += 1
+                self.stats.record_failure(request_id, 'drained')
                 stream._fail(ServerOverloaded(
-                    'request shed: endpoint draining for scale-in'))
+                    'request shed: endpoint draining for scale-in%s'
+                    % rid_sfx))
                 return stream
             with self.stats._lock:
                 if _shed_locked():      # re-check atomically with enqueue
@@ -1229,8 +1256,11 @@ class DecodingPredictor(object):
                 self.stats.queue_depth -= 1
                 self.stats.shed += 1
                 self.stats.drained += 1
+            self.stats.record_failure(req.request_id, 'drained')
             req.stream._fail(ServerOverloaded(
-                'request shed: endpoint draining for scale-in'))
+                'request shed: endpoint draining for scale-in%s'
+                % (' (request %s)' % req.request_id
+                   if req.request_id else '')))
 
     def _drain_on_close(self, waiting):
         err = RuntimeError('DecodingPredictor closed')
@@ -1268,9 +1298,12 @@ class DecodingPredictor(object):
                 if cancelled:
                     req.stream._fail(RuntimeError('request cancelled'))
                 else:
+                    self.stats.record_failure(req.request_id, 'expired')
                     req.stream._fail(DeadlineExceeded(
-                        'request expired after %.1f ms in queue'
-                        % ((now - req.t_submit) * 1e3)))
+                        'request expired after %.1f ms in queue%s'
+                        % ((now - req.t_submit) * 1e3,
+                           ' (request %s)' % req.request_id
+                           if req.request_id else '')))
             else:
                 alive.append(req)
         waiting.clear()
@@ -1286,9 +1319,13 @@ class DecodingPredictor(object):
                 else:
                     with self.stats._lock:
                         self.stats.expired += 1
+                    self.stats.record_failure(req.request_id, 'expired')
                     req.stream._fail(DeadlineExceeded(
                         'deadline elapsed mid-decode after %d token(s); '
-                        'slot freed' % req.produced))
+                        'slot freed%s'
+                        % (req.produced,
+                           ' (request %s)' % req.request_id
+                           if req.request_id else '')))
 
     def _admit(self, waiting):
         """Strict-FIFO admission at the step boundary: one prefill

@@ -224,9 +224,11 @@ def _rebuild_exc(header):
 
 class _FleetRequest(object):
     __slots__ = ('id', 'header', 'arrays', 'future', 't_submit',
-                 'deadline', 'attempts', 'on_token', 't_first', 'replica')
+                 'deadline', 'attempts', 'on_token', 't_first', 'replica',
+                 'request_id')
 
-    def __init__(self, rid, header, arrays, deadline_ms, on_token=None):
+    def __init__(self, rid, header, arrays, deadline_ms, on_token=None,
+                 request_id=None):
         self.id = rid
         self.header = header        # op + per-op fields (no id/deadline)
         self.arrays = arrays
@@ -238,6 +240,13 @@ class _FleetRequest(object):
         self.on_token = on_token
         self.t_first = None         # first token/result arrival
         self.replica = None
+        self.request_id = request_id  # caller trace id (gateway etc.)
+
+
+def _rid_suffix(req):
+    """' (request <id>)' when the caller tagged the request — every
+    router-originated error names something the caller can correlate."""
+    return ' (request %s)' % req.request_id if req.request_id else ''
 
 
 class _Replica(object):
@@ -270,6 +279,12 @@ class _Replica(object):
         stats = self.hb.get('stats', {}) or {}
         return {'state': self.state,
                 'pid': self.proc.pid if self.proc else None,
+                # the artifact the worker REPORTS serving (hello, then
+                # heartbeats): lets an operator map a wedged replica
+                # row to a process + on-disk artifact (ISSUE 19)
+                'artifact': (self.hb.get('artifact')
+                             or self.hello.get('artifact')
+                             or self.spec.get('artifact')),
                 'tier': self.hello.get('tier', self.spec.get('tier')
                                        or 'bf16'),
                 # decode artifacts: cache layout + mesh tag the worker
@@ -561,7 +576,7 @@ class FleetRouter(object):
 
     # -- request path ------------------------------------------------------
     def submit(self, inputs, deadline_ms=None, max_new_tokens=None,
-               beam=None, on_token=None):
+               beam=None, on_token=None, request_id=None):
         """Route one request; returns a Future.
 
         batching/compiled fleets: `inputs` is a dict (or feed-order
@@ -583,13 +598,17 @@ class FleetRouter(object):
         several calls may land with no network round-trip between them.
         Callbacks must not assume one frame (or one decode step) per
         call; exceptions are swallowed (a streaming callback can never
-        kill the reader)."""
+        kill the reader).
+
+        `request_id` is an optional caller trace id: it rides the wire
+        frame header into the replica's serving stats and is named in
+        every shed/expiry/failure message for this request."""
         if self._closed:
             raise RuntimeError('FleetRouter is closed')
         header, arrays = self._encode_request(inputs, max_new_tokens,
                                               beam, on_token)
         req = _FleetRequest(next(self._req_ids), header, arrays,
-                            deadline_ms, on_token)
+                            deadline_ms, on_token, request_id=request_id)
         with self.stats._lock:
             self.stats.submitted += 1
         self._route(req)
@@ -652,14 +671,16 @@ class FleetRouter(object):
             if req.attempts >= self._max_attempts:
                 self._fail_req(req, RuntimeError(
                     'request re-routed %d times without finding a '
-                    'stable replica' % req.attempts))
+                    'stable replica%s' % (req.attempts,
+                                          _rid_suffix(req))))
                 return
             candidates = [r for r in self._replicas.values()
                           if r.state == 'serving']
             if not candidates:
                 self._fail_req(req, FleetUnavailable(
-                    'no serving replicas (fleet %s)'
-                    % ('closed' if self._closed else 'degraded')))
+                    'no serving replicas (fleet %s)%s'
+                    % ('closed' if self._closed else 'degraded',
+                       _rid_suffix(req))))
                 return
             if self._max_queue is not None and not req.attempts:
                 depth = sum(len(r.pending) for r in candidates)
@@ -668,7 +689,8 @@ class FleetRouter(object):
                         self.stats.shed += 1
                     self._fail_req(req, ServerOverloaded(
                         'fleet queue depth %d >= max_queue %d — '
-                        'request shed' % (depth, self._max_queue)),
+                        'request shed%s' % (depth, self._max_queue,
+                                            _rid_suffix(req))),
                         count_failed=False)
                     return
             rep = min(candidates, key=lambda r: (r.load, r.rid))
@@ -694,7 +716,8 @@ class FleetRouter(object):
                 with self.stats._lock:
                     self.stats.expired += 1
                 self._fail_req(req, DeadlineExceeded(
-                    'request expired in the router queue'),
+                    'request expired in the router queue%s'
+                    % _rid_suffix(req)),
                     count_failed=False)
                 # NO _pump here: _pump calls _send, and a burst of
                 # simultaneously-expired queued requests would recurse
@@ -706,6 +729,8 @@ class FleetRouter(object):
         hdr['id'] = req.id
         if remaining is not None:
             hdr['deadline_ms'] = remaining
+        if req.request_id is not None:
+            hdr['request_id'] = req.request_id
         try:
             # no send timeout: a wedged worker's full socket buffer can
             # block sendall only until the watchdog SIGKILLs it
@@ -744,6 +769,12 @@ class FleetRouter(object):
         if count_failed:
             with self.stats._lock:
                 self.stats.failed += 1
+        if req.request_id is not None:
+            # tagged requests leave a correlatable trace in the fleet
+            # event log (surfaces in fleet_snapshot()['events'])
+            self.stats.record_event(
+                'request_failed', req.replica,
+                '%s: %s' % (req.request_id, type(exc).__name__))
         _resolve(req.future, exc=exc)
 
     # -- replica -> router frames ------------------------------------------
@@ -895,11 +926,10 @@ class FleetRouter(object):
             'failed loudly, %d queued re-routed'
             % (rep.rid, reason, len(outstanding), len(pending)),
             RuntimeWarning)
-        exc = ReplicaFailed(
-            'fleet replica %d died (%s) with this request in flight'
-            % (rep.rid, reason))
         for req in outstanding:
-            self._fail_req(req, exc)
+            self._fail_req(req, ReplicaFailed(
+                'fleet replica %d died (%s) with this request in '
+                'flight%s' % (rep.rid, reason, _rid_suffix(req))))
         if pending:
             # re-route in a THROWAWAY thread: this path runs on the
             # watchdog (and reader) threads, and _route -> _send can
@@ -983,7 +1013,8 @@ class FleetRouter(object):
             with self.stats._lock:
                 self.stats.expired += 1
             self._fail_req(req, DeadlineExceeded(
-                'request expired in the router queue'),
+                'request expired in the router queue%s'
+                % _rid_suffix(req)),
                 count_failed=False)
 
     def _process_ctl(self):
@@ -1128,11 +1159,11 @@ class FleetRouter(object):
         with self._lock:
             rep.state = 'retired'
         if leftovers:
-            exc = ReplicaFailed(
-                'fleet replica %d retired with this request still in '
-                'flight (drain timeout)' % rep.rid)
             for req in leftovers:
-                self._fail_req(req, exc)
+                self._fail_req(req, ReplicaFailed(
+                    'fleet replica %d retired with this request still '
+                    'in flight (drain timeout)%s'
+                    % (rep.rid, _rid_suffix(req))))
         try:
             if rep.sock is not None:
                 rep.sock.close()
@@ -1142,13 +1173,13 @@ class FleetRouter(object):
 
     # -- rollout / probe plumbing ------------------------------------------
     def submit_to(self, rid, inputs, deadline_ms=None,
-                  max_new_tokens=None, beam=None):
+                  max_new_tokens=None, beam=None, request_id=None):
         """Route one request to a SPECIFIC replica (rollout probes;
         bypasses least-work selection, still honors frame capacity)."""
         header, arrays = self._encode_request(inputs, max_new_tokens,
                                               beam, None)
         req = _FleetRequest(next(self._req_ids), header, arrays,
-                            deadline_ms)
+                            deadline_ms, request_id=request_id)
         with self._lock:
             rep = self._replicas.get(rid)
             if rep is None or rep.state not in ('serving', 'canary',

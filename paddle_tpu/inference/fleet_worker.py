@@ -130,7 +130,8 @@ class _BatchingEndpoint(object):
                       {'o%d' % j: o for j, o in enumerate(outs)})
         try:
             fut = self.pred.submit(feed,
-                                   deadline_ms=hdr.get('deadline_ms'))
+                                   deadline_ms=hdr.get('deadline_ms'),
+                                   request_id=hdr.get('request_id'))
         except Exception as e:
             conn.reply_err(req_id, e,
                            _is_requeueable(e, self.draining))
@@ -179,7 +180,8 @@ class _DecodingEndpoint(object):
             stream = self.pred.submit(
                 arrays['prompt'], max_new_tokens=hdr.get('max_new'),
                 beam=hdr.get('beam'),
-                deadline_ms=hdr.get('deadline_ms'))
+                deadline_ms=hdr.get('deadline_ms'),
+                request_id=hdr.get('request_id'))
         except Exception as e:
             conn.reply_err(req_id, e,
                            _is_requeueable(e, self.draining))
@@ -309,7 +311,9 @@ class _CompiledEndpoint(object):
                     (time.perf_counter() - t_in) * 1e3 >= dl:
                 raise _batching.DeadlineExceeded(
                     'deadline elapsed in the replica queue before '
-                    'dispatch')
+                    'dispatch%s'
+                    % (' (request %s)' % hdr['request_id']
+                       if hdr.get('request_id') else ''))
             feed = _serve._feed_from_npz(self.pred._sig['feeds'],
                                          arrays)
             outs = self.pred.run(feed)
@@ -401,6 +405,7 @@ def main():
             try:
                 _fleet.write_heartbeat(hb_path, {
                     'replica': rid, 'pid': os.getpid(),
+                    'artifact': artifact,
                     'state': state[0], 'kind': kind,
                     'compiles': compiles[0],
                     'stats': endpoint.snapshot()})
@@ -417,6 +422,7 @@ def main():
     sock.connect(sock_path)
     conn = _Conn(sock)
     conn.send({'op': 'hello', 'replica': rid, 'pid': os.getpid(),
+               'artifact': artifact,
                'kind': kind, 'tier': endpoint.tier,
                'layout': getattr(endpoint, 'layout', None),
                'mesh': getattr(endpoint, 'mesh', None),

@@ -1141,6 +1141,71 @@ def _fleet_cli(argv):
     return 0
 
 
+def _gateway_cli(argv):
+    # serve.py gateway ARTIFACT_DIR [PORT] [--host H] [--replicas N]
+    #          [--tier T] [--kind K] [--tenants TENANTS.json]
+    #          [--max-queue N] [--max-inflight N]
+    # Serve a replica fleet over HTTP (ISSUE 19): spin up REPLICAS
+    # workers behind a FleetRouter, front them with gateway.Gateway,
+    # print one {'url': ...} JSON line (flushed — callers poll it),
+    # and serve until SIGTERM/SIGINT or an authenticated POST
+    # /admin/drain. Shutdown is the graceful-drain contract: stop
+    # admitting, finish every in-flight request/stream, close the
+    # fleet, exit 0. TENANTS.json: {api_key: {tenant, rate, burst,
+    # max_inflight, admin}}; omitted = open/anonymous serving.
+    host, argv = _pop_flag(argv, 'host')
+    tier, argv = _pop_flag(argv, 'tier')
+    kind, argv = _pop_flag(argv, 'kind')
+    tenants_path, argv = _pop_flag(argv, 'tenants')
+    replicas, argv = _pop_flag(argv, 'replicas')
+    max_queue, argv = _pop_flag(argv, 'max-queue')
+    max_inflight, argv = _pop_flag(argv, 'max-inflight')
+    if len(argv) not in (3, 4):
+        print("usage: serve.py gateway ARTIFACT_DIR [PORT] [--host H] "
+              "[--replicas N] [--tier T] [--kind K] "
+              "[--tenants TENANTS.json] [--max-queue N] "
+              "[--max-inflight N]", file=sys.stderr)
+        return 2
+    artifact_dir = argv[2]
+    port = int(argv[3]) if len(argv) == 4 else 0
+    try:
+        from . import fleet as _fleet
+        from . import gateway as _gateway
+    except ImportError:  # run by file path: siblings sit alongside
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import fleet as _fleet
+        import gateway as _gateway
+    import signal
+    import threading
+    tenants = (_gateway.tenants_from_json(tenants_path)
+               if tenants_path else None)
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    with _fleet.FleetRouter(
+            artifact_dir, replicas=int(replicas) if replicas else 2,
+            kind=kind or 'auto', tier=tier,
+            max_queue=int(max_queue) if max_queue else None) as router:
+        gw = _gateway.Gateway(
+            router, host=host or '127.0.0.1', port=port,
+            tenants=tenants,
+            max_inflight=int(max_inflight) if max_inflight else None)
+        gw.start()
+        print(json.dumps({'url': gw.url, 'pid': os.getpid(),
+                          'kind': router.kind}), flush=True)
+        try:
+            while not stop.is_set() \
+                    and not gw.drain_requested.is_set():
+                if stop.wait(0.2):
+                    break
+            # SIGTERM/drain: stop admitting, finish in-flight streams
+            # (the fleet drain path closes the router after us), exit 0
+            gw.drain()
+        finally:
+            gw.close()
+    return 0
+
+
 def main(argv):
     if len(argv) >= 2 and argv[1] == 'bench':
         return _bench_cli(argv)
@@ -1150,6 +1215,8 @@ def main(argv):
         return _decode_cli(argv)
     if len(argv) >= 2 and argv[1] == 'fleet':
         return _fleet_cli(argv)
+    if len(argv) >= 2 and argv[1] == 'gateway':
+        return _gateway_cli(argv)
     if len(argv) >= 2 and argv[1] == 'train':
         # serve.py train ARTIFACT_DIR FEEDS.npz OUT.npz STEPS [CKPT.npz]
         # runs STEPS train steps on the (fixed) feeds; OUT.npz holds each
@@ -1179,7 +1246,10 @@ def main(argv):
               "       serve.py decode ARTIFACT_DIR PROMPTS.npz OUT.npz "
               "[MAX_NEW [BEAM]] [--tier T]\n"
               "       serve.py fleet ARTIFACT_DIR IN.npz N_REQUESTS "
-              "[REPLICAS] [--tier T] [--kind K]", file=sys.stderr)
+              "[REPLICAS] [--tier T] [--kind K]\n"
+              "       serve.py gateway ARTIFACT_DIR [PORT] [--host H] "
+              "[--replicas N] [--tier T] [--kind K] "
+              "[--tenants TENANTS.json]", file=sys.stderr)
         return 2
     artifact_dir, in_path, out_path = argv[1:]
     pred = CompiledPredictor(artifact_dir)

@@ -64,6 +64,8 @@ def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
         serving_report()
     if _fleet_sources:
         fleet_report()
+    if _gateway_sources:
+        gateway_report()
     if _training_sources:
         training_report()   # renders feeder + pod sources too
     else:
@@ -295,6 +297,69 @@ def fleet_report():
                        if s.get('spinup_s') is not None else '-',
                        s.get('compiles') if s.get('compiles')
                        is not None else '-'))
+    return out
+
+
+# -- serving-gateway metrics -------------------------------------------------
+# HTTP gateways (inference/gateway.Gateway) register a zero-arg snapshot
+# callable here; gateway_report() renders one summary row per gateway
+# (requests by outcome, inflight, TTFB/TTFT percentiles, drain state)
+# plus a per-tenant admission table (requests, rate-limited, quota and
+# overload sheds, expiries), alongside the fleet table at stop_profiler.
+_gateway_sources = {}
+
+
+def register_gateway_source(name, snapshot):
+    """Register a gateway-metrics source: `snapshot()` -> dict with
+    requests, ok, rate_limited, quota, shed, expired, failed, inflight,
+    streams, draining, ttfb/ttft percentiles, tenants={name: tenant
+    counters} (the contract of gateway.Gateway.snapshot)."""
+    _gateway_sources[name] = snapshot
+
+
+def unregister_gateway_source(name):
+    _gateway_sources.pop(name, None)
+
+
+def gateway_report():
+    """Print gateway metrics for every registered source and return
+    them as {source name: snapshot dict}."""
+    out = {}
+    rows = []
+    for name in sorted(_gateway_sources):
+        try:
+            snap = _gateway_sources[name]()
+        except Exception:
+            continue  # a closing gateway must not break the report
+        out[name] = snap
+        rows.append((name, snap))
+    if rows:
+        print("%-30s %8s %8s %5s %6s %5s %7s %6s %8s %10s %10s %6s" %
+              ('Gateway source', 'requests', 'ok', '429', 'quota',
+               'shed', 'expired', 'fail', 'inflight', 'ttfb99(ms)',
+               'ttft99(ms)', 'drain'))
+    for name, snap in rows:
+        print("%-30s %8d %8d %5d %6d %5d %7d %6d %8d %10.2f %10.2f "
+              "%6s" %
+              (name[:30], snap.get('requests', 0), snap.get('ok', 0),
+               snap.get('rate_limited', 0), snap.get('quota', 0),
+               snap.get('shed', 0), snap.get('expired', 0),
+               snap.get('failed', 0), snap.get('inflight', 0),
+               snap.get('ttfb_p99_ms', 0.0),
+               snap.get('ttft_p99_ms', 0.0),
+               'yes' if snap.get('draining') else 'no'))
+        tenants = snap.get('tenants', {})
+        if tenants:
+            print("  %-20s %8s %8s %5s %6s %5s %7s %6s %8s" %
+                  ('tenant', 'requests', 'ok', '429', 'quota', 'shed',
+                   'expired', 'fail', 'inflight'))
+            for tname in sorted(tenants):
+                t = tenants[tname]
+                print("  %-20s %8d %8d %5d %6d %5d %7d %6d %8d" %
+                      (tname[:20], t.get('requests', 0), t.get('ok', 0),
+                       t.get('rate_limited', 0), t.get('quota', 0),
+                       t.get('shed', 0), t.get('expired', 0),
+                       t.get('failed', 0), t.get('inflight', 0)))
     return out
 
 

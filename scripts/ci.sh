@@ -77,6 +77,9 @@ JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/quant_smoke.py
 echo "== serving fleet smoke (3-replica warm fleet 0 compiles at spin-up; SIGKILL chaos loses only the victim's in-flight work with bit-identical survivors; autoscaler holds p99 TTFT across a 5x Poisson swing with zero dropped streams; rolling int8 rollout promotes on parity and rolls back loudly on an injected failure; fleet_ctl 0/1/2 exit codes) =="
 JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/fleet_smoke.py
 
+echo "== serving gateway smoke (serve.py gateway over a 2-replica fleet: SSE byte-identical to the direct predictor; 401/429 admission with Retry-After; SIGKILL chaos 502s only the victim's in-flight streams; SIGTERM drain finishes every stream and exits 0) =="
+JAX_PLATFORMS=cpu PTPU_PLATFORM=cpu python scripts/gateway_smoke.py
+
 echo "== tpu smoke tier (when a real chip is visible) =="
 if env -u JAX_PLATFORMS -u PTPU_PLATFORM -u XLA_FLAGS python - <<'EOF'
 import sys

@@ -428,14 +428,30 @@ def test_sigterm_preemption_resume_parity(tmp_path):
                           '4'], env=env, cwd=REPO,
                          stdout=subprocess.PIPE, stderr=subprocess.PIPE,
                          text=True)
-    deadline = time.time() + 180
+    # Wait until the worker has provably trained (>=5 logged steps)
+    # BEFORE delivering SIGTERM: a worker still compiling has no signal
+    # handler installed yet and dies rc!=0, which is a test artifact,
+    # not a preemption bug. If the bar is never reached, fail loudly
+    # with the worker's stderr instead of SIGTERMing a cold process.
+    deadline = time.time() + 300
+    progressed = False
     while time.time() < deadline:
         if os.path.exists(out1) and \
                 len(open(out1).read().splitlines()) >= 5:
+            progressed = True
             break
+        if p.poll() is not None:
+            _out, err = p.communicate(timeout=30)
+            pytest.fail('worker exited rc=%s before writing 5 steps:\n%s'
+                        % (p.returncode, err[-2000:]))
         time.sleep(0.05)
+    if not progressed:
+        p.kill()
+        _out, err = p.communicate(timeout=30)
+        pytest.fail('worker wrote <5 steps in 300s (machine overloaded '
+                    'or training wedged):\n%s' % err[-2000:])
     p.send_signal(signal.SIGTERM)
-    _out, err = p.communicate(timeout=120)
+    _out, err = p.communicate(timeout=240)
     assert p.returncode == 0, 'preempted worker must exit 0: rc=%s\n%s' \
         % (p.returncode, err[-2000:])
     got = pod_latest_committed(ckpt)

@@ -110,10 +110,14 @@ def cmd_status(args):
              c.get('rollout', {}).get('state', 'idle')))
     # layout/mesh columns (ISSUE 13): which decode cache layout and
     # mesh each replica ACTUALLY loaded — a rolling rollout to the
-    # block-paged or mp-sharded tier is auditable mid-flight
-    print('%-8s %-9s %5s %6s %8s %5s %8s %8s %5s %9s %8s' %
+    # block-paged or mp-sharded tier is auditable mid-flight.
+    # pid/artifact (ISSUE 19): the WORKER-reported identity from
+    # hello/heartbeats, so a wedged row maps to a process + artifact
+    # dir even when the router-side view is stale
+    print('%-8s %-9s %5s %6s %8s %7s %8s %8s %5s %9s %8s %s' %
           ('replica', 'state', 'tier', 'layout', 'mesh', 'pid',
-           'backlog', 'requests', 'occ', 'hb-age(s)', 'compiles'))
+           'backlog', 'requests', 'occ', 'hb-age(s)', 'compiles',
+           'artifact'))
     reps = st.get('replicas', {})
     for rid in sorted(reps, key=lambda r: int(r)):
         s = reps[rid]
@@ -122,14 +126,18 @@ def cmd_status(args):
         # backlog = router pending + worker queue (outstanding would
         # double-count frames already inside the worker's queue)
         backlog = s.get('pending', 0) + s.get('queue_depth', 0)
-        print('%-8s %-9s %5s %6s %8s %5s %8d %8d %5.2f %9s %8s' %
+        artifact = hb.get('artifact') or s.get('artifact') or '-'
+        print('%-8s %-9s %5s %6s %8s %7s %8d %8d %5.2f %9s %8s %s' %
               (rid, s.get('state', '?')[:9], s.get('tier', 'bf16'),
                s.get('layout') or '-', s.get('mesh') or '-',
-               s.get('pid', '-'), backlog, s.get('requests', 0),
+               hb.get('pid') or s.get('pid') or '-',
+               backlog, s.get('requests', 0),
                s.get('occupancy', 0.0),
                ('%.2f' % hb_age) if hb_age is not None else '-',
                s.get('compiles') if s.get('compiles') is not None
-               else '-'))
+               else '-',
+               os.path.basename(str(artifact).rstrip('/'))
+               if artifact != '-' else '-'))
     return 0 if healthy else 1
 
 

@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Serving-gateway control CLI (ISSUE 19).
+
+    python tools/gateway_ctl.py status GATEWAY_URL [--json] [--key K]
+    python tools/gateway_ctl.py drain  GATEWAY_URL [--key K] [--timeout S]
+
+`status` hits the running gateway's /healthz and /stats.json endpoints
+and prints the serving picture: health, drain state, inflight, the
+per-tenant admission counters and TTFB/TTFT percentiles, plus the
+backend (fleet) summary. Pure stdlib HTTP — this CLI never imports jax
+or the framework and never touches the gateway process.
+
+`drain` POSTs /admin/drain (the gateway stops admitting, finishes every
+in-flight request/stream, and its serve loop exits — `serve.py gateway`
+then exits 0) and waits until the gateway goes unreachable or reports
+zero inflight, up to --timeout (default 120s). --key authenticates as
+an admin tenant when the gateway runs with tenant auth.
+
+Exit codes (both subcommands):
+  0  success — status: the gateway is healthy; drain: the gateway
+     drained (unreachable, or draining with zero inflight)
+  1  unhealthy / failed — status: gateway unreachable or reporting
+     unhealthy; drain: rejected, or still busy at --timeout
+  2  usage error — unknown subcommand or malformed URL
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+
+def _get(url, key=None, timeout=10.0):
+    req = urllib.request.Request(url)
+    if key:
+        req.add_header('X-API-Key', key)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read().decode('utf-8'))
+
+
+def _post(url, key=None, timeout=10.0):
+    req = urllib.request.Request(url, data=b'{}', method='POST')
+    req.add_header('Content-Type', 'application/json')
+    if key:
+        req.add_header('X-API-Key', key)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read().decode('utf-8'))
+
+
+def cmd_status(args):
+    base = args.url.rstrip('/')
+    try:
+        try:
+            code, health = _get(base + '/healthz')
+        except urllib.error.HTTPError as e:
+            # /healthz answers 503 when draining/unserving — still JSON
+            code, health = e.code, json.loads(
+                e.read().decode('utf-8') or '{}')
+        _, stats = _get(base + '/stats.json')
+    except Exception as e:
+        print('gateway_ctl: %s unreachable: %s' % (base, e),
+              file=sys.stderr)
+        return 1
+    healthy = code == 200 and health.get('ok', False)
+    if args.json:
+        print(json.dumps({'healthy': healthy, 'health': health,
+                          'stats': stats}, default=str))
+        return 0 if healthy else 1
+    print('gateway    : %s (backend kind=%s)'
+          % (base, health.get('kind')))
+    print('health     : %s%s, %d inflight'
+          % ('OK' if healthy else 'UNHEALTHY',
+             ' [DRAINING]' if health.get('draining') else '',
+             int(health.get('inflight', 0))))
+    print('requests   : %d total — %d ok, %d rate-limited, %d quota, '
+          '%d shed, %d expired, %d failed'
+          % (stats.get('requests', 0), stats.get('ok', 0),
+             stats.get('rate_limited', 0), stats.get('quota', 0),
+             stats.get('shed', 0), stats.get('expired', 0),
+             stats.get('failed', 0)))
+    print('latency    : ttfb p50 %.2fms p99 %.2fms  ttft p50 %.2fms '
+          'p99 %.2fms'
+          % (stats.get('ttfb_p50_ms', 0.0), stats.get('ttfb_p99_ms', 0.0),
+             stats.get('ttft_p50_ms', 0.0), stats.get('ttft_p99_ms', 0.0)))
+    tenants = stats.get('tenants', {})
+    if tenants:
+        print('%-20s %8s %8s %5s %6s %5s %7s %6s %8s' %
+              ('tenant', 'requests', 'ok', '429', 'quota', 'shed',
+               'expired', 'fail', 'inflight'))
+        for name in sorted(tenants):
+            t = tenants[name]
+            print('%-20s %8d %8d %5d %6d %5d %7d %6d %8d' %
+                  (name[:20], t.get('requests', 0), t.get('ok', 0),
+                   t.get('rate_limited', 0), t.get('quota', 0),
+                   t.get('shed', 0), t.get('expired', 0),
+                   t.get('failed', 0), t.get('inflight', 0)))
+    backend = stats.get('backend') or {}
+    if backend:
+        print('backend    : kind=%s %s'
+              % (backend.get('kind'),
+                 ' '.join('%s=%s' % (k, backend[k])
+                          for k in ('serving', 'completed', 'failed',
+                                    'shed', 'expired', 'requests')
+                          if k in backend)))
+    return 0 if healthy else 1
+
+
+def cmd_drain(args):
+    base = args.url.rstrip('/')
+    try:
+        code, resp = _post(base + '/admin/drain', key=args.key)
+    except urllib.error.HTTPError as e:
+        print('gateway_ctl: drain rejected: HTTP %d %s'
+              % (e.code, e.read().decode('utf-8', 'replace')[:200]),
+              file=sys.stderr)
+        return 1
+    except Exception as e:
+        print('gateway_ctl: %s unreachable: %s' % (base, e),
+              file=sys.stderr)
+        return 1
+    print('drain accepted (HTTP %d): %d inflight to finish'
+          % (code, int(resp.get('inflight', 0))))
+    deadline = time.time() + args.timeout
+    while time.time() < deadline:
+        try:
+            try:
+                _, health = _get(base + '/healthz', timeout=5)
+            except urllib.error.HTTPError as e:
+                health = json.loads(e.read().decode('utf-8') or '{}')
+        except Exception:
+            # unreachable = the serve loop exited: drained
+            print('gateway drained (listener gone)')
+            return 0
+        if health.get('draining') and not int(health.get('inflight', 0)):
+            print('gateway drained (0 inflight)')
+            return 0
+        time.sleep(0.2)
+    print('gateway_ctl: still busy after %.0fs' % args.timeout,
+          file=sys.stderr)
+    return 1
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog='gateway_ctl')
+    sub = p.add_subparsers(dest='cmd')
+    ps = sub.add_parser('status')
+    ps.add_argument('url')
+    ps.add_argument('--json', action='store_true')
+    ps.add_argument('--key', default=None)
+    pd = sub.add_parser('drain')
+    pd.add_argument('url')
+    pd.add_argument('--key', default=None)
+    pd.add_argument('--timeout', type=float, default=120.0)
+    args = p.parse_args(argv)
+    if args.cmd == 'status':
+        return cmd_status(args)
+    if args.cmd == 'drain':
+        return cmd_drain(args)
+    p.print_usage(sys.stderr)
+    return 2
+
+
+if __name__ == '__main__':
+    sys.exit(main())
